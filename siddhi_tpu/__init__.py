@@ -1,0 +1,32 @@
+"""siddhi_tpu — a TPU-native streaming / Complex Event Processing framework.
+
+A from-scratch re-design of the capabilities of the reference Siddhi engine
+(see SURVEY.md): SiddhiQL over unbounded event streams executed as columnar
+micro-batches through pure, jitted (state, batch) -> (state', out) step
+functions on TPU.
+"""
+import jax
+
+# Java long/double semantics (bit-parity with the reference) require 64-bit
+# types; must be set before any array is created.
+jax.config.update("jax_enable_x64", True)
+
+from .core.types import AttrType  # noqa: E402
+from .lang import parser as compiler  # noqa: E402
+from .lang.parser import (  # noqa: E402
+    parse,
+    parse_expression,
+    parse_on_demand_query,
+    parse_query,
+)
+
+__all__ = [
+    "AttrType",
+    "compiler",
+    "parse",
+    "parse_expression",
+    "parse_on_demand_query",
+    "parse_query",
+]
+
+__version__ = "0.1.0"

@@ -1,0 +1,130 @@
+"""Attribute type system for the TPU-native CEP engine.
+
+Mirrors the reference's attribute types (reference:
+modules/siddhi-query-api/.../definition/Attribute.java — STRING, INT, LONG,
+FLOAT, DOUBLE, BOOL, OBJECT) but maps them to device dtypes:
+
+- INT    -> int32   (Java int, wrapping arithmetic)
+- LONG   -> int64   (Java long)
+- FLOAT  -> float32
+- DOUBLE -> float64 (jax x64 enabled at import of siddhi_tpu)
+- BOOL   -> bool
+- STRING -> int32 dictionary codes (host-side interning; see StringTable)
+- OBJECT -> host-only (cannot cross to device; gated at plan time)
+
+Java-style binary numeric promotion (JLS 5.6.2) is used for arithmetic and
+comparisons, matching the typed executor selection in the reference's
+ExpressionParser (modules/siddhi-core/.../util/parser/ExpressionParser.java:206).
+"""
+from __future__ import annotations
+
+import enum
+import threading
+
+import numpy as np
+
+
+class AttrType(enum.Enum):
+    STRING = "string"
+    INT = "int"
+    LONG = "long"
+    FLOAT = "float"
+    DOUBLE = "double"
+    BOOL = "bool"
+    OBJECT = "object"
+
+    @classmethod
+    def from_name(cls, name: str) -> "AttrType":
+        return cls(name.lower())
+
+
+NUMERIC_TYPES = (AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE)
+
+_NP_DTYPES = {
+    AttrType.STRING: np.int32,   # dictionary code
+    AttrType.INT: np.int32,
+    AttrType.LONG: np.int64,
+    AttrType.FLOAT: np.float32,
+    AttrType.DOUBLE: np.float64,
+    AttrType.BOOL: np.bool_,
+}
+
+
+def np_dtype(t: AttrType):
+    if t is AttrType.OBJECT:
+        raise TypeError("OBJECT attributes cannot be placed on device")
+    return _NP_DTYPES[t]
+
+
+_PROMOTION_ORDER = {
+    AttrType.INT: 0,
+    AttrType.LONG: 1,
+    AttrType.FLOAT: 2,
+    AttrType.DOUBLE: 3,
+}
+
+
+def promote(a: AttrType, b: AttrType) -> AttrType:
+    """Java binary numeric promotion: the wider of the two operand types."""
+    if a not in _PROMOTION_ORDER or b not in _PROMOTION_ORDER:
+        raise TypeError(f"cannot apply numeric promotion to {a} and {b}")
+    order = max(_PROMOTION_ORDER[a], _PROMOTION_ORDER[b])
+    for t, o in _PROMOTION_ORDER.items():
+        if o == order:
+            return t
+    raise AssertionError
+
+
+class StringTable:
+    """Global host-side string interning: string <-> int32 dictionary code.
+
+    The reference manipulates java.lang.String values directly inside the
+    per-event executor trees; on TPU, strings travel as dictionary codes and
+    only equality / group-by / join-key semantics are preserved on device
+    (which is all the reference's hot paths use them for). Decoding happens in
+    host callbacks.
+
+    Code 0 is reserved for null.
+    """
+
+    NULL_CODE = 0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._to_code: dict[str, int] = {}
+        self._to_str: list = [None]  # code 0 -> null
+
+    def encode(self, s) -> int:
+        if s is None:
+            return self.NULL_CODE
+        s = str(s)
+        code = self._to_code.get(s)
+        if code is None:
+            with self._lock:
+                code = self._to_code.get(s)
+                if code is None:
+                    code = len(self._to_str)
+                    self._to_str.append(s)
+                    self._to_code[s] = code
+        return code
+
+    def decode(self, code: int):
+        return self._to_str[int(code)]
+
+    def __len__(self):
+        return len(self._to_str)
+
+
+# Single process-wide table: codes are stable across apps/runtimes, which
+# makes snapshots and cross-app streams trivially consistent.
+GLOBAL_STRINGS = StringTable()
+
+
+def null_value(t: AttrType):
+    """The in-band placeholder stored in the data column where null; the
+    actual null signal is the per-column null mask."""
+    if t is AttrType.STRING:
+        return StringTable.NULL_CODE
+    if t is AttrType.BOOL:
+        return False
+    return np_dtype(t)(0)

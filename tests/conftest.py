@@ -1,0 +1,12 @@
+"""Test configuration: force a virtual 8-device CPU platform so sharding /
+multi-chip paths are exercised without TPU hardware, and keep compiles fast.
+
+Must run before jax (or siddhi_tpu) is imported anywhere in the test process.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

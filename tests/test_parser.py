@@ -1,0 +1,325 @@
+"""Parser round-trip tests over the SiddhiQL surface.
+
+Modeled on the reference's grammar test suites
+(modules/siddhi-query-compiler/src/test/.../SimpleQueryTestCase.java etc.) —
+every construct parses into the expected query object model.
+"""
+import pytest
+
+from siddhi_tpu import AttrType, parse, parse_expression, parse_on_demand_query
+from siddhi_tpu.lang import ast as A
+
+
+def test_stream_definition():
+    app = parse("define stream StockStream (symbol string, price float, volume long);")
+    sd = app.stream_definitions["StockStream"]
+    assert [a.name for a in sd.attributes] == ["symbol", "price", "volume"]
+    assert [a.type for a in sd.attributes] == [AttrType.STRING, AttrType.FLOAT, AttrType.LONG]
+
+
+def test_filter_query():
+    app = parse("""
+        @app:name('Test')
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from StockStream[price > 100 and volume > 5]
+        select symbol, price
+        insert into OutputStream;
+    """)
+    assert app.name == "Test"
+    (q,) = app.execution_elements
+    assert q.name == "query1"
+    assert isinstance(q.input, A.SingleInputStream)
+    f = q.input.handlers[0]
+    assert isinstance(f, A.Filter)
+    assert isinstance(f.expression, A.And)
+    assert isinstance(q.output, A.InsertIntoStream)
+    assert q.output.target == "OutputStream"
+    assert len(q.selector.attributes) == 2
+
+
+def test_window_query():
+    app = parse("""
+        define stream S (symbol string, price float);
+        from S#window.lengthBatch(5)
+        select symbol, sum(price) as total
+        group by symbol
+        having total > 10
+        insert all events into Out;
+    """)
+    (q,) = app.execution_elements
+    w = q.input.window
+    assert w.name == "lengthBatch"
+    assert w.parameters[0].value == 5
+    assert q.selector.group_by[0].attribute == "symbol"
+    assert q.output.output_event_type == "all"
+
+
+def test_time_value_literal():
+    app = parse("""
+        define stream S (a int);
+        from S#window.time(1 min 30 sec) select a insert into O;
+    """)
+    (q,) = app.execution_elements
+    assert q.input.window.parameters[0].value == 90_000
+    assert q.input.window.parameters[0].is_time
+
+
+def test_join_query():
+    app = parse("""
+        define stream A (symbol string, price float);
+        define stream B (symbol string, tweets int);
+        from A#window.time(1 sec) as l
+        join B#window.time(1 sec) as r
+        on l.symbol == r.symbol
+        select l.symbol as symbol, l.price, r.tweets
+        insert into Out;
+    """)
+    (q,) = app.execution_elements
+    j = q.input
+    assert isinstance(j, A.JoinInputStream)
+    assert j.join_type == "inner"
+    assert j.left.alias == "l" and j.right.alias == "r"
+    assert isinstance(j.on, A.Compare)
+
+
+def test_outer_join_unidirectional():
+    app = parse("""
+        define stream A (x int); define stream B (x int);
+        from A#window.length(5) unidirectional left outer join B#window.length(5)
+        on A.x == B.x select A.x insert into Out;
+    """)
+    (q,) = app.execution_elements
+    assert q.input.join_type == "left_outer"
+    assert q.input.unidirectional == "left"
+
+
+def test_pattern_query():
+    app = parse("""
+        define stream A (v int); define stream B (v int);
+        from every e1=A[v > 10] -> e2=B[v > e1.v] within 5 sec
+        select e1.v as v1, e2.v as v2
+        insert into Out;
+    """)
+    (q,) = app.execution_elements
+    si = q.input
+    assert isinstance(si, A.StateInputStream)
+    assert si.state_type == "pattern"
+    assert si.within_ms == 5000
+    nxt = si.state
+    assert isinstance(nxt, A.NextStateElement)
+    assert isinstance(nxt.state, A.EveryStateElement)
+    inner = nxt.state.state
+    assert isinstance(inner, A.StreamStateElement)
+    assert inner.event_ref == "e1"
+    assert isinstance(nxt.next, A.StreamStateElement)
+
+
+def test_pattern_count_and_logical():
+    app = parse("""
+        define stream A (v int); define stream B (v int); define stream C (v int);
+        from e1=A<2:5> -> e2=B and e3=C
+        select e1[0].v as first, e2.v as bv
+        insert into Out;
+    """)
+    (q,) = app.execution_elements
+    nxt = q.input.state
+    assert isinstance(nxt.state, A.CountStateElement)
+    assert nxt.state.min_count == 2 and nxt.state.max_count == 5
+    assert isinstance(nxt.next, A.LogicalStateElement)
+    sel0 = q.selector.attributes[0].expression
+    assert sel0.index == 0
+
+
+def test_sequence_query():
+    app = parse("""
+        define stream A (v int); define stream B (v int);
+        from every e1=A, e2=B[v > e1.v]
+        select e1.v, e2.v insert into Out;
+    """)
+    (q,) = app.execution_elements
+    assert q.input.state_type == "sequence"
+
+
+def test_sequence_kleene():
+    app = parse("""
+        define stream A (v int); define stream B (v int);
+        from every e1=A+, e2=B
+        select e1[0].v as v0, e2.v insert into Out;
+    """)
+    (q,) = app.execution_elements
+    first = q.input.state.state
+    assert isinstance(first, A.EveryStateElement)
+    assert isinstance(first.state, A.CountStateElement)
+    assert first.state.min_count == 1 and first.state.max_count == -1
+
+
+def test_absent_pattern():
+    app = parse("""
+        define stream A (v int); define stream B (v int);
+        from e1=A -> not B[v == e1.v] for 1 sec
+        select e1.v insert into Out;
+    """)
+    (q,) = app.execution_elements
+    absent = q.input.state.next
+    assert isinstance(absent, A.AbsentStreamStateElement)
+    assert absent.waiting_time_ms == 1000
+
+
+def test_partition():
+    app = parse("""
+        define stream S (symbol string, price float);
+        partition with (symbol of S)
+        begin
+            from S select symbol, sum(price) as total insert into #Inner;
+            from #Inner select symbol, total insert into Out;
+        end;
+    """)
+    (p,) = app.execution_elements
+    assert isinstance(p, A.Partition)
+    assert isinstance(p.partition_types[0], A.ValuePartitionType)
+    assert len(p.queries) == 2
+    assert p.queries[0].output.is_inner
+    assert p.queries[1].input.is_inner
+
+
+def test_range_partition():
+    app = parse("""
+        define stream S (v int);
+        partition with (v < 10 as 'small' or v >= 10 as 'big' of S)
+        begin
+            from S select v insert into Out;
+        end;
+    """)
+    (p,) = app.execution_elements
+    rt = p.partition_types[0]
+    assert isinstance(rt, A.RangePartitionType)
+    assert [label for _, label in rt.ranges] == ["small", "big"]
+
+
+def test_table_definitions_and_ops():
+    app = parse("""
+        define stream S (symbol string, price float);
+        @PrimaryKey('symbol')
+        define table T (symbol string, price float);
+        from S select symbol, price insert into T;
+        from S delete T on T.symbol == symbol;
+        from S update T set T.price = price on T.symbol == symbol;
+        from S update or insert into T set T.price = S.price on T.symbol == S.symbol;
+    """)
+    assert "T" in app.table_definitions
+    outs = [q.output for q in app.execution_elements]
+    assert isinstance(outs[1], A.DeleteStream)
+    assert isinstance(outs[2], A.UpdateStream)
+    assert len(outs[2].set_clause) == 1
+    assert isinstance(outs[3], A.UpdateOrInsertStream)
+
+
+def test_trigger_and_window_definitions():
+    app = parse("""
+        define trigger T5 at every 5 sec;
+        define trigger TStart at 'start';
+        define window W (symbol string, price float) lengthBatch(20) output all events;
+    """)
+    assert app.trigger_definitions["T5"].at_every_ms == 5000
+    assert app.trigger_definitions["TStart"].at_cron == "start"
+    assert app.window_definitions["W"].window.name == "lengthBatch"
+
+
+def test_function_definition():
+    app = parse("""
+        define function concatFn[javascript] return string {
+            var str1 = data[0]; return str1;
+        };
+        define stream S (a string);
+        from S select concatFn(a) as b insert into Out;
+    """)
+    fd = app.function_definitions["concatFn"]
+    assert fd.language == "javascript"
+    assert fd.return_type == AttrType.STRING
+    assert "str1" in fd.body
+
+
+def test_aggregation_definition():
+    app = parse("""
+        define stream S (symbol string, price float, ts long);
+        define aggregation StockAgg
+        from S
+        select symbol, avg(price) as avgPrice, sum(price) as total
+        group by symbol
+        aggregate by ts every sec ... year;
+    """)
+    agg = app.aggregation_definitions["StockAgg"]
+    assert agg.durations == ["seconds", "minutes", "hours", "days", "weeks",
+                             "months", "years"]
+    assert agg.aggregate_by.attribute == "ts"
+
+
+def test_output_rate():
+    app = parse("""
+        define stream S (a int);
+        from S select a output last every 3 events insert into O;
+        from S select a output snapshot every 1 sec insert into O2;
+    """)
+    r0 = app.execution_elements[0].output_rate
+    assert isinstance(r0, A.EventOutputRate) and r0.events == 3 and r0.type == "last"
+    r1 = app.execution_elements[1].output_rate
+    assert isinstance(r1, A.SnapshotOutputRate) and r1.ms == 1000
+
+
+def test_expressions():
+    e = parse_expression("price * 0.9 + 5 > volume / 2")
+    assert isinstance(e, A.Compare)
+    e2 = parse_expression("not (a and b) or c != 'x'")
+    assert isinstance(e2, A.Or)
+    e3 = parse_expression("symbol is null")
+    assert isinstance(e3, A.IsNull)
+    e4 = parse_expression("convert(price, 'double')")
+    assert isinstance(e4, A.AttributeFunction)
+    e5 = parse_expression("math:floor(price)")
+    assert e5.namespace == "math"
+    e6 = parse_expression("price in PriceTable")
+    assert isinstance(e6, A.InTable)
+    e7 = parse_expression("-5")
+    assert e7.value == -5
+    e8 = parse_expression("1.5")
+    assert e8.type == AttrType.DOUBLE
+    e9 = parse_expression("1.5f")
+    assert e9.type == AttrType.FLOAT
+    e10 = parse_expression("10l")
+    assert e10.type == AttrType.LONG
+
+
+def test_on_demand_query():
+    q = parse_on_demand_query("from StockTable on price > 5 select symbol, price")
+    assert q.input_id == "StockTable"
+    assert isinstance(q.on, A.Compare)
+    q2 = parse_on_demand_query("select 'IBM' as symbol, 100f as price insert into StockTable")
+    assert isinstance(q2.output, A.InsertIntoStream)
+    q3 = parse_on_demand_query("update StockTable set StockTable.price = 50f on StockTable.symbol == 'IBM'")
+    assert isinstance(q3.output, A.UpdateStream)
+
+
+def test_comments_and_strings():
+    app = parse("""
+        -- line comment
+        /* block
+           comment */
+        define stream S (a string);
+        from S[a == "double-quoted"] select a insert into O;
+    """)
+    assert len(app.execution_elements) == 1
+
+
+def test_anonymous_stream():
+    app = parse("""
+        define stream S (a int);
+        from (from S select a return) select a insert into O;
+    """)
+    (q,) = app.execution_elements
+    assert isinstance(q.input, A.AnonymousInputStream)
+
+
+def test_parse_error():
+    with pytest.raises(Exception):
+        parse("define stream S (a int; from S select a insert into O;")
