@@ -11,6 +11,8 @@ import jax
 # types; must be set before any array is created.
 jax.config.update("jax_enable_x64", True)
 
+from .core.manager import SiddhiManager  # noqa: E402
+from .core.stream import Event, QueryCallback, StreamCallback  # noqa: E402
 from .core.types import AttrType  # noqa: E402
 from .lang import parser as compiler  # noqa: E402
 from .lang.parser import (  # noqa: E402
@@ -22,6 +24,10 @@ from .lang.parser import (  # noqa: E402
 
 __all__ = [
     "AttrType",
+    "Event",
+    "QueryCallback",
+    "SiddhiManager",
+    "StreamCallback",
     "compiler",
     "parse",
     "parse_expression",

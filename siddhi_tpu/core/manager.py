@@ -1,0 +1,55 @@
+"""SiddhiManager: top-level facade (reference: core/SiddhiManager.java:49).
+
+createSiddhiAppRuntime parses + plans + returns a runtime; also the
+registration point for persistence stores and extensions.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..lang import ast as A
+from ..lang.parser import parse
+from .runtime import SiddhiAppRuntime
+
+
+class SiddhiManager:
+    def __init__(self):
+        self.app_runtimes: dict[str, SiddhiAppRuntime] = {}
+        self.extensions: dict[str, object] = {}
+        self.persistence_store = None
+
+    def create_siddhi_app_runtime(self, source) -> SiddhiAppRuntime:
+        if isinstance(source, str):
+            app_ast = parse(source)
+        elif isinstance(source, A.SiddhiApp):
+            app_ast = source
+        else:
+            raise TypeError("expected SiddhiQL text or SiddhiApp")
+        rt = SiddhiAppRuntime(app_ast, manager=self)
+        self.app_runtimes[rt.name] = rt
+        return rt
+
+    # camelCase alias mirroring the reference API surface
+    createSiddhiAppRuntime = create_siddhi_app_runtime
+
+    def get_siddhi_app_runtime(self, name: str) -> Optional[SiddhiAppRuntime]:
+        return self.app_runtimes.get(name)
+
+    def validate_siddhi_app(self, source) -> None:
+        """Parse + plan, then discard (reference SiddhiManager.validateSiddhiApp)."""
+        if isinstance(source, str):
+            app_ast = parse(source)
+        else:
+            app_ast = source
+        SiddhiAppRuntime(app_ast, manager=None)
+
+    def set_extension(self, name: str, ext) -> None:
+        self.extensions[name.lower()] = ext
+
+    def set_persistence_store(self, store) -> None:
+        self.persistence_store = store
+
+    def shutdown(self) -> None:
+        for rt in list(self.app_runtimes.values()):
+            rt.shutdown()
+        self.app_runtimes.clear()

@@ -1,0 +1,309 @@
+"""Runtime assembly: query runtimes, the app runtime, and the planner that
+builds them from the parsed query object model.
+
+Reference mapping:
+- SiddhiAppRuntimeImpl (core/SiddhiAppRuntimeImpl.java:99) -> SiddhiAppRuntime
+- QueryRuntimeImpl (query/QueryRuntimeImpl.java:43)        -> QueryRuntime
+- SiddhiAppParser/QueryParser/SingleInputStreamParser
+  (util/parser/*.java)                                     -> Planner
+
+Execution model: each query compiles to ONE jitted step function
+(state, batch, now) -> (state', out_batch). The host junction layer feeds
+micro-batches in; batch capacity is bucketed so jit caches stay warm.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..lang import ast as A
+from ..ops.expr import CompileError, SingleStreamScope, compile_expression
+from ..ops.operators import FilterOp, Operator
+from ..ops.selector import ProjectOp, has_aggregators
+from .event import (CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema,
+                    batch_from_rows, rows_from_batch)
+from .stream import (Event, InputHandler, QueryCallback, Receiver,
+                     StreamCallback, StreamJunction)
+
+BATCH_BUCKETS = (16, 128, 1024, 8192, 65536)
+
+
+def bucket_capacity(n: int) -> int:
+    i = bisect.bisect_left(BATCH_BUCKETS, n)
+    if i == len(BATCH_BUCKETS):
+        return BATCH_BUCKETS[-1]
+    return BATCH_BUCKETS[i]
+
+
+class OutputHandler:
+    def handle(self, timestamp: int, rows: list) -> None:
+        raise NotImplementedError
+
+
+class InsertIntoStreamHandler(OutputHandler):
+    """Publish query output into a stream junction; EXPIRED events become
+    CURRENT on insert (InsertIntoStreamCallback.java:52-55)."""
+
+    def __init__(self, junction: StreamJunction, output_event_type: str):
+        self.junction = junction
+        self.output_event_type = output_event_type
+
+    def handle(self, timestamp, rows):
+        events = [Event(timestamp=ts, data=vals) for ts, kind, vals in rows]
+        self.junction.publish(events)
+
+
+class QueryCallbackHandler(OutputHandler):
+    def __init__(self):
+        self.callbacks: list[QueryCallback] = []
+
+    def handle(self, timestamp, rows):
+        if not self.callbacks:
+            return
+        in_events = [Event(ts, vals) for ts, kind, vals in rows
+                     if kind == CURRENT]
+        rm_events = [Event(ts, vals, is_expired=True)
+                     for ts, kind, vals in rows if kind == EXPIRED]
+        for cb in self.callbacks:
+            cb.receive(timestamp, in_events or None, rm_events or None)
+
+
+class QueryRuntime(Receiver):
+    """One query: an operator chain jitted into a single device step."""
+
+    def __init__(self, name: str, operators: list[Operator],
+                 in_schema: StreamSchema, app: "SiddhiAppRuntime",
+                 current_on: bool, expired_on: bool):
+        self.name = name
+        self.operators = operators
+        self.in_schema = in_schema
+        self.out_schema = operators[-1].out_schema
+        self.app = app
+        self.current_on = current_on
+        self.expired_on = expired_on
+        self.output_handlers: list[OutputHandler] = []
+        self.callback_handler = QueryCallbackHandler()
+        self.states = tuple(op.init_state() for op in operators)
+        self._step_fns: dict[int, Callable] = {}
+        self._lock = threading.Lock()
+
+    # -- compile ---------------------------------------------------------
+    def _make_step(self):
+        ops = self.operators
+        current_on, expired_on = self.current_on, self.expired_on
+
+        def step(states, batch: EventBatch, now):
+            new_states = []
+            for op, st in zip(ops, states):
+                st, batch = op.step(st, batch, now)
+                new_states.append(st)
+            keep = ((batch.kind == CURRENT) & current_on) | (
+                (batch.kind == EXPIRED) & expired_on)
+            batch = batch.mask(keep)
+            return tuple(new_states), batch
+
+        return jax.jit(step)
+
+    def _step_for(self, capacity: int) -> Callable:
+        fn = self._step_fns.get(capacity)
+        if fn is None:
+            fn = self._make_step()
+            self._step_fns[capacity] = fn
+        return fn
+
+    # -- runtime ---------------------------------------------------------
+    def receive(self, events: list[Event]) -> None:
+        max_cap = BATCH_BUCKETS[-1]
+        for start in range(0, len(events), max_cap):
+            chunk = events[start:start + max_cap]
+            rows = [e.data for e in chunk]
+            tss = [e.timestamp for e in chunk]
+            kinds = [EXPIRED if e.is_expired else CURRENT for e in chunk]
+            cap = bucket_capacity(len(chunk))
+            batch = batch_from_rows(self.in_schema, rows, tss, cap, kinds)
+            self.process_batch(batch, chunk[-1].timestamp)
+
+    def process_batch(self, batch: EventBatch, timestamp: int) -> None:
+        now = jnp.asarray(self.app.current_time(), dtype=jnp.int64)
+        with self._lock:
+            step = self._step_for(batch.capacity)
+            self.states, out = step(self.states, batch, now)
+        out_rows = rows_from_batch(self.out_schema.types, out)
+        if not out_rows:
+            return
+        for h in self.output_handlers:
+            h.handle(timestamp, out_rows)
+        self.callback_handler.handle(timestamp, out_rows)
+
+
+class StreamCallbackReceiver(Receiver):
+    def __init__(self, callback: StreamCallback):
+        self.callback = callback
+
+    def receive(self, events):
+        self.callback.receive(events)
+
+
+class SiddhiAppRuntime:
+    """Per-app container: junctions, query runtimes, handlers, lifecycle
+    (reference SiddhiAppRuntimeImpl: start/shutdown :440-655,
+    persist/restore :677-755)."""
+
+    def __init__(self, app_ast: A.SiddhiApp, manager=None):
+        self.ast = app_ast
+        self.manager = manager
+        self.name = app_ast.name or f"app_{id(self):x}"
+        self.junctions: dict[str, StreamJunction] = {}
+        self.schemas: dict[str, StreamSchema] = {}
+        self.input_handlers: dict[str, InputHandler] = {}
+        self.queries: dict[str, QueryRuntime] = {}
+        self.running = False
+        self._playback = False
+        self._playback_time: Optional[int] = None
+        Planner(self).plan()
+
+    # -- time ------------------------------------------------------------
+    def current_time(self) -> int:
+        if self._playback and self._playback_time is not None:
+            return self._playback_time
+        return int(time.time() * 1000)
+
+    def on_ingest(self, stream_id: str, events: list[Event]) -> None:
+        if self._playback and events:
+            self._playback_time = events[-1].timestamp
+
+    # -- wiring ----------------------------------------------------------
+    def junction_for(self, stream_id: str,
+                     schema: Optional[StreamSchema] = None) -> StreamJunction:
+        j = self.junctions.get(stream_id)
+        if j is None:
+            if schema is None:
+                raise CompileError(f"undefined stream '{stream_id}'")
+            j = StreamJunction(stream_id, schema)
+            self.junctions[stream_id] = j
+            self.schemas[stream_id] = schema
+        elif schema is not None and schema.types != j.schema.types:
+            raise CompileError(
+                f"output schema {list(schema.types)} does not match existing "
+                f"definition of stream '{stream_id}' {list(j.schema.types)} "
+                "(reference rejects mismatched insert-into at deploy time)")
+        return j
+
+    # -- public API (= SiddhiAppRuntime) ---------------------------------
+    def get_input_handler(self, stream_id: str) -> InputHandler:
+        h = self.input_handlers.get(stream_id)
+        if h is None:
+            raise KeyError(f"no input handler for stream '{stream_id}' "
+                           f"(defined streams: {list(self.input_handlers)})")
+        return h
+
+    def add_callback(self, target, callback) -> None:
+        """StreamCallback on a stream id, or QueryCallback on a query name."""
+        if isinstance(callback, QueryCallback):
+            q = self.queries.get(target)
+            if q is None:
+                raise KeyError(f"no query named '{target}'")
+            q.callback_handler.callbacks.append(callback)
+        else:
+            j = self.junctions.get(target)
+            if j is None:
+                raise KeyError(f"no stream '{target}' to subscribe to")
+            j.subscribe(StreamCallbackReceiver(callback))
+
+    def start(self) -> None:
+        self.running = True
+
+    def shutdown(self) -> None:
+        self.running = False
+
+
+class Planner:
+    """AST -> runtime graph (= SiddhiAppParser + QueryParser +
+    SingleInputStreamParser + SelectorParser + OutputParser)."""
+
+    def __init__(self, app: SiddhiAppRuntime):
+        self.app = app
+        self.ast = app.ast
+
+    def plan(self) -> None:
+        app, ast = self.app, self.ast
+        # 1. defined streams -> junctions + input handlers
+        for sid, sd in ast.stream_definitions.items():
+            schema = StreamSchema(sid, tuple(
+                Attribute(a.name, a.type) for a in sd.attributes))
+            j = app.junction_for(sid, schema)
+            app.input_handlers[sid] = InputHandler(sid, j, app)
+        # playback mode
+        pb = A.find_annotation(ast.annotations, "playback")
+        if pb is not None:
+            app._playback = True
+        # 2. queries in order; inferred output streams defined as we go
+        qcount = 0
+        for el in ast.execution_elements:
+            if isinstance(el, A.Query):
+                qcount += 1
+                self.plan_query(el, default_name=f"query_{qcount}")
+            elif isinstance(el, A.Partition):
+                raise CompileError("partitions are planned in a later stage")
+
+    def plan_query(self, q: A.Query, default_name: str) -> None:
+        app = self.app
+        name = q.name or default_name
+        if not isinstance(q.input, A.SingleInputStream):
+            raise CompileError(
+                f"query '{name}': only single-stream queries supported in "
+                "this stage")
+        sin = q.input
+        schema = app.schemas.get(sin.stream_id)
+        if schema is None:
+            raise CompileError(f"query '{name}': undefined stream "
+                               f"'{sin.stream_id}'")
+        scope = SingleStreamScope(schema, aliases=(sin.alias,))
+        operators: list[Operator] = []
+        for h in sin.handlers:
+            if isinstance(h, A.Filter):
+                cond = compile_expression(h.expression, scope)
+                if cond.type.name != "BOOL":
+                    raise CompileError(
+                        f"query '{name}': filter must be BOOL")
+                operators.append(FilterOp(cond, schema))
+            elif isinstance(h, A.WindowHandler):
+                raise CompileError(
+                    f"query '{name}': window '{h.name}' not yet supported")
+            else:
+                raise CompileError(
+                    f"query '{name}': stream function "
+                    f"'{h.name}' not yet supported")
+        # selector
+        if any(has_aggregators(oa.expression) for oa in q.selector.attributes):
+            raise CompileError(
+                f"query '{name}': aggregators not yet supported")
+        out = q.output
+        if isinstance(out, (A.InsertIntoStream, A.ReturnStream)):
+            out_type = out.output_event_type
+        else:
+            raise CompileError(f"query '{name}': table output not yet "
+                               "supported")
+        target = out.target if isinstance(out, A.InsertIntoStream) else name
+        operators.append(ProjectOp(q.selector, schema, target, scope))
+        current_on = out_type in ("current", "all")
+        expired_on = out_type in ("expired", "all")
+        if name in app.queries:
+            raise CompileError(f"duplicate query name '{name}'")
+        qr = QueryRuntime(name, operators, schema, app,
+                          current_on, expired_on)
+        app.junctions[sin.stream_id].subscribe(qr)
+        app.queries[name] = qr
+        if isinstance(out, A.InsertIntoStream):
+            tj = app.junction_for(out.target, qr.out_schema)
+            if out.target not in app.input_handlers:
+                app.input_handlers[out.target] = InputHandler(out.target, tj,
+                                                              app)
+            qr.output_handlers.append(
+                InsertIntoStreamHandler(tj, out_type))

@@ -1,0 +1,114 @@
+"""Host-side stream layer: events, junctions, input handlers, callbacks.
+
+Reference mapping:
+- Event (io.siddhi.core.event.Event)            -> Event dataclass
+- StreamJunction (stream/StreamJunction.java:61) -> StreamJunction (sync pub/sub;
+  async micro-batch pipelining is a junction option, see @Async in runtime.py)
+- InputHandler (stream/input/InputHandler.java:28) -> InputHandler
+- StreamCallback (stream/output/StreamCallback.java:38) -> StreamCallback
+- QueryCallback (query/output/callback/QueryCallback.java:37) -> QueryCallback
+
+The junction is the host edge of the device dataflow: queries subscribe as
+receivers; events are handed over as row lists and each receiver decides how
+to batch them onto the device.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+
+@dataclasses.dataclass
+class Event:
+    timestamp: int
+    data: tuple
+    is_expired: bool = False
+
+    def __repr__(self):
+        kind = "EXPIRED" if self.is_expired else "CURRENT"
+        return f"Event{{ts={self.timestamp}, data={list(self.data)}, {kind}}}"
+
+
+class Receiver:
+    """A junction subscriber (query input or stream callback)."""
+
+    def receive(self, events: list[Event]) -> None:
+        raise NotImplementedError
+
+
+class StreamJunction:
+    """Per-stream pub/sub hub. Synchronous: publish calls every receiver
+    inline, preserving the reference's sync-mode semantics
+    (StreamJunction.java:166-177)."""
+
+    def __init__(self, stream_id: str, schema):
+        self.stream_id = stream_id
+        self.schema = schema
+        self.receivers: list[Receiver] = []
+        self.fault_junction: Optional["StreamJunction"] = None
+        self.on_error_action: str = "LOG"
+        self._lock = threading.Lock()
+
+    def subscribe(self, receiver: Receiver) -> None:
+        self.receivers.append(receiver)
+
+    def publish(self, events: list[Event]) -> None:
+        if not events:
+            return
+        for r in list(self.receivers):
+            r.receive(events)
+
+
+class InputHandler:
+    """User entry point for one stream (InputHandler.send overloads:
+    Object[] / Event / Event[] — stream/input/InputHandler.java:40-75)."""
+
+    def __init__(self, stream_id: str, junction: StreamJunction, app_runtime):
+        self.stream_id = stream_id
+        self.junction = junction
+        self.app = app_runtime
+
+    def send(self, data) -> None:
+        if not self.app.running:
+            raise RuntimeError(
+                f"app '{self.app.name}' is not running; call start() first")
+        now = self.app.current_time
+        if isinstance(data, (list, tuple)) and len(data) == 0:
+            return
+        if isinstance(data, Event):
+            events = [data]
+        elif isinstance(data, (list, tuple)) and data and isinstance(
+                data[0], Event):
+            events = list(data)
+        elif (isinstance(data, (list, tuple)) and data
+              and isinstance(data[0], (list, tuple))):
+            events = [Event(timestamp=now(), data=tuple(d)) for d in data]
+        else:
+            events = [Event(timestamp=now(), data=tuple(data))]
+        self.app.on_ingest(self.stream_id, events)
+        self.junction.publish(events)
+
+
+class StreamCallback(Receiver):
+    """Subscribe to a stream and receive raw events. Subclass and override
+    receive(), or pass fn= to the constructor."""
+
+    def __init__(self, fn: Optional[Callable[[list[Event]], None]] = None):
+        self._fn = fn
+
+    def receive(self, events: list[Event]) -> None:
+        if self._fn is not None:
+            self._fn(events)
+
+
+class QueryCallback:
+    """Per-query callback: receive(timestamp, in_events, removed_events),
+    matching QueryCallback.receive(ts, inEvents, removeEvents)."""
+
+    def __init__(self, fn: Optional[Callable] = None):
+        self._fn = fn
+
+    def receive(self, timestamp: int, in_events, removed_events) -> None:
+        if self._fn is not None:
+            self._fn(timestamp, in_events, removed_events)

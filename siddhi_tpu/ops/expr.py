@@ -1,0 +1,499 @@
+"""Expression compiler: query-api expression AST -> vectorized column ops.
+
+The TPU-native replacement for the reference's typed executor trees
+(modules/siddhi-core/.../util/parser/ExpressionParser.java:206 and the 164
+executor classes under executor/). Instead of a per-event tree walk, each
+expression compiles to a pure function over whole columns:
+
+    fn(env: dict[key, Col]) -> Col      # Col = (values[B], nulls[B])
+
+Java/Siddhi semantics preserved exactly:
+- binary numeric promotion (int<long<float<double), wrapping int arithmetic
+- math on null -> null; divide/modulo by zero -> null (all numeric types,
+  executor/math/divide/DivideExpressionExecutorDouble.java:46-48)
+- integer division/remainder truncate toward zero (Java `/` `%`)
+- compare with null operand -> FALSE, never null
+  (executor/condition/compare/CompareConditionExpressionExecutor.java:38-42)
+- and/or treat null as false; not(null) -> TRUE
+  (AndConditionExpressionExecutor.java:65-73, NotConditionExpressionExecutor.java:43-50)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import AttrType, GLOBAL_STRINGS, NUMERIC_TYPES, np_dtype, promote
+from ..lang import ast as A
+
+
+class CompileError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class Col:
+    """A column: device values plus null mask (both [B] or scalar)."""
+    values: Any
+    nulls: Any
+
+    @classmethod
+    def const(cls, value, t: AttrType):
+        dt = np_dtype(t)
+        if value is None:
+            v = jnp.zeros((), dtype=dt)
+            n = jnp.ones((), dtype=jnp.bool_)
+        else:
+            if t is AttrType.STRING:
+                value = GLOBAL_STRINGS.encode(value)
+            v = jnp.asarray(value, dtype=dt)
+            n = jnp.zeros((), dtype=jnp.bool_)
+        return cls(v, n)
+
+
+@dataclasses.dataclass
+class CompiledExpr:
+    type: AttrType
+    fn: Callable[[dict], Col]
+    const_value: Any = None     # set when the expression is a literal
+    is_const: bool = False
+
+
+class Scope:
+    """Variable resolution at compile time.
+
+    Maps a Variable (stream_ref/attribute[/index]) to an env key and type.
+    Concrete scopes are provided by the planner (single stream, join sides,
+    pattern state events).
+    """
+
+    def resolve(self, var: A.Variable) -> tuple[Any, AttrType]:
+        raise NotImplementedError
+
+    def resolve_stream_isnull(self, is_null: A.IsNull):
+        raise CompileError("stream is null not supported in this context")
+
+
+class SingleStreamScope(Scope):
+    """One input stream: variables resolve to ('attr', index)."""
+
+    def __init__(self, schema, aliases=()):
+        self.schema = schema
+        self.aliases = {a for a in aliases if a}
+
+    def resolve(self, var: A.Variable):
+        ref = var.stream_ref
+        if ref is not None and ref != self.schema.stream_id and ref not in self.aliases:
+            raise CompileError(
+                f"unknown stream reference '{ref}' (expected "
+                f"'{self.schema.stream_id}')")
+        idx = self.schema.index_of(var.attribute)
+        return ("attr", idx), self.schema.types[idx]
+
+
+def env_from_batch(batch) -> dict:
+    """Standard env for a single-stream batch."""
+    env = {("attr", i): Col(batch.cols[i], batch.nulls[i])
+           for i in range(len(batch.cols))}
+    env["__ts__"] = Col(batch.ts, jnp.zeros_like(batch.valid))
+    return env
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _to_dtype(col: Col, t: AttrType) -> Col:
+    return Col(col.values.astype(np_dtype(t)), col.nulls)
+
+
+def _num(e: CompiledExpr, what: str) -> None:
+    if e.type not in NUMERIC_TYPES:
+        raise CompileError(f"{what} requires a numeric operand, got {e.type}")
+
+
+# ---------------------------------------------------------------------------
+# main compile dispatch
+# ---------------------------------------------------------------------------
+
+
+def compile_expression(expr: A.Expression, scope: Scope,
+                       functions: Optional[dict] = None) -> CompiledExpr:
+    functions = functions or {}
+
+    def comp(e: A.Expression) -> CompiledExpr:
+        if isinstance(e, A.Constant):
+            t = e.type
+            if e.value is None:
+                # untyped NULL literal: treated as an always-null DOUBLE
+                cv = Col.const(None, AttrType.DOUBLE)
+                return CompiledExpr(AttrType.DOUBLE, lambda env: cv,
+                                    const_value=None, is_const=True)
+            cv = Col.const(e.value, t)
+            return CompiledExpr(t, lambda env, c=cv: c,
+                                const_value=e.value, is_const=True)
+
+        if isinstance(e, A.Variable):
+            if e.attribute is None:
+                raise CompileError(f"bare stream reference '{e.stream_ref}' "
+                                   "only valid in IS NULL")
+            key, t = scope.resolve(e)
+            return CompiledExpr(t, lambda env, k=key: env[k])
+
+        if isinstance(e, A.MathOp):
+            return _compile_math(e, comp)
+
+        if isinstance(e, A.Compare):
+            return _compile_compare(e, comp)
+
+        if isinstance(e, A.And):
+            l, r = comp(e.left), comp(e.right)
+            _require_bool(l, "AND"), _require_bool(r, "AND")
+
+            def fn(env):
+                lc, rc = l.fn(env), r.fn(env)
+                v = (lc.values & ~lc.nulls) & (rc.values & ~rc.nulls)
+                return Col(v, jnp.zeros_like(v))
+            return CompiledExpr(AttrType.BOOL, fn)
+
+        if isinstance(e, A.Or):
+            l, r = comp(e.left), comp(e.right)
+            _require_bool(l, "OR"), _require_bool(r, "OR")
+
+            def fn(env):
+                lc, rc = l.fn(env), r.fn(env)
+                v = (lc.values & ~lc.nulls) | (rc.values & ~rc.nulls)
+                return Col(v, jnp.zeros_like(v))
+            return CompiledExpr(AttrType.BOOL, fn)
+
+        if isinstance(e, A.Not):
+            x = comp(e.expr)
+            _require_bool(x, "NOT")
+
+            def fn(env):
+                c = x.fn(env)
+                v = ~(c.values & ~c.nulls)
+                return Col(v, jnp.zeros_like(v))
+            return CompiledExpr(AttrType.BOOL, fn)
+
+        if isinstance(e, A.IsNull):
+            if e.expr is None:
+                return scope.resolve_stream_isnull(e)
+            x = comp(e.expr)
+
+            def fn(env):
+                c = x.fn(env)
+                v = c.nulls | jnp.zeros_like(c.nulls)
+                return Col(v, jnp.zeros_like(v))
+            return CompiledExpr(AttrType.BOOL, fn)
+
+        if isinstance(e, A.InTable):
+            raise CompileError("IN <table> must be planned by the query "
+                               "planner (table containment)")
+
+        if isinstance(e, A.AttributeFunction):
+            return _compile_function(e, comp, scope, functions)
+
+        raise CompileError(f"cannot compile expression {e!r}")
+
+    return comp(expr)
+
+
+def _require_bool(e: CompiledExpr, what: str):
+    if e.type is not AttrType.BOOL:
+        raise CompileError(
+            f"{what} requires BOOL operands, got {e.type} "
+            "(reference: AndConditionExpressionExecutor type check)")
+
+
+def _compile_math(e: A.MathOp, comp) -> CompiledExpr:
+    l, r = comp(e.left), comp(e.right)
+    _num(l, f"'{e.op}'"), _num(r, f"'{e.op}'")
+    t = promote(l.type, r.type)
+    dt = np_dtype(t)
+    op = e.op
+
+    def fn(env):
+        lc, rc = l.fn(env), r.fn(env)
+        lv = lc.values.astype(dt)
+        rv = rc.values.astype(dt)
+        nulls = lc.nulls | rc.nulls
+        if op == "+":
+            v = lv + rv
+        elif op == "-":
+            v = lv - rv
+        elif op == "*":
+            v = lv * rv
+        elif op == "/":
+            zero = rv == 0
+            nulls = nulls | zero
+            safe_r = jnp.where(zero, jnp.ones_like(rv), rv)
+            if t in (AttrType.INT, AttrType.LONG):
+                v = jax.lax.div(lv, safe_r)  # truncation toward zero (Java /)
+            else:
+                v = lv / safe_r
+        elif op == "%":
+            zero = rv == 0
+            nulls = nulls | zero
+            safe_r = jnp.where(zero, jnp.ones_like(rv), rv)
+            v = jax.lax.rem(lv, safe_r)  # sign of dividend (Java %)
+        else:
+            raise AssertionError(op)
+        v = jnp.where(nulls, jnp.zeros_like(v), v)
+        return Col(v, nulls)
+
+    return CompiledExpr(t, fn)
+
+
+def _compile_compare(e: A.Compare, comp) -> CompiledExpr:
+    l, r = comp(e.left), comp(e.right)
+    op = e.op
+    if l.type in NUMERIC_TYPES and r.type in NUMERIC_TYPES:
+        t = promote(l.type, r.type)
+        dt = np_dtype(t)
+
+        def fn(env):
+            lc, rc = l.fn(env), r.fn(env)
+            lv = lc.values.astype(dt)
+            rv = rc.values.astype(dt)
+            v = _cmp(op, lv, rv)
+            v = v & ~(lc.nulls | rc.nulls)  # null operand -> FALSE
+            return Col(v, jnp.zeros_like(v))
+        return CompiledExpr(AttrType.BOOL, fn)
+
+    if l.type == r.type and l.type in (AttrType.STRING, AttrType.BOOL):
+        if op not in ("==", "!=") and l.type is AttrType.STRING:
+            raise CompileError(
+                "ordering comparison on STRING is not supported on device")
+
+        def fn(env):
+            lc, rc = l.fn(env), r.fn(env)
+            v = _cmp(op, lc.values, rc.values)
+            v = v & ~(lc.nulls | rc.nulls)
+            return Col(v, jnp.zeros_like(v))
+        return CompiledExpr(AttrType.BOOL, fn)
+
+    raise CompileError(f"cannot compare {l.type} with {r.type}")
+
+
+def _cmp(op, lv, rv):
+    if op == "==":
+        return lv == rv
+    if op == "!=":
+        return lv != rv
+    if op == ">":
+        return lv > rv
+    if op == ">=":
+        return lv >= rv
+    if op == "<":
+        return lv < rv
+    if op == "<=":
+        return lv <= rv
+    raise AssertionError(op)
+
+
+# ---------------------------------------------------------------------------
+# built-in scalar functions
+# (reference: executor/function/*.java — cast, convert, coalesce, ifThenElse,
+#  instanceOf*, maximum, minimum, eventTimestamp, currentTimeMillis, default)
+# ---------------------------------------------------------------------------
+
+_CONVERT_TARGETS = {
+    "int": AttrType.INT, "long": AttrType.LONG, "float": AttrType.FLOAT,
+    "double": AttrType.DOUBLE, "bool": AttrType.BOOL, "string": AttrType.STRING,
+}
+
+
+def _compile_function(e: A.AttributeFunction, comp, scope, functions) -> CompiledExpr:
+    name = (f"{e.namespace}:{e.name}" if e.namespace else e.name)
+    key = name.lower()
+    params = [comp(p) for p in e.parameters]
+
+    if key in functions:
+        return functions[key](params)
+
+    if key in ("convert", "cast"):
+        if len(params) != 2:
+            raise CompileError(f"{name}() requires 2 arguments")
+        target_const = e.parameters[1]
+        if not isinstance(target_const, A.Constant):
+            raise CompileError(f"{name}() target type must be a constant")
+        tname = str(target_const.value).lower()
+        if tname not in _CONVERT_TARGETS:
+            raise CompileError(f"unknown {name}() target '{tname}'")
+        t = _CONVERT_TARGETS[tname]
+        src = params[0]
+        if t is AttrType.STRING or src.type is AttrType.STRING and t is not AttrType.STRING:
+            if not (src.type is AttrType.STRING and t is AttrType.STRING):
+                raise CompileError(
+                    f"{name}() to/from STRING is host-side only; not "
+                    "supported on the device path yet")
+        if t is AttrType.BOOL and src.type is not AttrType.BOOL:
+            raise CompileError(f"{name}() numeric->BOOL not supported")
+        dt = np_dtype(t)
+
+        def fn(env, src=src, dt=dt):
+            c = src.fn(env)
+            return Col(c.values.astype(dt), c.nulls)
+        return CompiledExpr(t, fn)
+
+    if key == "coalesce":
+        if not params:
+            raise CompileError("coalesce() requires arguments")
+        t = params[0].type
+        for p in params[1:]:
+            if p.type in NUMERIC_TYPES and t in NUMERIC_TYPES:
+                t = promote(t, p.type)
+            elif p.type != t:
+                raise CompileError("coalesce() arguments must share a type")
+        dt = np_dtype(t)
+
+        def fn(env):
+            cols = [p.fn(env) for p in params]
+            v = cols[0].values.astype(dt)
+            nulls = cols[0].nulls
+            for c in cols[1:]:
+                take = nulls & ~c.nulls
+                v = jnp.where(take, c.values.astype(dt), v)
+                nulls = nulls & c.nulls
+            return Col(v, nulls)
+        return CompiledExpr(t, fn)
+
+    if key == "ifthenelse":
+        if len(params) != 3:
+            raise CompileError("ifThenElse() requires 3 arguments")
+        cond, a, b = params
+        _require_bool(cond, "ifThenElse condition")
+        if a.type in NUMERIC_TYPES and b.type in NUMERIC_TYPES:
+            t = promote(a.type, b.type)
+        elif a.type == b.type:
+            t = a.type
+        else:
+            raise CompileError("ifThenElse() branches must share a type")
+        dt = np_dtype(t)
+
+        def fn(env):
+            cc, ca, cb = cond.fn(env), a.fn(env), b.fn(env)
+            take_a = cc.values & ~cc.nulls
+            v = jnp.where(take_a, ca.values.astype(dt), cb.values.astype(dt))
+            nulls = jnp.where(take_a, ca.nulls, cb.nulls)
+            return Col(v, nulls)
+        return CompiledExpr(t, fn)
+
+    if key in ("maximum", "minimum"):
+        if not params:
+            raise CompileError(f"{name}() requires arguments")
+        t = params[0].type
+        for p in params:
+            _num(p, name)
+            t = promote(t, p.type)
+        dt = np_dtype(t)
+        is_max = key == "maximum"
+
+        def fn(env):
+            cols = [p.fn(env) for p in params]
+            v, nulls = cols[0].values.astype(dt), cols[0].nulls
+            for c in cols[1:]:
+                cv = c.values.astype(dt)
+                pick = (_cmp(">" if is_max else "<", cv, v) & ~c.nulls) | nulls
+                v = jnp.where(pick & ~c.nulls, cv, v)
+                nulls = nulls & c.nulls
+            v = jnp.where(nulls, jnp.zeros_like(v), v)
+            return Col(v, nulls)
+        return CompiledExpr(t, fn)
+
+    if key == "eventtimestamp":
+        def fn(env):
+            return env["__ts__"]
+        return CompiledExpr(AttrType.LONG, fn)
+
+    if key == "currenttimemillis":
+        def fn(env):
+            now = env["__now__"]
+            return Col(now, jnp.zeros((), dtype=jnp.bool_))
+        return CompiledExpr(AttrType.LONG, fn)
+
+    if key.startswith("instanceof"):
+        target = {"instanceofinteger": AttrType.INT,
+                  "instanceoflong": AttrType.LONG,
+                  "instanceoffloat": AttrType.FLOAT,
+                  "instanceofdouble": AttrType.DOUBLE,
+                  "instanceofboolean": AttrType.BOOL,
+                  "instanceofstring": AttrType.STRING}.get(key)
+        if target is None:
+            raise CompileError(f"unknown function '{name}'")
+        if len(params) != 1:
+            raise CompileError(f"{name}() requires 1 argument")
+        src = params[0]
+        match = src.type is target
+
+        def fn(env, src=src, match=match):
+            c = src.fn(env)
+            # statically-typed columns: instanceOf is type match AND non-null
+            v = jnp.where(c.nulls, False, match)
+            return Col(v, jnp.zeros_like(c.nulls))
+        return CompiledExpr(AttrType.BOOL, fn)
+
+    if key == "default":
+        if len(params) != 2:
+            raise CompileError("default() requires 2 arguments")
+        src, dflt = params
+        if src.type == dflt.type:
+            t = src.type
+        elif src.type in NUMERIC_TYPES and dflt.type in NUMERIC_TYPES:
+            t = promote(src.type, dflt.type)
+        else:
+            raise CompileError(
+                f"default() arguments must share a type, got {src.type} "
+                f"and {dflt.type}")
+        dt = np_dtype(t)
+
+        def fn(env):
+            c, d = src.fn(env), dflt.fn(env)
+            v = jnp.where(c.nulls, d.values.astype(dt), c.values.astype(dt))
+            return Col(v, c.nulls & d.nulls)
+        return CompiledExpr(t, fn)
+
+    if key.startswith("math:"):
+        return _compile_math_ns(key[5:], name, params)
+
+    raise CompileError(f"unknown function '{name}'")
+
+
+_MATH_UNARY = {
+    "abs": jnp.abs, "ceil": jnp.ceil, "floor": jnp.floor, "sqrt": jnp.sqrt,
+    "exp": jnp.exp, "ln": jnp.log, "log10": jnp.log10, "sin": jnp.sin,
+    "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin, "acos": jnp.arccos,
+    "atan": jnp.arctan, "signum": jnp.sign, "round": jnp.round,
+}
+
+
+def _compile_math_ns(fn_name: str, display: str, params) -> CompiledExpr:
+    if fn_name in _MATH_UNARY and len(params) == 1:
+        src = params[0]
+        _num(src, display)
+        out_t = AttrType.DOUBLE if fn_name != "abs" else src.type
+        jfn = _MATH_UNARY[fn_name]
+        dt = np_dtype(out_t)
+
+        def fn(env):
+            c = src.fn(env)
+            v = jfn(c.values.astype(dt) if out_t is AttrType.DOUBLE else c.values)
+            v = jnp.where(c.nulls, jnp.zeros_like(v), v)
+            return Col(v.astype(dt), c.nulls)
+        return CompiledExpr(out_t, fn)
+    if fn_name == "power" and len(params) == 2:
+        a, b = params
+        _num(a, display), _num(b, display)
+
+        def fn(env):
+            ca, cb = a.fn(env), b.fn(env)
+            v = jnp.power(ca.values.astype(jnp.float64),
+                          cb.values.astype(jnp.float64))
+            nulls = ca.nulls | cb.nulls
+            return Col(jnp.where(nulls, 0.0, v), nulls)
+        return CompiledExpr(AttrType.DOUBLE, fn)
+    raise CompileError(f"unknown function '{display}'")

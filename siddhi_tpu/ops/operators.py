@@ -1,0 +1,51 @@
+"""Operator protocol: pure (state, batch) -> (state', batch) step functions.
+
+The TPU-native counterpart of the reference's Processor chain
+(query/processor/Processor.java:30 — process(chunk) mutating linked lists).
+Every operator is functional and jittable; an operator chain composes into a
+single XLA program per query.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..core.event import CURRENT, TIMER, EventBatch
+from .expr import CompiledExpr, env_from_batch
+
+
+class Operator:
+    """Stateless by default. State must be a pytree of device arrays."""
+
+    def init_state(self) -> Any:
+        return ()
+
+    def step(self, state, batch: EventBatch, now):
+        raise NotImplementedError
+
+    @property
+    def out_schema(self):
+        raise NotImplementedError
+
+
+class FilterOp(Operator):
+    """Drop events whose condition is not TRUE
+    (reference: query/processor/filter/FilterProcessor.java:32).
+    TIMER events pass through untouched so downstream scheduling operators
+    still observe time."""
+
+    def __init__(self, cond: CompiledExpr, schema):
+        self.cond = cond
+        self.schema = schema
+
+    def step(self, state, batch: EventBatch, now):
+        env = env_from_batch(batch)
+        env["__now__"] = now
+        c = self.cond.fn(env)
+        keep = (c.values & ~c.nulls) | (batch.kind == TIMER)
+        return state, batch.mask(keep)
+
+    @property
+    def out_schema(self):
+        return self.schema

@@ -1,0 +1,111 @@
+"""Query selector: projection, and (in later stages) group-by aggregation,
+having, order-by, limit/offset.
+
+Reference: query/selector/QuerySelector.java:44 with AttributeProcessor per
+output attribute. Here the whole select clause is one vectorized operator.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.event import Attribute, EventBatch, StreamSchema
+from ..core.types import AttrType
+from ..lang import ast as A
+from .expr import CompileError, CompiledExpr, Scope, compile_expression, env_from_batch
+from .operators import Operator
+
+# aggregator function names recognized in select clauses
+AGGREGATOR_NAMES = {
+    "sum", "avg", "count", "distinctcount", "min", "max", "minforever",
+    "maxforever", "stddev", "and", "or", "unionset",
+}
+
+
+def has_aggregators(expr: A.Expression) -> bool:
+    if isinstance(expr, A.AttributeFunction):
+        if expr.namespace is None and expr.name.lower() in AGGREGATOR_NAMES:
+            return True
+        return any(has_aggregators(p) for p in expr.parameters)
+    if isinstance(expr, A.MathOp) or isinstance(expr, A.Compare):
+        return has_aggregators(expr.left) or has_aggregators(expr.right)
+    if isinstance(expr, (A.And, A.Or)):
+        return has_aggregators(expr.left) or has_aggregators(expr.right)
+    if isinstance(expr, A.Not):
+        return has_aggregators(expr.expr)
+    if isinstance(expr, A.IsNull) and expr.expr is not None:
+        return has_aggregators(expr.expr)
+    return False
+
+
+def output_attribute_name(oa: A.OutputAttribute, i: int) -> str:
+    if oa.rename:
+        return oa.rename
+    if isinstance(oa.expression, A.Variable):
+        return oa.expression.attribute
+    return f"_{i}"
+
+
+class ProjectOp(Operator):
+    """Stateless projection (select clause without aggregators)."""
+
+    def __init__(self, selector: A.Selector, in_schema: StreamSchema,
+                 out_stream_id: str, scope: Scope, functions=None):
+        self.in_schema = in_schema
+        if selector.select_all:
+            self._passthrough = True
+            self._schema = StreamSchema(out_stream_id, in_schema.attributes)
+            self.compiled: list[CompiledExpr] = []
+        else:
+            self._passthrough = False
+            self.compiled = [
+                compile_expression(oa.expression, scope, functions)
+                for oa in selector.attributes
+            ]
+            attrs = tuple(
+                Attribute(output_attribute_name(oa, i), ce.type)
+                for i, (oa, ce) in enumerate(zip(selector.attributes,
+                                                 self.compiled)))
+            self._schema = StreamSchema(out_stream_id, attrs)
+        self.having = None
+        if selector.having is not None:
+            self.having = compile_expression(selector.having,
+                                             OutputScope(self._schema),
+                                             functions)
+
+    def step(self, state, batch: EventBatch, now):
+        if self._passthrough:
+            out = batch
+        else:
+            env = env_from_batch(batch)
+            env["__now__"] = now
+            cols, nulls = [], []
+            for ce in self.compiled:
+                c = ce.fn(env)
+                vals = jnp.broadcast_to(c.values, batch.ts.shape)
+                nls = jnp.broadcast_to(c.nulls, batch.ts.shape)
+                cols.append(vals)
+                nulls.append(nls)
+            out = EventBatch(ts=batch.ts, cols=tuple(cols), nulls=tuple(nulls),
+                             kind=batch.kind, valid=batch.valid)
+        if self.having is not None:
+            henv = env_from_batch(out)
+            henv["__now__"] = now
+            hc = self.having.fn(henv)
+            out = out.mask(hc.values & ~hc.nulls)
+        return state, out
+
+    @property
+    def out_schema(self):
+        return self._schema
+
+
+class OutputScope(Scope):
+    """Scope over the selector's own output attributes (used by HAVING,
+    reference: SelectorParser having over output meta)."""
+
+    def __init__(self, schema: StreamSchema):
+        self.schema = schema
+
+    def resolve(self, var: A.Variable):
+        idx = self.schema.index_of(var.attribute)
+        return ("attr", idx), self.schema.types[idx]

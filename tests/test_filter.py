@@ -1,0 +1,218 @@
+"""End-to-end filter/projection queries through the public API.
+
+Modeled on the reference's FilterTestCase idiom
+(modules/siddhi-core/src/test/.../query/FilterTestCase1.java): SiddhiQL text
+-> runtime -> callback -> send -> assert.
+"""
+import pytest
+
+from siddhi_tpu import Event, QueryCallback, SiddhiManager, StreamCallback
+
+
+def collect(events_sink):
+    return StreamCallback(fn=lambda evs: events_sink.extend(evs))
+
+
+def test_simple_filter():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name = 'query1')
+        from StockStream[price > 100.0]
+        select symbol, price
+        insert into OutputStream;
+    """)
+    got = []
+    rt.add_callback("OutputStream", collect(got))
+    rt.start()
+    h = rt.get_input_handler("StockStream")
+    h.send(("IBM", 120.0, 100))
+    h.send(("WSO2", 50.0, 200))
+    h.send(("GOOG", 250.5, 10))
+    rt.shutdown()
+    assert [e.data for e in got] == [("IBM", 120.0), ("GOOG", 250.5)]
+
+
+def test_filter_arithmetic_and_projection():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (symbol string, price double, volume long);
+        from S[price * 0.9 > 100.0 and volume >= 10]
+        select symbol, price * volume as value, volume
+        insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([("A", 200.0, 20), ("B", 100.0, 5), ("C", 150.0, 9),
+            ("D", 112.0, 10)])
+    assert [e.data for e in got] == [("A", 4000.0, 20), ("D", 1120.0, 10)]
+
+
+def test_query_callback():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (a int);
+        @info(name = 'q')
+        from S[a > 0] select a insert into Out;
+    """)
+    received = []
+    rt.add_callback("q", QueryCallback(
+        fn=lambda ts, ins, removes: received.append((ins, removes))))
+    rt.start()
+    rt.get_input_handler("S").send((5,))
+    rt.get_input_handler("S").send((-1,))
+    assert len(received) == 1
+    ins, removes = received[0]
+    assert [e.data for e in ins] == [(5,)]
+    assert removes is None
+
+
+def test_chained_queries():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (a int);
+        from S[a > 0] select a, a * 2 as b insert into Mid;
+        from Mid[b > 10] select b insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([(1,), (4,), (6,), (-2,), (10,)])
+    assert [e.data for e in got] == [(12,), (20,)]
+
+
+def test_int_division_truncates_toward_zero():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (a int, b int);
+        from S select a / b as q, a % b as r insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    rt.get_input_handler("S").send([(7, 2), (-7, 2), (7, -2)])
+    assert [e.data for e in got] == [(3, 1), (-3, -1), (-3, 1)]
+
+
+def test_division_by_zero_yields_null():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (a int, b double);
+        from S select a / 0 as q, b / 0.0 as d insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    rt.get_input_handler("S").send((10, 5.0))
+    assert got[0].data == (None, None)
+
+
+def test_null_compare_is_false_and_isnull():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (a int, s string);
+        from S[a > 5 or s is null] select a, s insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([(None, "x"), (10, None), (3, "y")])
+    # (None,'x'): a>5 false (null), s not null -> dropped
+    # (10,None): a>5 true -> kept; (3,'y') dropped
+    assert [e.data for e in got] == [(10, None)]
+
+
+def test_type_promotion():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (i int, l long, f float, d double);
+        from S select i + l as il, i + f as if_, l * d as ld, i / 2 as half
+        insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    rt.get_input_handler("S").send((3, 10, 1.5, 2.0))
+    il, if_, ld, half = got[0].data
+    assert il == 13 and isinstance(il, int)
+    assert abs(if_ - 4.5) < 1e-6
+    assert ld == 20.0
+    assert half == 1  # int division
+
+
+def test_functions():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (a int, b int);
+        from S select coalesce(a, b) as c,
+                      ifThenElse(a > b, a, b) as mx,
+                      maximum(a, b) as mx2,
+                      minimum(a, b) as mn,
+                      convert(a, 'double') as ad
+        insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    rt.get_input_handler("S").send([(5, 3), (None, 7)])
+    assert got[0].data == (5, 5, 5, 3, 5.0)
+    assert got[1].data == (7, 7, 7, 7, None)
+
+
+def test_select_star():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (a int, b string);
+        from S[a != 0] select * insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    rt.get_input_handler("S").send([(1, "x"), (0, "y")])
+    assert [e.data for e in got] == [(1, "x")]
+
+
+def test_string_equality_and_bool():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (sym string, ok bool);
+        from S[sym == 'IBM' and ok == true] select sym insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    rt.get_input_handler("S").send([("IBM", True), ("IBM", False),
+                                    ("X", True)])
+    assert [e.data for e in got] == [("IBM",)]
+
+
+def test_send_event_objects():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream S (a int);
+        from S select a, eventTimestamp() as ts insert into Out;
+    """)
+    got = []
+    rt.add_callback("Out", collect(got))
+    rt.start()
+    rt.get_input_handler("S").send(Event(timestamp=12345, data=(9,)))
+    assert got[0].data == (9, 12345)
+
+
+def test_undefined_stream_raises():
+    mgr = SiddhiManager()
+    with pytest.raises(Exception, match="undefined stream"):
+        mgr.create_siddhi_app_runtime(
+            "define stream S (a int); from Nope select a insert into O;")
+
+
+def test_send_before_start_raises():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(
+        "define stream S (a int); from S select a insert into O;")
+    with pytest.raises(RuntimeError, match="not running"):
+        rt.get_input_handler("S").send((1,))
