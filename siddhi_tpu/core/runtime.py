@@ -6,10 +6,12 @@ Reference mapping:
 - QueryRuntimeImpl (query/QueryRuntimeImpl.java:43)        -> QueryRuntime
 - SiddhiAppParser/QueryParser/SingleInputStreamParser
   (util/parser/*.java)                                     -> Planner
+- Scheduler timer events (util/Scheduler.java:113)         -> TIMER batches
+  injected by core/scheduler.py when a window's next_due passes.
 
 Execution model: each query compiles to ONE jitted step function
-(state, batch, now) -> (state', out_batch). The host junction layer feeds
-micro-batches in; batch capacity is bucketed so jit caches stay warm.
+(state, batch, now) -> (state', out_batch, next_due). The host junction layer
+feeds micro-batches in; batch capacity is bucketed so jit caches stay warm.
 """
 from __future__ import annotations
 
@@ -23,15 +25,27 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..lang import ast as A
+from ..ops.aggregators import AggregateOp
 from ..ops.expr import CompileError, SingleStreamScope, compile_expression
 from ..ops.operators import FilterOp, Operator
-from ..ops.selector import ProjectOp, has_aggregators
+from ..ops.selector import ProjectOp, selector_needs_aggregation
+from ..ops.windows import (POS_INF, LengthBatchWindowOp, LengthWindowOp,
+                           TimeBatchWindowOp, TimeWindowOp, WindowOp)
 from .event import (CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema,
                     batch_from_rows, rows_from_batch)
+from .scheduler import Scheduler
 from .stream import (Event, InputHandler, QueryCallback, Receiver,
                      StreamCallback, StreamJunction)
+from .types import AttrType
 
 BATCH_BUCKETS = (16, 128, 1024, 8192, 65536)
+
+WINDOW_CLASSES = {
+    "time": TimeWindowOp,
+    "length": LengthWindowOp,
+    "lengthbatch": LengthBatchWindowOp,
+    "timebatch": TimeBatchWindowOp,
+}
 
 
 def bucket_capacity(n: int) -> int:
@@ -70,6 +84,8 @@ class QueryCallbackHandler(OutputHandler):
                      if kind == CURRENT]
         rm_events = [Event(ts, vals, is_expired=True)
                      for ts, kind, vals in rows if kind == EXPIRED]
+        if not in_events and not rm_events:
+            return
         for cb in self.callbacks:
             cb.receive(timestamp, in_events or None, rm_events or None)
 
@@ -78,44 +94,50 @@ class QueryRuntime(Receiver):
     """One query: an operator chain jitted into a single device step."""
 
     def __init__(self, name: str, operators: list[Operator],
-                 in_schema: StreamSchema, app: "SiddhiAppRuntime",
-                 current_on: bool, expired_on: bool):
+                 in_schema: StreamSchema, app: "SiddhiAppRuntime"):
         self.name = name
         self.operators = operators
         self.in_schema = in_schema
         self.out_schema = operators[-1].out_schema
         self.app = app
-        self.current_on = current_on
-        self.expired_on = expired_on
         self.output_handlers: list[OutputHandler] = []
         self.callback_handler = QueryCallbackHandler()
         self.states = tuple(op.init_state() for op in operators)
-        self._step_fns: dict[int, Callable] = {}
+        self._step: Optional[Callable] = None
         self._lock = threading.Lock()
+        self._has_timers = any(
+            isinstance(op, WindowOp) and op.next_due(op.init_state())
+            is not None for op in operators)
+        self._sched_due: Optional[int] = None
 
     # -- compile ---------------------------------------------------------
     def _make_step(self):
         ops = self.operators
-        current_on, expired_on = self.current_on, self.expired_on
+        has_timers = self._has_timers
 
         def step(states, batch: EventBatch, now):
             new_states = []
             for op, st in zip(ops, states):
                 st, batch = op.step(st, batch, now)
                 new_states.append(st)
-            keep = ((batch.kind == CURRENT) & current_on) | (
-                (batch.kind == EXPIRED) & expired_on)
-            batch = batch.mask(keep)
-            return tuple(new_states), batch
+            if has_timers:
+                dues = [op.next_due(st) for op, st in zip(ops, new_states)
+                        if isinstance(op, WindowOp)]
+                dues = [d for d in dues if d is not None]
+                due = dues[0]
+                for d in dues[1:]:
+                    due = jnp.minimum(due, d)
+            else:
+                due = jnp.int64(2 ** 62)
+            return tuple(new_states), batch, due
 
         return jax.jit(step)
 
     def _step_for(self, capacity: int) -> Callable:
-        fn = self._step_fns.get(capacity)
-        if fn is None:
-            fn = self._make_step()
-            self._step_fns[capacity] = fn
-        return fn
+        # one jit wrapper; XLA specializes per batch-capacity shape
+        if self._step is None:
+            self._step = self._make_step()
+        return self._step
 
     # -- runtime ---------------------------------------------------------
     def receive(self, events: list[Event]) -> None:
@@ -129,17 +151,51 @@ class QueryRuntime(Receiver):
             batch = batch_from_rows(self.in_schema, rows, tss, cap, kinds)
             self.process_batch(batch, chunk[-1].timestamp)
 
-    def process_batch(self, batch: EventBatch, timestamp: int) -> None:
-        now = jnp.asarray(self.app.current_time(), dtype=jnp.int64)
+    def process_batch(self, batch: EventBatch, timestamp: int,
+                      now: Optional[int] = None) -> None:
+        if now is None:
+            now = self.app.current_time()
+        now_dev = jnp.asarray(now, dtype=jnp.int64)
         with self._lock:
             step = self._step_for(batch.capacity)
-            self.states, out = step(self.states, batch, now)
-        out_rows = rows_from_batch(self.out_schema.types, out)
+            self.states, out, due = step(self.states, batch, now_dev)
+        out_host, due_host = jax.device_get((out, due))
+        if self._has_timers:
+            self._schedule(int(due_host))
+        out_rows = rows_from_batch(self.out_schema.types, out_host)
         if not out_rows:
             return
         for h in self.output_handlers:
             h.handle(timestamp, out_rows)
         self.callback_handler.handle(timestamp, out_rows)
+
+    # -- timers ----------------------------------------------------------
+    def _schedule(self, due: int) -> None:
+        if due >= int(POS_INF):
+            return
+        if self._sched_due is not None and self._sched_due <= due:
+            return
+        self._sched_due = due
+        self.app.scheduler.notify_at(due, self._on_timer)
+
+    def _on_timer(self, due: int) -> None:
+        self._sched_due = None
+        if not self.app.running:
+            return
+        cap = BATCH_BUCKETS[0]
+        batch = batch_from_rows(self.in_schema, [], [], cap)
+        # one TIMER row carrying the due timestamp
+        from .event import TIMER
+        ts = np.zeros((cap,), dtype=np.int64)
+        ts[0] = due
+        kind = np.zeros((cap,), dtype=np.int32)
+        kind[0] = TIMER
+        valid = np.zeros((cap,), dtype=np.bool_)
+        valid[0] = True
+        batch = EventBatch(ts=ts, cols=batch.cols, nulls=batch.nulls,
+                           kind=kind, valid=valid)
+        now = max(due, self.app.current_time())
+        self.process_batch(batch, due, now=now)
 
 
 class StreamCallbackReceiver(Receiver):
@@ -166,7 +222,9 @@ class SiddhiAppRuntime:
         self.running = False
         self._playback = False
         self._playback_time: Optional[int] = None
+        self.scheduler = Scheduler(playback=False)
         Planner(self).plan()
+        self.scheduler.playback = self._playback
 
     # -- time ------------------------------------------------------------
     def current_time(self) -> int:
@@ -177,6 +235,7 @@ class SiddhiAppRuntime:
     def on_ingest(self, stream_id: str, events: list[Event]) -> None:
         if self._playback and events:
             self._playback_time = events[-1].timestamp
+            self.scheduler.advance_to(self._playback_time)
 
     # -- wiring ----------------------------------------------------------
     def junction_for(self, stream_id: str,
@@ -218,14 +277,20 @@ class SiddhiAppRuntime:
 
     def start(self) -> None:
         self.running = True
+        self.scheduler.start()
 
     def shutdown(self) -> None:
         self.running = False
+        self.scheduler.shutdown()
+        for q in self.queries.values():
+            q._sched_due = None
 
 
 class Planner:
     """AST -> runtime graph (= SiddhiAppParser + QueryParser +
     SingleInputStreamParser + SelectorParser + OutputParser)."""
+
+    DEFAULT_TIME_CAP = 4096
 
     def __init__(self, app: SiddhiAppRuntime):
         self.app = app
@@ -252,6 +317,52 @@ class Planner:
             elif isinstance(el, A.Partition):
                 raise CompileError("partitions are planned in a later stage")
 
+    # -- windows ---------------------------------------------------------
+    def window_class(self, h: A.WindowHandler):
+        name = h.name if h.namespace is None else f"{h.namespace}:{h.name}"
+        cls = WINDOW_CLASSES.get(name.lower())
+        if cls is None:
+            raise CompileError(f"window '{name}' not yet supported")
+        return cls
+
+    def make_window(self, h: A.WindowHandler, schema: StreamSchema,
+                    expired_enabled: bool) -> WindowOp:
+        name = h.name if h.namespace is None else f"{h.namespace}:{h.name}"
+        params = []
+        for p in h.parameters:
+            if isinstance(p, A.Constant):
+                params.append(p.value)
+            else:
+                raise CompileError(
+                    f"window '{name}' parameters must be constants")
+        key = name.lower()
+        if key == "time":
+            _expect(params, 1, name)
+            return TimeWindowOp(schema, _ms(params[0], name),
+                                cap=self.DEFAULT_TIME_CAP,
+                                expired_enabled=expired_enabled)
+        if key == "length":
+            _expect(params, 1, name)
+            return LengthWindowOp(schema, int(params[0]),
+                                  expired_enabled=expired_enabled)
+        if key == "lengthbatch":
+            if len(params) not in (1, 2):
+                raise CompileError(f"{name} takes 1-2 parameters")
+            if len(params) == 2 and bool(params[1]):
+                raise CompileError(
+                    "lengthBatch stream.current.event mode not yet supported")
+            return LengthBatchWindowOp(schema, int(params[0]),
+                                       expired_enabled=expired_enabled)
+        if key == "timebatch":
+            if len(params) not in (1, 2):
+                raise CompileError(f"{name} takes 1-2 parameters")
+            start = int(params[1]) if len(params) == 2 else None
+            return TimeBatchWindowOp(schema, _ms(params[0], name),
+                                     start_time=start,
+                                     cap=self.DEFAULT_TIME_CAP,
+                                     expired_enabled=expired_enabled)
+        raise CompileError(f"window '{name}' not yet supported")
+
     def plan_query(self, q: A.Query, default_name: str) -> None:
         app = self.app
         name = q.name or default_name
@@ -265,25 +376,7 @@ class Planner:
             raise CompileError(f"query '{name}': undefined stream "
                                f"'{sin.stream_id}'")
         scope = SingleStreamScope(schema, aliases=(sin.alias,))
-        operators: list[Operator] = []
-        for h in sin.handlers:
-            if isinstance(h, A.Filter):
-                cond = compile_expression(h.expression, scope)
-                if cond.type.name != "BOOL":
-                    raise CompileError(
-                        f"query '{name}': filter must be BOOL")
-                operators.append(FilterOp(cond, schema))
-            elif isinstance(h, A.WindowHandler):
-                raise CompileError(
-                    f"query '{name}': window '{h.name}' not yet supported")
-            else:
-                raise CompileError(
-                    f"query '{name}': stream function "
-                    f"'{h.name}' not yet supported")
-        # selector
-        if any(has_aggregators(oa.expression) for oa in q.selector.attributes):
-            raise CompileError(
-                f"query '{name}': aggregators not yet supported")
+
         out = q.output
         if isinstance(out, (A.InsertIntoStream, A.ReturnStream)):
             out_type = out.output_event_type
@@ -291,13 +384,56 @@ class Planner:
             raise CompileError(f"query '{name}': table output not yet "
                                "supported")
         target = out.target if isinstance(out, A.InsertIntoStream) else name
-        operators.append(ProjectOp(q.selector, schema, target, scope))
         current_on = out_type in ("current", "all")
         expired_on = out_type in ("expired", "all")
+        needs_agg = selector_needs_aggregation(q.selector)
+
+        operators: list[Operator] = []
+        window_op: Optional[WindowOp] = None
+        for h in sin.handlers:
+            if isinstance(h, A.Filter):
+                if window_op is not None:
+                    raise CompileError(
+                        f"query '{name}': filter after window not yet "
+                        "supported")
+                cond = compile_expression(h.expression, scope)
+                if cond.type is not AttrType.BOOL:
+                    raise CompileError(f"query '{name}': filter must be BOOL")
+                operators.append(FilterOp(cond, schema))
+            elif isinstance(h, A.WindowHandler):
+                if window_op is not None:
+                    raise CompileError(
+                        f"query '{name}': multiple windows on one stream")
+                cls = self.window_class(h)
+                # sliding windows must feed EXPIRED events to aggregating
+                # selectors (subtract-on-expire); batch windows only emit
+                # expired when the output asks for them
+                # (outputExpectsExpiredEvents in the reference)
+                expired_enabled = expired_on if cls.is_batch \
+                    else (expired_on or needs_agg)
+                window_op = self.make_window(h, schema, expired_enabled)
+                operators.append(window_op)
+            else:
+                raise CompileError(
+                    f"query '{name}': stream function "
+                    f"'{h.name}' not yet supported")
+
+        batch_mode = window_op is not None and window_op.is_batch
+        expired_possible = window_op is not None and window_op.expired_enabled
+
+        if needs_agg:
+            operators.append(AggregateOp(
+                q.selector, schema, target, scope,
+                batch_mode=batch_mode, expired_possible=expired_possible,
+                current_on=current_on, expired_on=expired_on))
+        else:
+            operators.append(ProjectOp(
+                q.selector, schema, target, scope,
+                current_on=current_on, expired_on=expired_on))
+
         if name in app.queries:
             raise CompileError(f"duplicate query name '{name}'")
-        qr = QueryRuntime(name, operators, schema, app,
-                          current_on, expired_on)
+        qr = QueryRuntime(name, operators, schema, app)
         app.junctions[sin.stream_id].subscribe(qr)
         app.queries[name] = qr
         if isinstance(out, A.InsertIntoStream):
@@ -307,3 +443,16 @@ class Planner:
                                                               app)
             qr.output_handlers.append(
                 InsertIntoStreamHandler(tj, out_type))
+
+
+def _expect(params, n, name):
+    if len(params) != n:
+        raise CompileError(f"window '{name}' takes {n} parameter(s), got "
+                           f"{len(params)}")
+
+
+def _ms(v, name) -> int:
+    if not isinstance(v, int):
+        raise CompileError(f"window '{name}' duration must be int/time, got "
+                           f"{v!r}")
+    return int(v)

@@ -1,0 +1,633 @@
+"""Attribute aggregators + the aggregating selector operator.
+
+Reference mapping:
+- query/selector/attribute/aggregator/*.java (sum, avg, count, min, max,
+  minForever, maxForever, stdDev, and, or, distinctCount) — per-event state
+  machines with processAdd / processRemove / reset driven by event type
+  (AttributeAggregatorExecutor.java:95-150).
+- query/selector/QuerySelector.java:44 — processNoGroupBy / processGroupBy
+  (per-event emission) and processInBatchNoGroupBy / processInBatchGroupBy
+  (batch windows: only the last event / last event per group is emitted).
+- RESET clears ALL group states (AttributeAggregatorExecutor.processReset ->
+  StateHolder.cleanGroupByStates, PartitionStateHolder.java:95).
+
+TPU design: an aggregator is a set of LANES, each an accumulator with an
+associative combine (sum / min / max). A batch is processed as:
+
+  1. per-row signed lane contributions (CURRENT adds, EXPIRED removes for
+     sum lanes; null contributes identity),
+  2. rows sorted by (group slot, reset segment), where the reset segment id
+     is the count of RESET rows at-or-before the row (RESET is global),
+  3. segmented prefix scan per lane + carry-in from persistent [K] state,
+  4. unsort -> per-row running aggregate values (exactly the per-event
+     values the reference's tree-walk produces), project, gate, emit.
+
+min/max over content that can EXPIRE (sliding windows) needs a value
+multiset per key (the reference keeps a Deque); that path is a bounded
+per-slot value buffer updated with a lax.scan — not yet implemented; the
+planner rejects it explicitly.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.event import (CURRENT, EXPIRED, RESET, Attribute, EventBatch,
+                          StreamSchema)
+from ..core.types import AttrType, NUMERIC_TYPES, np_dtype, promote
+from ..lang import ast as A
+from .expr import (Col, CompileError, CompiledExpr, Scope, compile_expression,
+                   env_from_batch)
+from .keyed import (hash_columns, lookup_or_insert, segmented_cummax,
+                    segmented_cummin, segmented_cumsum)
+from .operators import Operator
+from .selector import (AGGREGATOR_NAMES, compile_order_by, const_int,
+                       output_attribute_name, shape_output)
+
+I64_MIN = jnp.int64(-(2 ** 62))
+I64_MAX = jnp.int64(2 ** 62)
+
+
+# ---------------------------------------------------------------------------
+# lane + aggregator specs
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Lane:
+    op: str            # 'sum' | 'min' | 'max'
+    dtype: object      # numpy dtype for the accumulator
+
+    def identity(self):
+        if self.op == "sum":
+            return jnp.zeros((), dtype=self.dtype)
+        if jnp.issubdtype(jnp.dtype(self.dtype), jnp.floating):
+            return jnp.asarray(jnp.inf if self.op == "min" else -jnp.inf,
+                               dtype=self.dtype)
+        info = jnp.iinfo(jnp.dtype(self.dtype))
+        return jnp.asarray(info.max if self.op == "min" else info.min,
+                           dtype=self.dtype)
+
+    def combine(self, a, b):
+        if self.op == "sum":
+            return a + b
+        return jnp.minimum(a, b) if self.op == "min" else jnp.maximum(a, b)
+
+    def segmented_scan(self, vals, seg_ids):
+        if self.op == "sum":
+            return segmented_cumsum(vals, seg_ids)
+        if self.op == "min":
+            return segmented_cummin(vals, seg_ids)
+        return segmented_cummax(vals, seg_ids)
+
+
+class AggSpec:
+    """One aggregator call instance inside a select clause."""
+
+    name: str
+    out_type: AttrType
+    lanes: tuple
+
+    def contribs(self, arg: Optional[Col], is_add, is_remove):
+        """Per-lane [B] contribution arrays (identity where no effect)."""
+        raise NotImplementedError
+
+    def value(self, lane_vals) -> Col:
+        """Aggregate value from running lane values."""
+        raise NotImplementedError
+
+
+def _signed(x, is_add, is_remove, dtype):
+    x = x.astype(dtype)
+    return jnp.where(is_add, x, jnp.where(is_remove, -x, jnp.zeros_like(x)))
+
+
+class SumAgg(AggSpec):
+    """sum(): (sum, count) per key; null when count==0
+    (SumAttributeAggregatorExecutor.AggregatorStateDouble:183-227)."""
+
+    def __init__(self, arg_type: AttrType):
+        if arg_type not in NUMERIC_TYPES:
+            raise CompileError(f"sum() requires numeric input, got {arg_type}")
+        self.name = "sum"
+        self.out_type = (AttrType.LONG if arg_type in (AttrType.INT,
+                                                       AttrType.LONG)
+                         else AttrType.DOUBLE)
+        self.acc_dtype = np_dtype(self.out_type)
+        self.lanes = (Lane("sum", self.acc_dtype), Lane("sum", jnp.int64))
+
+    def contribs(self, arg, is_add, is_remove):
+        eff = (is_add | is_remove) & ~arg.nulls
+        x = jnp.where(eff, arg.values.astype(self.acc_dtype), 0)
+        one = jnp.where(eff, jnp.int64(1), jnp.int64(0))
+        return (_signed(x, is_add, is_remove, self.acc_dtype) * eff,
+                _signed(one, is_add, is_remove, jnp.int64))
+
+    def value(self, lane_vals):
+        s, cnt = lane_vals
+        return Col(jnp.where(cnt == 0, jnp.zeros_like(s), s), cnt == 0)
+
+
+class AvgAgg(AggSpec):
+    """avg(): sum/count as DOUBLE; null when count==0."""
+
+    def __init__(self, arg_type: AttrType):
+        if arg_type not in NUMERIC_TYPES:
+            raise CompileError(f"avg() requires numeric input, got {arg_type}")
+        self.name = "avg"
+        self.out_type = AttrType.DOUBLE
+        self.lanes = (Lane("sum", jnp.float64), Lane("sum", jnp.int64))
+
+    def contribs(self, arg, is_add, is_remove):
+        eff = (is_add | is_remove) & ~arg.nulls
+        x = jnp.where(eff, arg.values.astype(jnp.float64), 0.0)
+        one = jnp.where(eff, jnp.int64(1), jnp.int64(0))
+        return (_signed(x, is_add, is_remove, jnp.float64),
+                _signed(one, is_add, is_remove, jnp.int64))
+
+    def value(self, lane_vals):
+        s, cnt = lane_vals
+        safe = jnp.maximum(cnt, 1)
+        return Col(jnp.where(cnt == 0, 0.0, s / safe), cnt == 0)
+
+
+class CountAgg(AggSpec):
+    """count(): event count, LONG, never null
+    (CountAttributeAggregatorExecutor)."""
+
+    def __init__(self):
+        self.name = "count"
+        self.out_type = AttrType.LONG
+        self.lanes = (Lane("sum", jnp.int64),)
+
+    def contribs(self, arg, is_add, is_remove):
+        one = jnp.where(is_add | is_remove, jnp.int64(1), jnp.int64(0))
+        return (_signed(one, is_add, is_remove, jnp.int64),)
+
+    def value(self, lane_vals):
+        (cnt,) = lane_vals
+        return Col(cnt, jnp.zeros_like(cnt, dtype=jnp.bool_))
+
+
+class StdDevAgg(AggSpec):
+    """stdDev(): population standard deviation from (sum, sumsq, count)
+    (StdDevAttributeAggregatorExecutor: std = sqrt(E[x^2] - mean^2));
+    null when count==0."""
+
+    def __init__(self, arg_type: AttrType):
+        if arg_type not in NUMERIC_TYPES:
+            raise CompileError(
+                f"stdDev() requires numeric input, got {arg_type}")
+        self.name = "stdDev"
+        self.out_type = AttrType.DOUBLE
+        self.lanes = (Lane("sum", jnp.float64), Lane("sum", jnp.float64),
+                      Lane("sum", jnp.int64))
+
+    def contribs(self, arg, is_add, is_remove):
+        eff = (is_add | is_remove) & ~arg.nulls
+        x = jnp.where(eff, arg.values.astype(jnp.float64), 0.0)
+        one = jnp.where(eff, jnp.int64(1), jnp.int64(0))
+        return (_signed(x, is_add, is_remove, jnp.float64),
+                _signed(x * x, is_add, is_remove, jnp.float64),
+                _signed(one, is_add, is_remove, jnp.int64))
+
+    def value(self, lane_vals):
+        s, ss, cnt = lane_vals
+        n = jnp.maximum(cnt, 1).astype(jnp.float64)
+        mean = s / n
+        var = jnp.maximum(ss / n - mean * mean, 0.0)
+        return Col(jnp.where(cnt == 0, 0.0, jnp.sqrt(var)), cnt == 0)
+
+
+class MinMaxAgg(AggSpec):
+    """min()/max() without expiring content (monotonic running extreme +
+    RESET segmentation). The sliding-window variant (processRemove over a
+    Deque, MinAttributeAggregatorExecutor) needs the multiset path — planner
+    rejects it for now."""
+
+    def __init__(self, arg_type: AttrType, is_max: bool):
+        if arg_type not in NUMERIC_TYPES:
+            raise CompileError("min()/max() requires numeric input")
+        self.name = "max" if is_max else "min"
+        self.out_type = arg_type
+        self.dtype = np_dtype(arg_type)
+        self.lanes = (Lane("max" if is_max else "min", self.dtype),
+                      Lane("sum", jnp.int64))
+
+    def contribs(self, arg, is_add, is_remove):
+        lane = self.lanes[0]
+        eff = is_add & ~arg.nulls
+        x = jnp.where(eff, arg.values.astype(self.dtype), lane.identity())
+        one = jnp.where(eff, jnp.int64(1), jnp.int64(0))
+        return (x, one)
+
+    def value(self, lane_vals):
+        m, cnt = lane_vals
+        return Col(jnp.where(cnt == 0, jnp.zeros_like(m), m), cnt == 0)
+
+
+class ForeverMinMaxAgg(MinMaxAgg):
+    """minForever()/maxForever(): extreme over every event ever seen —
+    EXPIRED events also tighten the extreme
+    (MinForeverAttributeAggregatorExecutor.processRemove also does min)."""
+
+    def __init__(self, arg_type: AttrType, is_max: bool):
+        super().__init__(arg_type, is_max)
+        self.name = "maxForever" if is_max else "minForever"
+
+    def contribs(self, arg, is_add, is_remove):
+        lane = self.lanes[0]
+        eff = (is_add | is_remove) & ~arg.nulls
+        x = jnp.where(eff, arg.values.astype(self.dtype), lane.identity())
+        one = jnp.where(eff, jnp.int64(1), jnp.int64(0))
+        return (x, one)
+
+
+class BoolAgg(AggSpec):
+    """and()/or() over BOOL: counts of true/false values
+    (AndAttributeAggregatorExecutor keeps counts so removes work)."""
+
+    def __init__(self, arg_type: AttrType, is_and: bool):
+        if arg_type is not AttrType.BOOL:
+            raise CompileError("and()/or() requires BOOL input")
+        self.name = "and" if is_and else "or"
+        self.is_and = is_and
+        self.out_type = AttrType.BOOL
+        self.lanes = (Lane("sum", jnp.int64), Lane("sum", jnp.int64))
+
+    def contribs(self, arg, is_add, is_remove):
+        eff = (is_add | is_remove) & ~arg.nulls
+        t = jnp.where(eff & arg.values, jnp.int64(1), jnp.int64(0))
+        f = jnp.where(eff & ~arg.values, jnp.int64(1), jnp.int64(0))
+        return (_signed(t, is_add, is_remove, jnp.int64),
+                _signed(f, is_add, is_remove, jnp.int64))
+
+    def value(self, lane_vals):
+        t, f = lane_vals
+        v = (f == 0) if self.is_and else (t > 0)
+        return Col(v, jnp.zeros_like(v, dtype=jnp.bool_))
+
+
+class DistinctCountAgg(AggSpec):
+    """distinctCount(): needs a per-key value->count map; bounded device
+    multiset not yet implemented — planner rejects."""
+
+    def __init__(self, *_):
+        raise CompileError("distinctCount() is not supported yet")
+
+
+def make_agg_spec(name: str, arg_type: Optional[AttrType],
+                  expired_possible: bool) -> AggSpec:
+    key = name.lower()
+    if key == "sum":
+        return SumAgg(arg_type)
+    if key == "avg":
+        return AvgAgg(arg_type)
+    if key == "count":
+        return CountAgg()
+    if key == "stddev":
+        return StdDevAgg(arg_type)
+    if key in ("min", "max"):
+        if expired_possible:
+            raise CompileError(
+                f"{key}() over a sliding window (expiring events) needs the "
+                "multiset aggregator — not supported yet; use minForever/"
+                "maxForever or a batch window")
+        return MinMaxAgg(arg_type, key == "max")
+    if key in ("minforever", "maxforever"):
+        return ForeverMinMaxAgg(arg_type, key == "maxforever")
+    if key in ("and", "or"):
+        return BoolAgg(arg_type, key == "and")
+    if key == "distinctcount":
+        return DistinctCountAgg()
+    raise CompileError(f"unknown aggregator '{name}'")
+
+
+# ---------------------------------------------------------------------------
+# AST rewrite: aggregator calls -> placeholder variables
+# ---------------------------------------------------------------------------
+
+
+def extract_aggregators(expr: A.Expression, found: list) -> A.Expression:
+    """Replace aggregator calls with __agg_<i>__ variables, collecting the
+    (name, arg asts) list."""
+    if isinstance(expr, A.AttributeFunction):
+        if expr.namespace is None and expr.name.lower() in AGGREGATOR_NAMES:
+            idx = len(found)
+            found.append((expr.name, list(expr.parameters), expr.star))
+            return A.Variable(attribute=f"__agg_{idx}__")
+        return A.AttributeFunction(
+            expr.namespace, expr.name,
+            [extract_aggregators(p, found) for p in expr.parameters],
+            expr.star)
+    if isinstance(expr, A.MathOp):
+        return A.MathOp(expr.op, extract_aggregators(expr.left, found),
+                        extract_aggregators(expr.right, found))
+    if isinstance(expr, A.Compare):
+        return A.Compare(expr.op, extract_aggregators(expr.left, found),
+                         extract_aggregators(expr.right, found))
+    if isinstance(expr, A.And):
+        return A.And(extract_aggregators(expr.left, found),
+                     extract_aggregators(expr.right, found))
+    if isinstance(expr, A.Or):
+        return A.Or(extract_aggregators(expr.left, found),
+                    extract_aggregators(expr.right, found))
+    if isinstance(expr, A.Not):
+        return A.Not(extract_aggregators(expr.expr, found))
+    if isinstance(expr, A.IsNull) and expr.expr is not None:
+        return A.IsNull(expr=extract_aggregators(expr.expr, found))
+    return expr
+
+
+class AggScope(Scope):
+    """Delegates to a base scope but resolves __agg_<i>__ placeholders."""
+
+    def __init__(self, base: Scope, agg_types: list):
+        self.base = base
+        self.agg_types = agg_types
+
+    def resolve(self, var: A.Variable):
+        if var.attribute and var.attribute.startswith("__agg_") \
+                and var.attribute.endswith("__") and var.stream_ref is None:
+            i = int(var.attribute[6:-2])
+            return ("agg", i), self.agg_types[i]
+        return self.base.resolve(var)
+
+    def resolve_stream_isnull(self, is_null):
+        return self.base.resolve_stream_isnull(is_null)
+
+
+# ---------------------------------------------------------------------------
+# the aggregating selector
+# ---------------------------------------------------------------------------
+
+
+class AggregateOp(Operator):
+    """Select clause with aggregators and/or group-by.
+
+    batch_mode mirrors the reference's batchingEnabled (batch windows): only
+    the last qualifying row (or the last per group, in first-seen group
+    order) is emitted per input chunk.
+    """
+
+    def __init__(self, selector: A.Selector, in_schema: StreamSchema,
+                 out_stream_id: str, scope: Scope, functions=None,
+                 batch_mode: bool = False, expired_possible: bool = True,
+                 current_on: bool = True, expired_on: bool = False,
+                 key_capacity: int = 1024):
+        self.in_schema = in_schema
+        self.batch_mode = batch_mode
+        self.current_on = current_on
+        self.expired_on = expired_on
+        self.group_by = selector.group_by
+        self.K = key_capacity if selector.group_by else 1
+        functions = functions or {}
+
+        if selector.select_all:
+            raise CompileError("select * cannot be combined with aggregation")
+
+        # group-by key expressions
+        self.key_exprs = [compile_expression(v, scope, functions)
+                          for v in selector.group_by]
+
+        # split output expressions into aggregator instances + wrappers
+        found: list = []
+        rewritten = [extract_aggregators(oa.expression, found)
+                     for oa in selector.attributes]
+        rewritten_having = (extract_aggregators(selector.having, found)
+                            if selector.having is not None else None)
+
+        self.agg_specs: list[AggSpec] = []
+        self.agg_args: list[Optional[CompiledExpr]] = []
+        for name, params, star in found:
+            if len(params) > 1:
+                raise CompileError(
+                    f"{name}() takes at most one argument here")
+            if params:
+                ce = compile_expression(params[0], scope, functions)
+                self.agg_specs.append(
+                    make_agg_spec(name, ce.type, expired_possible))
+                self.agg_args.append(ce)
+            else:
+                self.agg_specs.append(
+                    make_agg_spec(name, None, expired_possible))
+                self.agg_args.append(None)
+
+        agg_types = [s.out_type for s in self.agg_specs]
+        agg_scope = AggScope(scope, agg_types)
+        self.compiled = [compile_expression(e, agg_scope, functions)
+                         for e in rewritten]
+        attrs = tuple(
+            Attribute(output_attribute_name(oa, i), ce.type)
+            for i, (oa, ce) in enumerate(zip(selector.attributes,
+                                             self.compiled)))
+        self._schema = StreamSchema(out_stream_id, attrs)
+
+        # having may reference output names OR input attributes OR aggregates
+        self.having = None
+        if rewritten_having is not None:
+            hscope = HavingScope(self._schema, agg_scope)
+            self.having = compile_expression(rewritten_having, hscope,
+                                             functions)
+            if self.having.type is not AttrType.BOOL:
+                raise CompileError("HAVING must be BOOL")
+
+        # order by / limit / offset
+        self.order_by = compile_order_by(selector, self._schema)
+        self.limit = const_int(selector.limit, "limit")
+        self.offset = const_int(selector.offset, "offset")
+
+    @property
+    def out_schema(self):
+        return self._schema
+
+    def init_state(self):
+        carries = []
+        for spec in self.agg_specs:
+            carries.append(tuple(
+                jnp.full((self.K,), lane.identity(), dtype=lane.dtype)
+                for lane in spec.lanes))
+        return {
+            "keys": jnp.zeros((self.K,), jnp.int64),
+            "used": jnp.zeros((self.K,), jnp.bool_),
+            "carry": tuple(carries),
+            "overflow": jnp.int64(0),
+        }
+
+    def step(self, state, batch: EventBatch, now):
+        B = batch.capacity
+        env = env_from_batch(batch)
+        env["__now__"] = now
+        valid = batch.valid
+        is_add = valid & (batch.kind == CURRENT)
+        is_remove = valid & (batch.kind == EXPIRED)
+        is_reset = valid & (batch.kind == RESET)
+        agg_row = is_add | is_remove
+
+        # --- group slots -------------------------------------------------
+        overflow = state["overflow"]
+        if self.group_by:
+            key_cols = [ce.fn(env) for ce in self.key_exprs]
+            hkeys = hash_columns([c.values for c in key_cols],
+                                 [c.nulls for c in key_cols])
+            slots, new_keys, new_used, ov = lookup_or_insert(
+                state["keys"], state["used"], hkeys, agg_row)
+            # overflowed keys (slot table full) are parked on the trash slot
+            # K: excluded from state, carry, and output — counted, not
+            # silently mis-aggregated
+            overflowed = agg_row & (slots < 0)
+            agg_row = agg_row & ~overflowed
+            slots = jnp.where(agg_row, slots, jnp.int32(self.K))
+            overflow = overflow + ov
+        else:
+            new_keys, new_used = state["keys"], state["used"]
+            slots = jnp.where(agg_row, jnp.int32(0), jnp.int32(self.K))
+
+        # --- reset segmentation ------------------------------------------
+        reset_seg = jnp.cumsum(is_reset.astype(jnp.int64))  # inclusive
+        # a reset row itself belongs to the next segment — contributions on
+        # the reset row don't exist anyway (reset rows are not agg rows)
+        n_resets = reset_seg[B - 1] if B > 0 else jnp.int64(0)
+
+        # --- sort by (slot, row) -----------------------------------------
+        rows = jnp.arange(B, dtype=jnp.int64)
+        perm = jnp.lexsort((rows, slots.astype(jnp.int64)))
+        inv_perm = jnp.argsort(perm)
+        seg_sorted = (slots.astype(jnp.int64) * (B + 1) + reset_seg)[perm]
+        slot_sorted = slots[perm]
+        segzero_sorted = (reset_seg == 0)[perm]
+
+        # --- per-aggregator running values -------------------------------
+        agg_cols: list[Col] = []
+        new_carries = []
+        for spec, arg, carry in zip(self.agg_specs, self.agg_args,
+                                    state["carry"]):
+            arg_col = arg.fn(env) if arg is not None else None
+            contribs = spec.contribs(arg_col, is_add, is_remove)
+            lane_runnings = []
+            lane_carries = []
+            for lane, contrib, cvec in zip(spec.lanes, contribs, carry):
+                c_sorted = contrib[perm]
+                pref = lane.segmented_scan(c_sorted, seg_sorted)
+                # carry-in applies to rows before any reset
+                slot_safe = jnp.clip(slot_sorted, 0, self.K - 1)
+                cin = jnp.where(segzero_sorted, cvec[slot_safe],
+                                lane.identity())
+                run_sorted = lane.combine(cin, pref)
+                lane_runnings.append(run_sorted[inv_perm])
+                # new carry: contributions in the LAST reset segment
+                last_mask = (reset_seg == n_resets) & agg_row
+                base = jnp.where(n_resets == 0, cvec,
+                                 jnp.full_like(cvec, lane.identity()))
+                upd = jnp.where(last_mask, contrib,
+                                jnp.full_like(contrib, lane.identity()))
+                tgt = jnp.where(last_mask, slots, jnp.int32(self.K))
+                if lane.op == "sum":
+                    newc = base.at[tgt].add(upd, mode="drop")
+                elif lane.op == "min":
+                    newc = base.at[tgt].min(upd, mode="drop")
+                else:
+                    newc = base.at[tgt].max(upd, mode="drop")
+                lane_carries.append(newc)
+            agg_cols.append(spec.value(tuple(lane_runnings)))
+            new_carries.append(tuple(lane_carries))
+
+        for i, c in enumerate(agg_cols):
+            env[("agg", i)] = c
+
+        # --- project ------------------------------------------------------
+        out_cols, out_nulls = [], []
+        for ce in self.compiled:
+            c = ce.fn(env)
+            out_cols.append(jnp.broadcast_to(c.values, (B,)))
+            out_nulls.append(jnp.broadcast_to(c.nulls, (B,)))
+
+        qualifying = ((is_add & self.current_on) |
+                      (is_remove & self.expired_on)) & \
+            (slots < jnp.int32(self.K))
+        if self.having is not None:
+            henv = dict(env)
+            for i, (cv, cn) in enumerate(zip(out_cols, out_nulls)):
+                henv[("out", i)] = Col(cv, cn)
+            hc = self.having.fn(henv)
+            qualifying = qualifying & hc.values & ~hc.nulls
+
+        out_valid = qualifying
+        if self.batch_mode:
+            # The reference emits one output chunk PER FLUSH
+            # (LengthBatchWindowProcessor.process collects streamEventChunks
+            # and the selector runs per chunk, keeping the last qualifying
+            # event — or the last per group in first-seen order). A flush
+            # chunk in the window's output is [EXPIRED*, RESET?, CURRENT*]:
+            # a new chunk starts at the first valid row or where an
+            # EXPIRED/RESET row follows a CURRENT row.
+            vidx = jnp.where(valid, rows, jnp.int64(-1))
+            last_valid_upto = jax.lax.cummax(vidx)
+            prev_valid = jnp.concatenate([jnp.full((1,), -1, jnp.int64),
+                                          last_valid_upto[:-1]])
+            prev_kind = jnp.where(
+                prev_valid >= 0, batch.kind[jnp.maximum(prev_valid, 0)],
+                jnp.int32(-1))
+            boundary = valid & (
+                (prev_valid < 0) |
+                (((batch.kind == EXPIRED) | (batch.kind == RESET)) &
+                 (prev_kind == CURRENT)))
+            chunk_id = jnp.cumsum(boundary.astype(jnp.int64))
+            # last qualifying row per (slot, flush chunk); emitted in order
+            # of the group's first qualifying row (chunks are contiguous row
+            # ranges, so this also orders chunks)
+            qkey = jnp.where(qualifying,
+                             slots.astype(jnp.int64) * (B + 1) + chunk_id,
+                             I64_MAX)
+            perm2 = jnp.lexsort((rows, qkey))
+            qk_s = qkey[perm2]
+            rows_s = rows[perm2]
+            is_last_s = jnp.concatenate([qk_s[:-1] != qk_s[1:],
+                                         jnp.ones((1,), jnp.bool_)])
+            first_s = segmented_cummin(rows_s, qk_s)
+            out_valid = jnp.zeros((B,), jnp.bool_).at[perm2].set(
+                is_last_s & (qk_s < I64_MAX))
+            emit_order = jnp.zeros((B,), jnp.int64).at[perm2].set(first_s)
+        else:
+            emit_order = rows
+
+        out = EventBatch(ts=batch.ts, cols=tuple(out_cols),
+                         nulls=tuple(out_nulls), kind=batch.kind,
+                         valid=out_valid)
+
+        # --- order by / offset / limit (chunk level) ----------------------
+        out = shape_output(out, self.order_by, self.offset, self.limit,
+                           emit_order)
+
+        new_state = {"keys": new_keys, "used": new_used,
+                     "carry": tuple(new_carries), "overflow": overflow}
+        return new_state, out
+
+
+class HavingScope(Scope):
+    """HAVING resolves output attribute names first, then falls back to the
+    input scope (reference: having runs on the projected output event but
+    may also reference input attrs that were projected through)."""
+
+    def __init__(self, out_schema: StreamSchema, base: Scope):
+        self.out_schema = out_schema
+        self.base = base
+
+    def resolve(self, var: A.Variable):
+        if var.attribute and var.attribute.startswith("__agg_"):
+            return self.base.resolve(var)
+        if var.stream_ref is None:
+            try:
+                idx = self.out_schema.index_of(var.attribute)
+                return ("out", idx), self.out_schema.types[idx]
+            except KeyError:
+                pass
+        return self.base.resolve(var)
+
+    def resolve_stream_isnull(self, is_null):
+        return self.base.resolve_stream_isnull(is_null)
+
+

@@ -1,17 +1,20 @@
-"""Query selector: projection, and (in later stages) group-by aggregation,
-having, order-by, limit/offset.
+"""Query selector: projection, having, order-by/limit/offset, and the
+current/expired output-event gating.
 
-Reference: query/selector/QuerySelector.java:44 with AttributeProcessor per
-output attribute. Here the whole select clause is one vectorized operator.
+Reference: query/selector/QuerySelector.java:44 (processNoGroupBy — per-event
+AttributeProcessor evaluation, type gating, having, then order/offset/limit
+chunk shaping). The aggregating variants live in ops/aggregators.py.
 """
 from __future__ import annotations
 
+from typing import Optional
+
 import jax.numpy as jnp
 
-from ..core.event import Attribute, EventBatch, StreamSchema
+from ..core.event import CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema
 from ..core.types import AttrType
 from ..lang import ast as A
-from .expr import CompileError, CompiledExpr, Scope, compile_expression, env_from_batch
+from .expr import Col, CompileError, CompiledExpr, Scope, compile_expression, env_from_batch
 from .operators import Operator
 
 # aggregator function names recognized in select clauses
@@ -37,6 +40,16 @@ def has_aggregators(expr: A.Expression) -> bool:
     return False
 
 
+def selector_needs_aggregation(selector: A.Selector) -> bool:
+    if selector.group_by:
+        return True
+    if any(has_aggregators(oa.expression) for oa in selector.attributes):
+        return True
+    if selector.having is not None and has_aggregators(selector.having):
+        return True
+    return False
+
+
 def output_attribute_name(oa: A.OutputAttribute, i: int) -> str:
     if oa.rename:
         return oa.rename
@@ -45,12 +58,78 @@ def output_attribute_name(oa: A.OutputAttribute, i: int) -> str:
     return f"_{i}"
 
 
+def const_int(expr, what: str) -> Optional[int]:
+    if expr is None:
+        return None
+    if not isinstance(expr, A.Constant) or not isinstance(expr.value, int):
+        raise CompileError(f"{what} must be an integer constant")
+    return int(expr.value)
+
+
+def compile_order_by(selector: A.Selector, schema: StreamSchema):
+    order_by = []
+    for ob in selector.order_by:
+        idx = schema.index_of(ob.variable.attribute)
+        if ob.order.lower() not in ("asc", "desc"):
+            raise CompileError(f"unknown order '{ob.order}'")
+        if schema.types[idx] is AttrType.STRING:
+            raise CompileError(
+                "order by on STRING attributes is not supported on device "
+                "(dictionary codes are not lexicographic)")
+        order_by.append((idx, ob.order.lower()))
+    return order_by
+
+
+def shape_output(out: EventBatch, order_by, offset: Optional[int],
+                 limit: Optional[int],
+                 emit_order=None) -> EventBatch:
+    """Order-by / offset / limit over a chunk's valid rows
+    (QuerySelector.orderEventChunk / offsetEventChunk / limitEventChunk)."""
+    B = out.capacity
+    rows = jnp.arange(B, dtype=jnp.int64)
+    if order_by:
+        sort_keys = []
+        for idx, direction in reversed(order_by):
+            v = out.cols[idx]
+            if v.dtype == jnp.bool_:
+                v = v.astype(jnp.int64)
+            # integer keys sort as int64 (no float53 precision loss)
+            sort_keys.append(v if direction == "asc" else -v)
+        primary = jnp.where(out.valid, jnp.int64(0), jnp.int64(1))
+        perm = jnp.lexsort((rows,) + tuple(sort_keys) + (primary,))
+        out = _permute(out, perm)
+    elif emit_order is not None:
+        primary = jnp.where(out.valid, emit_order, jnp.int64(2 ** 62))
+        perm = jnp.lexsort((rows, primary))
+        out = _permute(out, perm)
+    if offset is not None or limit is not None:
+        rank = jnp.cumsum(out.valid.astype(jnp.int64)) - 1
+        keep = out.valid
+        if offset is not None:
+            keep = keep & (rank >= offset)
+        if limit is not None:
+            keep = keep & (rank < (offset or 0) + limit)
+        out = out.mask(keep)
+    return out
+
+
+def _permute(out: EventBatch, perm) -> EventBatch:
+    return EventBatch(ts=out.ts[perm],
+                      cols=tuple(c[perm] for c in out.cols),
+                      nulls=tuple(n[perm] for n in out.nulls),
+                      kind=out.kind[perm], valid=out.valid[perm])
+
+
 class ProjectOp(Operator):
-    """Stateless projection (select clause without aggregators)."""
+    """Stateless select clause (no aggregators): projection + gating +
+    having + order/offset/limit."""
 
     def __init__(self, selector: A.Selector, in_schema: StreamSchema,
-                 out_stream_id: str, scope: Scope, functions=None):
+                 out_stream_id: str, scope: Scope, functions=None,
+                 current_on: bool = True, expired_on: bool = False):
         self.in_schema = in_schema
+        self.current_on = current_on
+        self.expired_on = expired_on
         if selector.select_all:
             self._passthrough = True
             self._schema = StreamSchema(out_stream_id, in_schema.attributes)
@@ -71,28 +150,36 @@ class ProjectOp(Operator):
             self.having = compile_expression(selector.having,
                                              OutputScope(self._schema),
                                              functions)
+            if self.having.type is not AttrType.BOOL:
+                raise CompileError("HAVING must be BOOL")
+        self.order_by = compile_order_by(selector, self._schema)
+        self.limit = const_int(selector.limit, "limit")
+        self.offset = const_int(selector.offset, "offset")
 
     def step(self, state, batch: EventBatch, now):
+        gate = batch.valid & (
+            ((batch.kind == CURRENT) & self.current_on) |
+            ((batch.kind == EXPIRED) & self.expired_on))
         if self._passthrough:
-            out = batch
+            out = batch.mask(gate)
         else:
             env = env_from_batch(batch)
             env["__now__"] = now
             cols, nulls = [], []
             for ce in self.compiled:
                 c = ce.fn(env)
-                vals = jnp.broadcast_to(c.values, batch.ts.shape)
-                nls = jnp.broadcast_to(c.nulls, batch.ts.shape)
-                cols.append(vals)
-                nulls.append(nls)
-            out = EventBatch(ts=batch.ts, cols=tuple(cols), nulls=tuple(nulls),
-                             kind=batch.kind, valid=batch.valid)
+                cols.append(jnp.broadcast_to(c.values, batch.ts.shape))
+                nulls.append(jnp.broadcast_to(c.nulls, batch.ts.shape))
+            out = EventBatch(ts=batch.ts, cols=tuple(cols),
+                             nulls=tuple(nulls), kind=batch.kind,
+                             valid=gate)
         if self.having is not None:
             henv = env_from_batch(out)
             henv["__now__"] = now
             hc = self.having.fn(henv)
             out = out.mask(hc.values & ~hc.nulls)
-        return state, out
+        return state, shape_output(out, self.order_by, self.offset,
+                                   self.limit)
 
     @property
     def out_schema(self):

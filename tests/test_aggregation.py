@@ -1,0 +1,240 @@
+"""Aggregator + group-by selector tests, modeled on the reference's
+query/selector/attribute/aggregator test corpus and window aggregation cases
+(modules/siddhi-core/src/test/.../query/window/LengthBatchWindowTestCase.java
+group-by tests, AggregationTestCase idiom): per-event running aggregates,
+RESET semantics on batch windows, group-by keyed state.
+"""
+import pytest
+
+from siddhi_tpu import Event, QueryCallback, SiddhiManager, StreamCallback
+
+PLAYBACK = "@app:playback "
+
+
+def run(ql, stream, rows, target="Out", query_cb=False, ts0=1000):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got, q = [], []
+    if query_cb:
+        rt.add_callback(target, QueryCallback(
+            fn=lambda ts, ins, rms: q.append((ins, rms))))
+    else:
+        rt.add_callback(target, StreamCallback(fn=lambda evs:
+                                               got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for i, r in enumerate(rows):
+        if isinstance(r, Event):
+            h.send(r)
+        else:
+            h.send(Event(timestamp=ts0 + i, data=tuple(r)))
+    rt.shutdown()
+    return got, q
+
+
+class TestRunningAggregates:
+    def test_sum_count_avg_per_event(self):
+        ql = PLAYBACK + """
+            define stream S (symbol string, price double, volume int);
+            from S
+            select symbol, sum(volume) as total, count() as n,
+                   avg(price) as mean
+            insert into Out;
+        """
+        got, _ = run(ql, "S", [("A", 10.0, 1), ("B", 20.0, 2),
+                               ("C", 30.0, 3)])
+        assert [e.data for e in got] == [
+            ("A", 1, 1, 10.0), ("B", 3, 2, 15.0), ("C", 6, 3, 20.0)]
+
+    def test_sum_type_widening(self):
+        # sum(int) -> LONG, sum(float) -> DOUBLE
+        # (SumAttributeAggregatorExecutor returnType selection)
+        ql = PLAYBACK + """
+            define stream S (a int, b float);
+            from S select sum(a) as sa, sum(b) as sb insert into Out;
+        """
+        got, _ = run(ql, "S", [(1, 1.5), (2, 2.5)])
+        assert [e.data for e in got] == [(1, 1.5), (3, 4.0)]
+        assert isinstance(got[-1].data[0], int)
+        assert isinstance(got[-1].data[1], float)
+
+    def test_min_max_running(self):
+        ql = PLAYBACK + """
+            define stream S (a int);
+            from S select min(a) as lo, max(a) as hi insert into Out;
+        """
+        got, _ = run(ql, "S", [(5,), (3,), (9,), (4,)])
+        assert [e.data for e in got] == [(5, 5), (3, 5), (3, 9), (3, 9)]
+
+    def test_stddev(self):
+        ql = PLAYBACK + """
+            define stream S (a double);
+            from S select stdDev(a) as sd insert into Out;
+        """
+        got, _ = run(ql, "S", [(2.0,), (4.0,), (4.0,), (4.0,), (5.0,),
+                               (5.0,), (7.0,), (9.0,)])
+        assert got[-1].data[0] == pytest.approx(2.0)
+
+    def test_null_input_skipped(self):
+        ql = PLAYBACK + """
+            define stream S (a int);
+            from S select sum(a) as s, count() as n insert into Out;
+        """
+        got, _ = run(ql, "S", [(1,), (None,), (2,)])
+        # null add leaves sum unchanged but count() still counts the event
+        assert [e.data for e in got] == [(1, 1), (1, 2), (3, 3)]
+
+    def test_aggregate_inside_expression(self):
+        ql = PLAYBACK + """
+            define stream S (a int);
+            from S select sum(a) * 2 + 1 as x insert into Out;
+        """
+        got, _ = run(ql, "S", [(1,), (2,)])
+        assert [e.data for e in got] == [(3,), (7,)]
+
+
+class TestGroupBy:
+    def test_group_by_sum(self):
+        ql = PLAYBACK + """
+            define stream S (symbol string, volume int);
+            from S select symbol, sum(volume) as total
+            group by symbol insert into Out;
+        """
+        got, _ = run(ql, "S", [("IBM", 10), ("WSO2", 5), ("IBM", 20),
+                               ("WSO2", 7)])
+        assert [e.data for e in got] == [
+            ("IBM", 10), ("WSO2", 5), ("IBM", 30), ("WSO2", 12)]
+
+    def test_group_by_two_keys(self):
+        ql = PLAYBACK + """
+            define stream S (symbol string, kind int, volume int);
+            from S select symbol, kind, sum(volume) as total
+            group by symbol, kind insert into Out;
+        """
+        got, _ = run(ql, "S", [("A", 1, 10), ("A", 2, 5), ("A", 1, 1)])
+        assert [e.data for e in got] == [
+            ("A", 1, 10), ("A", 2, 5), ("A", 1, 11)]
+
+    def test_lengthbatch_multiple_flushes_in_one_send(self):
+        # one send() covering two full batches must emit BOTH flush results
+        # (reference emits one output chunk per flush:
+        # LengthBatchWindowProcessor.process collects streamEventChunks)
+        ql = PLAYBACK + """
+            define stream S (a int);
+            from S#window.lengthBatch(2) select sum(a) as s insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda evs:
+                                              got.extend(evs)))
+        rt.start()
+        rt.get_input_handler("S").send([(1,), (2,), (3,), (4,)])
+        rt.shutdown()
+        assert [e.data for e in got] == [(3,), (7,)]
+
+    def test_group_by_lengthbatch_resets_all_groups(self):
+        # RESET clears every group's state
+        # (AttributeAggregatorExecutor.processReset -> cleanGroupByStates)
+        ql = PLAYBACK + """
+            define stream S (symbol string, volume int);
+            from S#window.lengthBatch(4)
+            select symbol, sum(volume) as total
+            group by symbol insert into Out;
+        """
+        got, _ = run(ql, "S", [("A", 1), ("B", 2), ("A", 3), ("B", 4),
+                               ("A", 10), ("B", 20), ("B", 30), ("A", 40)])
+        # batch mode group-by: one output per group per flush (last value),
+        # in first-seen group order
+        assert [e.data for e in got] == [
+            ("A", 4), ("B", 6), ("A", 50), ("B", 50)]
+
+
+class TestHavingOrderLimit:
+    def test_having_on_aggregate(self):
+        ql = PLAYBACK + """
+            define stream S (a int);
+            from S select sum(a) as s having s > 3 insert into Out;
+        """
+        got, _ = run(ql, "S", [(1,), (2,), (3,)])
+        assert [e.data for e in got] == [(6,)]
+
+    def test_having_no_aggregation(self):
+        ql = PLAYBACK + """
+            define stream S (symbol string, price double);
+            from S select symbol, price having price > 100.0
+            insert into Out;
+        """
+        got, _ = run(ql, "S", [("A", 50.0), ("B", 150.0)])
+        assert [e.data for e in got] == [("B", 150.0)]
+
+    def test_limit_in_batch(self):
+        ql = PLAYBACK + """
+            define stream S (a int);
+            from S select a limit 2 insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda evs:
+                                              got.extend(evs)))
+        rt.start()
+        # one chunk of 5 events -> limit applies per chunk
+        rt.get_input_handler("S").send([(1,), (2,), (3,), (4,), (5,)])
+        rt.shutdown()
+        assert [e.data for e in got] == [(1,), (2,)]
+
+    def test_order_by_in_chunk(self):
+        ql = PLAYBACK + """
+            define stream S (a int, b double);
+            from S select a, b order by b desc insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda evs:
+                                              got.extend(evs)))
+        rt.start()
+        rt.get_input_handler("S").send([(1, 5.0), (2, 9.0), (3, 1.0)])
+        rt.shutdown()
+        assert [e.data for e in got] == [(2, 9.0), (1, 5.0), (3, 1.0)]
+
+
+class TestSlidingWindowAggregates:
+    def test_length_window_sum(self):
+        ql = PLAYBACK + """
+            define stream S (a int);
+            from S#window.length(3) select sum(a) as s insert into Out;
+        """
+        got, _ = run(ql, "S", [(1,), (2,), (3,), (10,), (20,)])
+        # window [1,2,3] -> 6; then expire 1, add 10 -> 15; expire 2 -> 33
+        # per-event emission: expired rows emit too (but only CURRENT is
+        # inserted since output is 'current events' -> expired row value is
+        # suppressed by gating)
+        assert [e.data for e in got] == [(1,), (3,), (6,), (15,), (33,)]
+
+    def test_time_window_group_by_sum(self):
+        ql = PLAYBACK + """
+            define stream S (symbol string, volume int);
+            from S#window.time(1 sec)
+            select symbol, sum(volume) as total
+            group by symbol insert into Out;
+        """
+        got, _ = run(ql, "S", [
+            Event(1000, ("A", 10)),
+            Event(1100, ("B", 5)),
+            Event(1500, ("A", 7)),
+            Event(2300, ("A", 100)),  # A@1000 expired at 2000 -> total 7+100
+        ])
+        assert [e.data for e in got] == [
+            ("A", 10), ("B", 5), ("A", 17), ("A", 107)]
+
+    def test_min_over_sliding_window_rejected(self):
+        from siddhi_tpu.ops.expr import CompileError
+        mgr = SiddhiManager()
+        with pytest.raises(CompileError, match="min"):
+            mgr.create_siddhi_app_runtime(PLAYBACK + """
+                define stream S (a int);
+                from S#window.time(1 sec) select min(a) as m
+                insert into Out;
+            """)

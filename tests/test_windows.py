@@ -1,0 +1,233 @@
+"""Window operator tests, modeled on the reference's window test corpus
+(modules/siddhi-core/src/test/.../query/window/LengthWindowTestCase.java,
+LengthBatchWindowTestCase.java, TimeWindowTestCase.java,
+TimeBatchWindowTestCase.java). Playback mode (= managment/PlaybackTestCase
+idiom) replaces wall-clock sleeps with explicit event timestamps so the
+tests are deterministic and bit-exact.
+"""
+import pytest
+
+from siddhi_tpu import Event, QueryCallback, SiddhiManager, StreamCallback
+
+
+def run_app(ql, stream, events, callback_target=None, query_cb=False):
+    """Send events (ts, data) in playback mode; collect outputs.
+
+    Returns (stream_events, query_results) where query_results is a list of
+    (in_events, remove_events) tuples per callback.
+    """
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    stream_got = []
+    q_got = []
+    if callback_target:
+        if query_cb:
+            rt.add_callback(callback_target, QueryCallback(
+                fn=lambda ts, ins, rms: q_got.append((ins, rms))))
+        else:
+            rt.add_callback(callback_target,
+                            StreamCallback(fn=lambda evs:
+                                           stream_got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for ts, data in events:
+        h.send(Event(timestamp=ts, data=tuple(data)))
+    rt.shutdown()
+    return stream_got, q_got
+
+
+PLAYBACK = "@app:playback "
+
+
+class TestLengthWindow:
+    QL = PLAYBACK + """
+        define stream S (symbol string, price float, volume int);
+        @info(name = 'q')
+        from S#window.length(4)
+        select symbol, price, volume
+        insert all events into Out;
+    """
+
+    def test_under_capacity_no_expiry(self):
+        got, _ = run_app(self.QL, "S",
+                         [(1000, ("IBM", 700.0, 1)),
+                          (1001, ("WSO2", 60.5, 2))],
+                         callback_target="Out")
+        assert [e.data[2] for e in got] == [1, 2]
+        assert all(not e.is_expired for e in got)
+
+    def test_expiry_interleaving(self):
+        # 6 events through length(4): arrivals 5,6 evict 1,2; expired events
+        # come BEFORE the current event that evicted them. Inserting into a
+        # stream converts EXPIRED to CURRENT (InsertIntoStreamCallback
+        # .java:52-55), so the stream callback checks order only.
+        events = [(1000 + i, ("S", 10.0, i)) for i in range(1, 7)]
+        got, _ = run_app(self.QL, "S", events, callback_target="Out")
+        assert [e.data[2] for e in got] == [1, 2, 3, 4, 1, 5, 2, 6]
+        assert all(not e.is_expired for e in got)
+
+    def test_query_callback_split(self):
+        events = [(1000 + i, ("S", 10.0, i)) for i in range(1, 6)]
+        _, q = run_app(self.QL, "S", events, callback_target="q",
+                       query_cb=True)
+        # 5th event: removeEvents=[1], inEvents=[5]
+        ins, rms = q[-1]
+        assert [e.data[2] for e in ins] == [5]
+        assert [e.data[2] for e in rms] == [1]
+
+
+class TestLengthBatchWindow:
+    QL = PLAYBACK + """
+        define stream S (symbol string, price float, volume int);
+        @info(name = 'q')
+        from S#window.lengthBatch(4)
+        select symbol, price, volume
+        insert all events into Out;
+    """
+
+    def test_flush_every_l(self):
+        events = [(1000 + i, ("S", 10.0, i)) for i in range(1, 9)]
+        _, q = run_app(self.QL, "S", events, callback_target="q",
+                       query_cb=True)
+        assert len(q) == 2
+        ins1, rms1 = q[0]
+        assert [e.data[2] for e in ins1] == [1, 2, 3, 4]
+        assert rms1 is None
+        ins2, rms2 = q[1]
+        assert [e.data[2] for e in ins2] == [5, 6, 7, 8]
+        assert [e.data[2] for e in rms2] == [1, 2, 3, 4]
+
+    def test_sum_resets_per_batch(self):
+        ql = PLAYBACK + """
+            define stream S (symbol string, price float, volume int);
+            @info(name = 'q')
+            from S#window.lengthBatch(3)
+            select sum(volume) as total
+            insert into Out;
+        """
+        events = [(1000 + i, ("S", 10.0, i)) for i in range(1, 7)]
+        got, _ = run_app(ql, "S", events, callback_target="Out")
+        # batch mode: one output per flush with the batch's final sum
+        assert [e.data[0] for e in got] == [1 + 2 + 3, 4 + 5 + 6]
+
+
+class TestTimeWindow:
+    QL = PLAYBACK + """
+        define stream S (symbol string, price float, volume int);
+        @info(name = 'q')
+        from S#window.time(1 sec)
+        select symbol, price, volume
+        insert all events into Out;
+    """
+
+    def test_expiry_on_later_event(self):
+        got, _ = run_app(
+            self.QL, "S",
+            [(1000, ("A", 1.0, 1)),
+             (1500, ("B", 1.0, 2)),
+             (2100, ("C", 1.0, 3)),   # expires A (1000+1000<=2100)
+             (2600, ("D", 1.0, 4))],  # expires B
+            callback_target="Out")
+        assert [e.data[2] for e in got] == [1, 2, 1, 3, 2, 4]
+
+    def test_expired_timestamp_rewritten(self):
+        # in playback the scheduler fires the expiry TIMER at 2000 before the
+        # 2500 event is processed; the expired event's ts is the observation
+        # time (TimeWindowProcessor.java:147 setTimestamp(currentTime))
+        _, q = run_app(
+            self.QL, "S",
+            [(1000, ("A", 1.0, 1)), (2500, ("B", 1.0, 2))],
+            callback_target="q", query_cb=True)
+        assert len(q) == 3
+        ins1, rms1 = q[0]
+        assert ([e.data[2] for e in ins1], rms1) == ([1], None)
+        ins2, rms2 = q[1]  # timer-driven expiry at due time 2000
+        assert ins2 is None
+        assert [(e.data[2], e.timestamp) for e in rms2] == [(1, 2000)]
+        ins3, rms3 = q[2]
+        assert ([e.data[2] for e in ins3], rms3) == ([2], None)
+
+    def test_sliding_sum(self):
+        ql = PLAYBACK + """
+            define stream S (symbol string, price float, volume int);
+            from S#window.time(1 sec)
+            select sum(volume) as total
+            insert into Out;
+        """
+        got, _ = run_app(
+            ql, "S",
+            [(1000, ("A", 1.0, 10)),
+             (1500, ("B", 1.0, 20)),
+             (2100, ("C", 1.0, 30))],  # A expired first: 20+30
+            callback_target="Out")
+        assert [e.data[0] for e in got] == [10, 30, 50]
+
+
+class TestTimeBatchWindow:
+    def test_flush_on_interval(self):
+        ql = PLAYBACK + """
+            define stream S (symbol string, price float, volume int);
+            @info(name = 'q')
+            from S#window.timeBatch(1 sec)
+            select symbol, price, volume
+            insert all events into Out;
+        """
+        # window starts at first event (1000); the playback scheduler fires
+        # the flush timer at 2000 (before the 2100 event) and at 3000;
+        # event 4 stays pending at shutdown
+        _, q = run_app(
+            ql, "S",
+            [(1000, ("A", 1.0, 1)),
+             (1400, ("B", 1.0, 2)),
+             (2100, ("C", 1.0, 3)),
+             (3200, ("D", 1.0, 4))],
+            callback_target="q", query_cb=True)
+        assert len(q) == 2
+        ins1, rms1 = q[0]
+        assert [e.data[2] for e in ins1] == [1, 2]
+        assert rms1 is None
+        ins2, rms2 = q[1]
+        assert [e.data[2] for e in ins2] == [3]
+        assert [e.data[2] for e in rms2] == [1, 2]
+
+    def test_timebatch_sum(self):
+        ql = PLAYBACK + """
+            define stream S (symbol string, price float, volume int);
+            from S#window.timeBatch(1 sec)
+            select sum(volume) as total
+            insert into Out;
+        """
+        got, _ = run_app(
+            ql, "S",
+            [(1000, ("A", 1.0, 10)), (1400, ("B", 1.0, 20)),
+             (2100, ("C", 1.0, 5)), (3200, ("D", 1.0, 7))],
+            callback_target="Out")
+        assert [e.data[0] for e in got] == [30, 5]
+
+
+class TestTimerDriven:
+    def test_wallclock_time_window_expires_without_events(self):
+        """Scheduler injects TIMER batches in wall-clock mode
+        (util/Scheduler.java:113 -> EntryValveProcessor path)."""
+        import time as _t
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+            define stream S (a int);
+            @info(name = 'q')
+            from S#window.time(150 milliseconds)
+            select a insert all events into Out;
+        """)
+        q = []
+        rt.add_callback("q", QueryCallback(
+            fn=lambda ts, ins, rms: q.append((ins, rms))))
+        rt.start()
+        rt.get_input_handler("S").send((7,))
+        deadline = _t.time() + 3.0
+        while _t.time() < deadline:
+            if any(rms for _, rms in q):
+                break
+            _t.sleep(0.02)
+        rt.shutdown()
+        assert len(q) == 2
+        assert [e.data[0] for e in q[0][0]] == [7] and q[0][1] is None
+        assert q[1][0] is None and [e.data[0] for e in q[1][1]] == [7]
