@@ -1,0 +1,86 @@
+"""Benchmark driver: prints ONE JSON line
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Workload: BASELINE.md config 1 (StockStream filter, stateless) until the
+NFA engine lands; then the north-star 5-state sequence pattern over a
+1M-event replay takes over.
+
+vs_baseline: the reference repo publishes no numbers (BASELINE.md) and this
+image has no JVM (`java` not found), so the Java single-thread figure cannot
+be measured here. vs_baseline is computed against the figure recorded in
+BASELINE.md §Assumed (1.0M events/s single-thread Java for the filter
+config — the reference harness's typical order of magnitude on commodity
+CPUs); it is an assumption, not a measurement, until a JVM is available.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+import siddhi_tpu
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.types import GLOBAL_STRINGS
+
+ASSUMED_JAVA_FILTER_EPS = 1_000_000.0
+
+N_EVENTS = 1_000_000
+BATCH = 65_536
+
+
+def bench_filter() -> dict:
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:playback
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name = 'q')
+        from StockStream[price > 100.0]
+        select symbol, price
+        insert into OutputStream;
+    """)
+    q = rt.queries["q"]
+    matched = []
+    q.batch_callbacks.append(lambda out: matched.append(out.count()))
+    rt.start()
+    h = rt.get_input_handler("StockStream")
+
+    rng = np.random.default_rng(7)
+    syms = np.array([GLOBAL_STRINGS.encode(s)
+                     for s in ("IBM", "WSO2", "GOOG", "MSFT")], np.int32)
+    n_batches = N_EVENTS // BATCH
+    batches = []
+    ts0 = 1_700_000_000_000
+    for b in range(n_batches):
+        ts = ts0 + np.arange(b * BATCH, (b + 1) * BATCH, dtype=np.int64)
+        sym = syms[rng.integers(0, len(syms), BATCH)]
+        price = rng.uniform(0, 200, BATCH).astype(np.float32)
+        vol = rng.integers(1, 1000, BATCH, dtype=np.int64)
+        batches.append((ts, [sym, price, vol]))
+
+    # warmup / compile
+    h.send_arrays(*batches[0])
+    matched[0].block_until_ready()
+    matched.clear()
+
+    t0 = time.perf_counter()
+    for ts, cols in batches:
+        h.send_arrays(ts, cols)
+    for m in matched:
+        m.block_until_ready()
+    dt = time.perf_counter() - t0
+    total = n_batches * BATCH
+    n_matched = int(sum(int(m) for m in matched))
+    rt.shutdown()
+    assert n_matched > 0
+    eps = total / dt
+    return {
+        "metric": "filter_events_per_sec",
+        "value": round(eps, 1),
+        "unit": "events/s",
+        "vs_baseline": round(eps / ASSUMED_JAVA_FILTER_EPS, 3),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_filter()))

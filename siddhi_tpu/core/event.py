@@ -170,6 +170,41 @@ def batch_from_rows(
                       valid=valid)
 
 
+def batch_from_columns(
+    schema: StreamSchema,
+    ts,
+    cols: Sequence,
+    capacity: int | None = None,
+) -> EventBatch:
+    """Columnar fast-path ingest: build an EventBatch straight from numpy
+    arrays (no per-row Python). STRING columns must already be dictionary
+    codes (GLOBAL_STRINGS.encode). The TPU-native equivalent of the
+    reference's Event[] send overload (InputHandler.java:63)."""
+    ts = np.asarray(ts, dtype=np.int64)
+    n = ts.shape[0]
+    capacity = capacity or n
+    assert n <= capacity, (n, capacity)
+    if len(cols) != len(schema.types):
+        raise ValueError(
+            f"stream '{schema.stream_id}' expects {len(schema.types)} data "
+            f"columns, got {len(cols)}")
+    out_ts = np.zeros((capacity,), dtype=np.int64)
+    out_ts[:n] = ts
+    valid = np.zeros((capacity,), dtype=np.bool_)
+    valid[:n] = True
+    out_cols, out_nulls = [], []
+    for t, c in zip(schema.types, cols):
+        dt = np_dtype(t)
+        col = np.zeros((capacity,), dtype=dt)
+        col[:n] = np.asarray(c, dtype=dt)
+        out_cols.append(col)
+        out_nulls.append(np.zeros((capacity,), dtype=np.bool_))
+    return EventBatch(ts=out_ts, cols=tuple(out_cols),
+                      nulls=tuple(out_nulls),
+                      kind=np.zeros((capacity,), dtype=np.int32),
+                      valid=valid)
+
+
 def rows_from_batch(schema_types: Sequence[AttrType], batch) -> list:
     """Host-side: decode a device EventBatch into
     (timestamp, kind, tuple(values)) rows, in row order, skipping padding."""

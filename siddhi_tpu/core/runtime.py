@@ -27,6 +27,7 @@ import numpy as np
 from ..lang import ast as A
 from ..ops.aggregators import AggregateOp
 from ..ops.expr import CompileError, SingleStreamScope, compile_expression
+from ..ops.nfa import MatchScope, NfaCompiler, NfaEngine
 from ..ops.operators import FilterOp, Operator
 from ..ops.selector import ProjectOp, selector_needs_aggregation
 from ..ops.windows import (POS_INF, LengthBatchWindowOp, LengthWindowOp,
@@ -102,6 +103,9 @@ class QueryRuntime(Receiver):
         self.app = app
         self.output_handlers: list[OutputHandler] = []
         self.callback_handler = QueryCallbackHandler()
+        # raw device-batch observers (no host row decode) — the zero-copy
+        # path used by bench.py and device-to-device chaining
+        self.batch_callbacks: list[Callable] = []
         self.states = tuple(op.init_state() for op in operators)
         self._step: Optional[Callable] = None
         self._lock = threading.Lock()
@@ -140,7 +144,9 @@ class QueryRuntime(Receiver):
         return self._step
 
     # -- runtime ---------------------------------------------------------
-    def receive(self, events: list[Event]) -> None:
+    @staticmethod
+    def encode_chunks(schema: StreamSchema, events: list[Event]):
+        """Yield (EventBatch, last_timestamp) bucketed device batches."""
         max_cap = BATCH_BUCKETS[-1]
         for start in range(0, len(events), max_cap):
             chunk = events[start:start + max_cap]
@@ -148,8 +154,12 @@ class QueryRuntime(Receiver):
             tss = [e.timestamp for e in chunk]
             kinds = [EXPIRED if e.is_expired else CURRENT for e in chunk]
             cap = bucket_capacity(len(chunk))
-            batch = batch_from_rows(self.in_schema, rows, tss, cap, kinds)
-            self.process_batch(batch, chunk[-1].timestamp)
+            yield (batch_from_rows(schema, rows, tss, cap, kinds),
+                   chunk[-1].timestamp)
+
+    def receive(self, events: list[Event]) -> None:
+        for batch, last_ts in self.encode_chunks(self.in_schema, events):
+            self.process_batch(batch, last_ts)
 
     def process_batch(self, batch: EventBatch, timestamp: int,
                       now: Optional[int] = None) -> None:
@@ -159,9 +169,25 @@ class QueryRuntime(Receiver):
         with self._lock:
             step = self._step_for(batch.capacity)
             self.states, out, due = step(self.states, batch, now_dev)
-        out_host, due_host = jax.device_get((out, due))
-        if self._has_timers:
+        self._dispatch_output(out, timestamp,
+                              due=due if self._has_timers else None)
+
+    def _dispatch_output(self, out, timestamp: int, due=None) -> None:
+        """Raw-batch observers, timer scheduling, and (only when someone
+        listens) host row decode + handler/callback delivery."""
+        for cb in self.batch_callbacks:
+            cb(out)
+        decode = bool(self.output_handlers or
+                      self.callback_handler.callbacks)
+        if decode and due is not None:
+            out_host, due_host = jax.device_get((out, due))
             self._schedule(int(due_host))
+        elif decode:
+            out_host = jax.device_get(out)
+        else:
+            if due is not None:
+                self._schedule(int(jax.device_get(due)))
+            return
         out_rows = rows_from_batch(self.out_schema.types, out_host)
         if not out_rows:
             return
@@ -206,6 +232,73 @@ class StreamCallbackReceiver(Receiver):
         self.callback.receive(events)
 
 
+class PatternStreamReceiver(Receiver):
+    """Junction subscriber feeding one stream of a pattern query
+    (= PatternMultiProcessStreamReceiver, .../state/receiver/*.java:29)."""
+
+    def __init__(self, runtime: "PatternQueryRuntime", stream_id: str):
+        self.runtime = runtime
+        self.stream_id = stream_id
+
+    def receive(self, events):
+        self.runtime.process_stream_events(self.stream_id, events)
+
+    def process_batch(self, batch, last_ts):
+        self.runtime.process_pattern_batch(self.stream_id, batch, last_ts)
+
+
+class PatternQueryRuntime(QueryRuntime):
+    """Pattern/sequence query: the NFA engine feeds the selector chain.
+    One receiver per distinct input stream; all share the pending-match
+    table (reference: StateStreamRuntime + per-state processors).
+
+    The base-class `states` tuple holds the selector operator states; the
+    NFA pending table lives in `nfa_state`."""
+
+    def __init__(self, name: str, engine: NfaEngine,
+                 sel_ops: list[Operator], app: "SiddhiAppRuntime"):
+        super().__init__(name, sel_ops, engine.match_schema, app)
+        self.engine = engine
+        self.nfa_state = engine.init_state()
+        self._stream_steps: dict[str, Callable] = {}
+
+    def receive(self, events: list[Event]) -> None:
+        raise RuntimeError(
+            "pattern runtimes consume via per-stream PatternStreamReceivers")
+
+    def _step_for_stream(self, stream_id: str) -> Callable:
+        fn = self._stream_steps.get(stream_id)
+        if fn is None:
+            nfa_step = self.engine.make_stream_step(stream_id)
+            sel_ops = self.operators
+
+            def step(nfa_state, sel_states, batch: EventBatch, now):
+                nfa_state, match = nfa_step(nfa_state, batch, now)
+                new_sel = []
+                for op, st in zip(sel_ops, sel_states):
+                    st, match = op.step(st, match, now)
+                    new_sel.append(st)
+                return nfa_state, tuple(new_sel), match
+
+            fn = jax.jit(step)
+            self._stream_steps[stream_id] = fn
+        return fn
+
+    def process_stream_events(self, stream_id: str, events) -> None:
+        schema = self.app.schemas[stream_id]
+        for batch, last_ts in self.encode_chunks(schema, events):
+            self.process_pattern_batch(stream_id, batch, last_ts)
+
+    def process_pattern_batch(self, stream_id: str, batch: EventBatch,
+                              timestamp: int) -> None:
+        now = jnp.asarray(self.app.current_time(), dtype=jnp.int64)
+        with self._lock:
+            step = self._step_for_stream(stream_id)
+            self.nfa_state, self.states, out = step(
+                self.nfa_state, self.states, batch, now)
+        self._dispatch_output(out, timestamp)
+
+
 class SiddhiAppRuntime:
     """Per-app container: junctions, query runtimes, handlers, lifecycle
     (reference SiddhiAppRuntimeImpl: start/shutdown :440-655,
@@ -233,9 +326,15 @@ class SiddhiAppRuntime:
         return int(time.time() * 1000)
 
     def on_ingest(self, stream_id: str, events: list[Event]) -> None:
-        if self._playback and events:
-            self._playback_time = events[-1].timestamp
-            self.scheduler.advance_to(self._playback_time)
+        if events:
+            self.on_ingest_ts(events[-1].timestamp)
+
+    def on_ingest_ts(self, last_ts: int) -> None:
+        """Advance the playback clock (and due timers) to an ingested
+        timestamp — shared by the row and columnar ingest paths."""
+        if self._playback:
+            self._playback_time = last_ts
+            self.scheduler.advance_to(last_ts)
 
     # -- wiring ----------------------------------------------------------
     def junction_for(self, stream_id: str,
@@ -366,10 +465,12 @@ class Planner:
     def plan_query(self, q: A.Query, default_name: str) -> None:
         app = self.app
         name = q.name or default_name
+        if isinstance(q.input, A.StateInputStream):
+            return self.plan_pattern_query(q, name)
         if not isinstance(q.input, A.SingleInputStream):
             raise CompileError(
-                f"query '{name}': only single-stream queries supported in "
-                "this stage")
+                f"query '{name}': only single-stream and pattern queries "
+                "supported in this stage")
         sin = q.input
         schema = app.schemas.get(sin.stream_id)
         if schema is None:
@@ -443,6 +544,49 @@ class Planner:
                                                               app)
             qr.output_handlers.append(
                 InsertIntoStreamHandler(tj, out_type))
+
+    # -- pattern / sequence queries --------------------------------------
+    def plan_pattern_query(self, q: A.Query, name: str) -> None:
+        app = self.app
+        sin: A.StateInputStream = q.input
+        out = q.output
+        if isinstance(out, (A.InsertIntoStream, A.ReturnStream)):
+            out_type = out.output_event_type
+        else:
+            raise CompileError(f"query '{name}': table output not yet "
+                               "supported")
+        target = out.target if isinstance(out, A.InsertIntoStream) else name
+        current_on = out_type in ("current", "all")
+        expired_on = out_type in ("expired", "all")
+
+        compiler = NfaCompiler(app.schemas, sin.state_type)
+        slots, states = compiler.compile(sin.state)
+        engine = NfaEngine(slots, states, sin.state_type, sin.within_ms)
+        scope = MatchScope(slots, engine.col_index)
+
+        sel_ops: list[Operator] = []
+        if selector_needs_aggregation(q.selector):
+            sel_ops.append(AggregateOp(
+                q.selector, engine.match_schema, target, scope,
+                batch_mode=False, expired_possible=False,
+                current_on=current_on, expired_on=expired_on))
+        else:
+            sel_ops.append(ProjectOp(
+                q.selector, engine.match_schema, target, scope,
+                current_on=current_on, expired_on=expired_on))
+
+        if name in app.queries:
+            raise CompileError(f"duplicate query name '{name}'")
+        qr = PatternQueryRuntime(name, engine, sel_ops, app)
+        for sid in sorted({s.stream_id for s in slots}):
+            app.junctions[sid].subscribe(PatternStreamReceiver(qr, sid))
+        app.queries[name] = qr
+        if isinstance(out, A.InsertIntoStream):
+            tj = app.junction_for(out.target, qr.out_schema)
+            if out.target not in app.input_handlers:
+                app.input_handlers[out.target] = InputHandler(
+                    out.target, tj, app)
+            qr.output_handlers.append(InsertIntoStreamHandler(tj, out_type))
 
 
 def _expect(params, n, name):

@@ -59,6 +59,22 @@ class StreamJunction:
         for r in list(self.receivers):
             r.receive(events)
 
+    def publish_batch(self, batch, last_ts: int) -> None:
+        """Columnar fast path: receivers that implement process_batch get
+        the device batch directly; row-oriented receivers get decoded
+        events (decoded at most once)."""
+        decoded = None
+        for r in list(self.receivers):
+            if hasattr(r, "process_batch"):
+                r.process_batch(batch, last_ts)
+            else:
+                if decoded is None:
+                    from .event import EXPIRED, rows_from_batch
+                    rows = rows_from_batch(self.schema.types, batch)
+                    decoded = [Event(ts, vals, is_expired=(kind == EXPIRED))
+                               for ts, kind, vals in rows]
+                r.receive(decoded)
+
 
 class InputHandler:
     """User entry point for one stream (InputHandler.send overloads:
@@ -88,6 +104,29 @@ class InputHandler:
             events = [Event(timestamp=now(), data=tuple(data))]
         self.app.on_ingest(self.stream_id, events)
         self.junction.publish(events)
+
+    def send_arrays(self, ts, cols) -> None:
+        """Columnar ingest: numpy timestamp + data column arrays
+        (STRING columns as dictionary codes). Device batches with no
+        per-row Python — the framework's intended high-throughput operating
+        mode. Capacities are bucketed so jit caches stay warm."""
+        from .event import batch_from_columns
+        from .runtime import BATCH_BUCKETS, bucket_capacity
+        if not self.app.running:
+            raise RuntimeError(
+                f"app '{self.app.name}' is not running; call start() first")
+        n = len(ts)
+        if n == 0:
+            return
+        max_cap = BATCH_BUCKETS[-1]
+        for start in range(0, n, max_cap):
+            t = ts[start:start + max_cap]
+            c = [col[start:start + max_cap] for col in cols]
+            batch = batch_from_columns(self.junction.schema, t, c,
+                                       capacity=bucket_capacity(len(t)))
+            last_ts = int(t[-1])
+            self.app.on_ingest_ts(last_ts)
+            self.junction.publish_batch(batch, last_ts)
 
 
 class StreamCallback(Receiver):

@@ -1,0 +1,790 @@
+"""NFA pattern/sequence engine — the CEP core.
+
+Reference mapping (modules/siddhi-core/.../query/input/stream/state/):
+- StreamPreStateProcessor.java:364-403 (processAndReturn: per pending
+  partial match, set this state's slot, run the filter chain, forward on
+  match; pattern keeps unmatched pendings, sequence kills them)
+- StreamPostStateProcessor.java:64-85 (stateChanged, forward to
+  nextStatePreProcessor.addState / nextEveryStatePreProcessor.addEveryState)
+- StreamPreStateProcessor.addEveryState:219-241 ('every' re-arm: clone with
+  slots >= stateId cleared)
+- StreamPreStateProcessor.updateState:308-323 (newAndEvery -> pending after
+  each event; here: rows only see events with index > their born counter)
+- StreamPreStateProcessor.isExpired:118-129 (within pruning)
+- CountPreStateProcessor / CountPostStateProcessor (count <m:n>: the pending
+  absorbs events into one slot; at min count it ALSO starts answering the
+  next state's condition — the reference shares the StateEvent object
+  between both pendings, here it is one row with two active personas)
+
+TPU design: ONE device table of partial matches (struct-of-arrays, capacity
+M). Each row: waiting-state id, captured slot columns [M, cap], fill
+counts, born counter, seq. A batch of B events is consumed by a lax.scan
+over rows; inside the scan every pending row is tested in parallel
+(vectorized over M — the 'vmap over pending matches' axis). All state
+transitions are masked scatter updates; appends (every re-arms) go to free
+rows found with one argsort per event.
+
+Capacity: the reference's pending lists are unbounded; here M is static.
+Overflow drops the OLDEST re-arm appends and counts them (state
+['overflow']) — no silent loss.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.event import (CURRENT, Attribute, EventBatch, StreamSchema)
+from ..core.types import AttrType, np_dtype
+from ..lang import ast as A
+from .expr import Col, CompileError, CompiledExpr, Scope, compile_expression
+
+NEG1 = jnp.int32(-1)
+POS_INF = jnp.int64(2 ** 62)
+
+
+# ---------------------------------------------------------------------------
+# compile: AST state tree -> linear NFA
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SlotSpec:
+    """One StateEvent slot (= one stream state element's capture)."""
+    ref: Optional[str]          # e1 / e2 ... (event_ref)
+    stream_id: str
+    schema: StreamSchema
+    cap: int                    # 1 for plain states, >1 for counting states
+
+
+@dataclasses.dataclass
+class NfaStateSpec:
+    idx: int
+    slot: int
+    stream_id: str
+    cond_ast: Optional[A.Expression]
+    next_idx: int               # -1 => completing this state emits a match
+    every_arm: int              # -1 or state idx re-armed on forward
+    clear_from: int             # first slot cleared on re-arm
+    is_start: bool = False
+    always_armed: bool = False  # implicit empty pending at every event
+    armed_once: bool = False    # explicit initial pending at t=0
+    min_count: int = 1
+    max_count: int = 1          # -1 == unbounded
+    cond: Optional[CompiledExpr] = None
+
+    @property
+    def is_counting(self) -> bool:
+        return not (self.min_count == 1 and self.max_count == 1)
+
+
+class NfaCompiler:
+    """StateInputStream AST -> (slots, states). Linear chains of stream
+    states with filters, counts <m:n>/+/*, and 'every' scopes; logical
+    and/or and absent states are rejected for now (follow-up stage)."""
+
+    def __init__(self, schemas: dict, state_type: str, count_cap: int = 16):
+        self.schemas = schemas
+        self.state_type = state_type
+        self.count_cap = count_cap
+        self.slots: list[SlotSpec] = []
+        self.states: list[NfaStateSpec] = []
+
+    def compile(self, root: A.StateElement):
+        entry, exits = self._element(root)
+        for e in exits:
+            self.states[e].next_idx = -1
+        start = self.states[entry]
+        start.is_start = True
+        if self.state_type == "sequence":
+            start.always_armed = True
+        elif start.every_arm == start.idx or (
+                start.idx in [self.states[e].every_arm
+                              for e in range(len(self.states))]
+                and self._single_state_scope(start)):
+            start.always_armed = True
+        else:
+            start.armed_once = True
+        # single-state every scopes collapse re-arm into always_armed
+        for st in self.states:
+            if st.is_start and any(
+                    s.every_arm == st.idx and s.idx == st.idx
+                    for s in self.states):
+                st.always_armed = True
+                st.armed_once = False
+        return self.slots, self.states
+
+    def _single_state_scope(self, start) -> bool:
+        return any(s.every_arm == start.idx and s.idx == start.idx
+                   for s in self.states)
+
+    # -- element walkers -------------------------------------------------
+    def _element(self, el: A.StateElement):
+        """Returns (entry_state_idx, [exit_state_idxs])."""
+        if isinstance(el, A.AbsentStreamStateElement):
+            raise CompileError("absent patterns (not ... for) not yet "
+                               "supported")
+        if isinstance(el, A.StreamStateElement):
+            return self._stream(el, cap=1, min_c=1, max_c=1)
+        if isinstance(el, A.CountStateElement):
+            mx = el.max_count
+            cap = self.count_cap if mx == -1 else max(mx, 1)
+            return self._stream(el.stream, cap=cap, min_c=el.min_count,
+                                max_c=mx)
+        if isinstance(el, A.NextStateElement):
+            e1, x1 = self._element(el.state)
+            e2, x2 = self._element(el.next)
+            for x in x1:
+                self.states[x].next_idx = e2
+            return e1, x2
+        if isinstance(el, A.EveryStateElement):
+            entry, exits = self._element(el.state)
+            scope_first_slot = self.states[entry].slot
+            for x in exits:
+                self.states[x].every_arm = entry
+                self.states[x].clear_from = scope_first_slot
+            return entry, exits
+        if isinstance(el, A.LogicalStateElement):
+            raise CompileError("logical (and/or) pattern states not yet "
+                               "supported")
+        raise CompileError(f"unsupported state element {type(el).__name__}")
+
+    def _stream(self, el: A.StreamStateElement, cap, min_c, max_c):
+        sin = el.stream
+        schema = self.schemas.get(sin.stream_id)
+        if schema is None:
+            raise CompileError(f"undefined stream '{sin.stream_id}' in "
+                               "pattern")
+        conds = []
+        for h in sin.handlers:
+            if isinstance(h, A.Filter):
+                conds.append(h.expression)
+            else:
+                raise CompileError(
+                    "windows/stream functions inside pattern states are not "
+                    "supported")
+        cond = None
+        if conds:
+            cond = conds[0]
+            for c in conds[1:]:
+                cond = A.And(cond, c)
+        slot = len(self.slots)
+        self.slots.append(SlotSpec(el.event_ref, sin.stream_id, schema, cap))
+        idx = len(self.states)
+        self.states.append(NfaStateSpec(
+            idx=idx, slot=slot, stream_id=sin.stream_id, cond_ast=cond,
+            next_idx=-1, every_arm=-1, clear_from=0,
+            min_count=min_c, max_count=max_c))
+        return idx, [idx]
+
+
+# ---------------------------------------------------------------------------
+# pattern variable scope
+# ---------------------------------------------------------------------------
+
+
+class PatternScope(Scope):
+    """Resolves e1.attr / e1[i].attr / bare stream-name.attr over the match
+    slots. Used both for state conditions (where the state's own slot is the
+    incoming event) and for the selector over the match batch.
+
+    Unindexed references to counting slots resolve to index 0 with
+    last-fallback semantics handled by the storage (reference
+    ExpressionParser default index SiddhiConstants.UNKNOWN_STATE -> 0)."""
+
+    def __init__(self, slots: list[SlotSpec], own_slot: Optional[int] = None):
+        self.slots = slots
+        self.own_slot = own_slot  # set for state filter conditions: bare
+        # attribute names bind to the state's own stream first
+        # (SingleInputStreamParser binds filter vars to the state's meta)
+
+    def _find(self, var: A.Variable):
+        ref = var.stream_ref
+        if ref is not None:
+            for j, s in enumerate(self.slots):
+                if s.ref == ref:
+                    return j
+            matches = [j for j, s in enumerate(self.slots)
+                       if s.stream_id == ref]
+            if len(matches) == 1:
+                return matches[0]
+            if len(matches) > 1:
+                raise CompileError(
+                    f"ambiguous stream reference '{ref}' in pattern")
+            raise CompileError(f"unknown event reference '{ref}'")
+        if self.own_slot is not None and \
+                var.attribute in self.slots[self.own_slot].schema.names:
+            return self.own_slot
+        # unprefixed: unique attribute across slots
+        matches = [j for j, s in enumerate(self.slots)
+                   if var.attribute in s.schema.names]
+        if len(matches) == 1:
+            return matches[0]
+        raise CompileError(
+            f"attribute '{var.attribute}' is "
+            + ("ambiguous" if matches else "unknown") + " in pattern scope")
+
+    def resolve(self, var: A.Variable):
+        j = self._find(var)
+        spec = self.slots[j]
+        a = spec.schema.index_of(var.attribute)
+        idx = var.index
+        if idx is None:
+            if self.own_slot == j:
+                # inside a state's own condition the unindexed reference is
+                # the incoming event (the slot position being filled)
+                return ("slot_last", j, a, 0), spec.schema.types[a]
+            idx = 0
+        if idx == "last":
+            idx = ("last", 0)
+        if isinstance(idx, tuple):
+            key = ("slot_last", j, a, idx[1])
+        else:
+            if not isinstance(idx, int) or idx < 0 or idx >= spec.cap:
+                raise CompileError(
+                    f"event index {idx!r} out of range for '{spec.ref}' "
+                    f"(capacity {spec.cap})")
+            key = ("slot", j, a, idx)
+        return key, spec.schema.types[a]
+
+
+class MatchScope(PatternScope):
+    """Selector scope over the flattened match batch: e1[i].attr resolves to
+    the corresponding flattened column."""
+
+    def __init__(self, slots, col_index):
+        super().__init__(slots)
+        self.col_index = col_index
+
+    def resolve(self, var: A.Variable):
+        key, t = super().resolve(var)
+        if key[0] == "slot":
+            _, j, a, c = key
+            return ("attr", self.col_index[(j, a, c)]), t
+        raise CompileError(
+            "e[last] references in select clauses are not supported yet")
+
+
+# ---------------------------------------------------------------------------
+# the device NFA
+# ---------------------------------------------------------------------------
+
+
+class NfaEngine:
+    """Holds compiled states and builds per-stream step functions over the
+    pending-match table."""
+
+    def __init__(self, slots: list[SlotSpec], states: list[NfaStateSpec],
+                 state_type: str, within_ms: Optional[int],
+                 capacity: int = 128, out_capacity: int = 256):
+        self.slots = slots
+        self.states = states
+        self.state_type = state_type
+        self.within_ms = within_ms
+        self.M = capacity
+        self.OUT = out_capacity
+        for st in states:
+            if st.cond_ast is not None:
+                st.cond = compile_expression(
+                    st.cond_ast, PatternScope(slots, own_slot=st.slot))
+                if st.cond.type is not AttrType.BOOL:
+                    raise CompileError("pattern filter must be BOOL")
+
+        # flattened match-batch schema: slot j attr a copy c
+        attrs = []
+        self.col_index: dict = {}
+        for j, s in enumerate(slots):
+            for a, att in enumerate(s.schema.attributes):
+                for c in range(s.cap):
+                    self.col_index[(j, a, c)] = len(attrs)
+                    nm = (f"{s.ref or s.stream_id}_{att.name}"
+                          + (f"_{c}" if s.cap > 1 else ""))
+                    attrs.append(Attribute(nm, att.type))
+        self.match_schema = StreamSchema("#match", tuple(attrs))
+
+    # -- state pytree ----------------------------------------------------
+    def init_state(self):
+        M = self.M
+        slots_buf = []
+        for s in self.slots:
+            slots_buf.append({
+                "cols": tuple(jnp.zeros((M, s.cap), dtype=np_dtype(t))
+                              for t in s.schema.types),
+                "nulls": tuple(jnp.ones((M, s.cap), dtype=jnp.bool_)
+                               for _ in s.schema.types),
+                "ts": jnp.zeros((M, s.cap), dtype=jnp.int64),
+                "n": jnp.zeros((M,), dtype=jnp.int32),
+            })
+        state = jnp.full((self.M,), len(self.states), dtype=jnp.int32)
+        valid = jnp.zeros((M,), dtype=jnp.bool_)
+        armed_once = [st.idx for st in self.states if st.armed_once]
+        if armed_once:
+            # explicit initial pending at the start state
+            state = state.at[0].set(armed_once[0])
+            valid = valid.at[0].set(True)
+        return {
+            "state": state,
+            "valid": valid,
+            "ts0": jnp.zeros((M,), dtype=jnp.int64),
+            "has_ts0": jnp.zeros((M,), dtype=jnp.bool_),
+            "born": jnp.full((M,), -1, dtype=jnp.int64),
+            "min_at": jnp.full((M,), -1, dtype=jnp.int64),
+            "seq": jnp.arange(M, dtype=jnp.int64),
+            "slots": tuple(slots_buf),
+            "next_seq": jnp.int64(M),
+            "counter": jnp.int64(0),
+            "overflow": jnp.int64(0),
+        }
+
+    # -- per-event core (vectorized over the M pending rows) -------------
+    def _slot_env(self, table, ev_cols, ev_nulls, own_slot: int):
+        """Env for condition eval: own slot's 'current' view = incoming
+        event appended; other slots from the table."""
+        env = {}
+        for j, spec in enumerate(self.slots):
+            buf = table["slots"][j]
+            for a in range(len(spec.schema.types)):
+                for c in range(spec.cap):
+                    vals = buf["cols"][a][:, c]
+                    nulls = buf["nulls"][a][:, c]
+                    if j == own_slot:
+                        # the event lands at position n (post-append view)
+                        at_n = buf["n"] == c
+                        vals = jnp.where(at_n, ev_cols[a], vals)
+                        nulls = jnp.where(at_n, ev_nulls[a], nulls)
+                    env[("slot", j, a, c)] = Col(vals, nulls)
+        # ("slot_last", j, a, k): gather n-1-k
+        for j, spec in enumerate(self.slots):
+            buf = table["slots"][j]
+            n_eff = buf["n"] + (1 if j == own_slot else 0)
+            for a in range(len(spec.schema.types)):
+                for kback in range(min(spec.cap, 4)):
+                    pos = jnp.clip(n_eff - 1 - kback, 0, spec.cap - 1)
+                    vals = jnp.take_along_axis(
+                        buf["cols"][a], pos[:, None], axis=1)[:, 0]
+                    nulls = jnp.take_along_axis(
+                        buf["nulls"][a], pos[:, None], axis=1)[:, 0]
+                    if j == own_slot:
+                        at_n = pos == jnp.clip(buf["n"], 0, spec.cap - 1)
+                        sel = at_n & (kback == 0)
+                        vals = jnp.where(sel, ev_cols[a], vals)
+                        nulls = jnp.where(sel, ev_nulls[a], nulls)
+                    env[("slot_last", j, a, kback)] = Col(vals, nulls)
+        return env
+
+    def make_stream_step(self, stream_id: str):
+        """(table, EventBatch, now) -> (table', match_batch)."""
+        consuming = [st for st in self.states if st.stream_id == stream_id]
+        # counting states whose forwarded persona answers state st
+        persona_sources = {
+            st.idx: [cs for cs in self.states
+                     if cs.is_counting and cs.next_idx == st.idx]
+            for st in consuming}
+
+        def event_body(carry, ev):
+            table, out = carry
+            (ev_ts, ev_kind, ev_valid, ev_cols, ev_nulls) = ev
+            M = self.M
+            counter = table["counter"]
+            live = table["valid"]
+            mature = live & (table["born"] < counter)
+
+            # within expiry (any valid event advances observed time)
+            if self.within_ms is not None:
+                expired = (mature & table["has_ts0"] &
+                           (jnp.abs(ev_ts - table["ts0"]) > self.within_ms)
+                           & ev_valid)
+                live = live & ~expired
+                mature = mature & live
+
+            is_current = ev_valid & (ev_kind == CURRENT)
+
+            matched_any = jnp.zeros((M,), jnp.bool_)
+            rearm_target = jnp.full((M,), -1, jnp.int32)
+            rearm_clear = jnp.zeros((M,), jnp.int32)
+            out_rows = jnp.zeros((M,), jnp.bool_)
+            new_state = table["state"]
+            new_valid = live
+            new_min_at = table["min_at"]
+            slots_upd = table["slots"]
+            seq_kill = jnp.zeros((M,), jnp.bool_)
+
+            pre_state = table["state"]  # all personas test pre-event state
+
+            for st in consuming:
+                own = st.slot
+                env = self._slot_env(table, ev_cols, ev_nulls, own)
+                if st.cond is not None:
+                    c = st.cond.fn(env)
+                    cond_ok = c.values & ~c.nulls
+                    cond_ok = jnp.broadcast_to(cond_ok, (M,))
+                else:
+                    cond_ok = jnp.ones((M,), jnp.bool_)
+
+                normal = mature & (pre_state == st.idx)
+                persona = jnp.zeros((M,), jnp.bool_)
+                for cs in persona_sources[st.idx]:
+                    pn = table["slots"][cs.slot]["n"]
+                    persona = persona | (
+                        mature & (pre_state == cs.idx) &
+                        (pn >= cs.min_count) &
+                        (table["min_at"] < counter))
+                at_state = (normal | persona) & is_current
+                hit = at_state & cond_ok
+
+                # fill own slot at position n (persona rows have n=0 there)
+                buf = slots_upd[own]
+                cap = self.slots[own].cap
+                n = buf["n"]
+                if st.is_counting:
+                    can_fill = hit & (n < cap) & (
+                        (st.max_count == -1) | (n < st.max_count))
+                else:
+                    can_fill = hit
+                    n = jnp.zeros_like(n)  # plain slots always write pos 0
+                pos = jnp.clip(n, 0, cap - 1)
+                onehot = (jnp.arange(cap)[None, :] == pos[:, None]) & \
+                    can_fill[:, None]
+                new_cols = tuple(
+                    jnp.where(onehot, ev_cols[a], col)
+                    for a, col in enumerate(buf["cols"]))
+                new_nulls = tuple(
+                    jnp.where(onehot, ev_nulls[a], nl)
+                    for a, nl in enumerate(buf["nulls"]))
+                new_ts = jnp.where(onehot, ev_ts, buf["ts"])
+                filled_n = (buf["n"] + 1 if st.is_counting
+                            else jnp.ones_like(buf["n"]))
+                new_n = jnp.where(can_fill, filled_n, buf["n"])
+                slots_upd = tuple(
+                    {"cols": new_cols, "nulls": new_nulls,
+                     "ts": new_ts, "n": new_n} if j == own else b
+                    for j, b in enumerate(slots_upd))
+                matched_any = matched_any | can_fill
+
+                if st.is_counting:
+                    nn = new_n
+                    just_min = can_fill & (nn == st.min_count)
+                    maxed = can_fill & (st.max_count != -1) & \
+                        (nn == st.max_count)
+                    # persona rows moving INTO this counting state
+                    new_state = jnp.where(can_fill,
+                                          jnp.int32(st.idx), new_state)
+                    new_min_at = jnp.where(just_min, counter, new_min_at)
+                    if st.next_idx == -1:
+                        out_rows = out_rows | just_min
+                        new_valid = jnp.where(maxed, False, new_valid)
+                    else:
+                        new_state = jnp.where(
+                            maxed, jnp.int32(st.next_idx), new_state)
+                    fwd = just_min
+                else:
+                    if st.next_idx == -1:
+                        out_rows = out_rows | hit
+                        new_valid = jnp.where(hit, False, new_valid)
+                    else:
+                        new_state = jnp.where(
+                            hit, jnp.int32(st.next_idx), new_state)
+                    fwd = hit
+                if st.every_arm >= 0:
+                    rearm_target = jnp.where(fwd, jnp.int32(st.every_arm),
+                                             rearm_target)
+                    rearm_clear = jnp.where(fwd, jnp.int32(st.clear_from),
+                                            rearm_clear)
+                if self.state_type == "sequence" and not st.is_counting:
+                    seq_kill = seq_kill | (normal & is_current & ~cond_ok)
+
+            # ts0 bookkeeping (first captured event)
+            got_first = matched_any & ~table["has_ts0"]
+            ts0 = jnp.where(got_first, ev_ts, table["ts0"])
+            has_ts0 = table["has_ts0"] | got_first
+
+            new_valid = new_valid & ~seq_kill
+
+            table2 = {**table, "state": new_state, "valid": new_valid,
+                      "ts0": ts0, "has_ts0": has_ts0, "slots": slots_upd,
+                      "min_at": new_min_at}
+
+            # every re-arms (cleared clones, born=now)
+            do_rearm = (rearm_target >= 0) & is_current
+            table2 = self._append_rows(
+                table2, [("rearm", do_rearm, rearm_target, rearm_clear)],
+                counter)
+
+            # completed matches -> output buffer (seq order within event)
+            out = self._emit(out, table, slots_upd, out_rows, ev_ts,
+                             table["seq"])
+
+            # implicit always-armed start states (virtual empty pending)
+            table2, out = self._virtual_start(table2, out, ev_ts, ev_kind,
+                                              ev_valid, ev_cols, ev_nulls,
+                                              counter)
+
+            table2 = {**table2, "counter": counter + 1}
+            return (table2, out), None
+
+        def step(table, batch: EventBatch, now):
+            out = {
+                "cols": tuple(jnp.zeros((self.OUT,), dtype=np_dtype(t))
+                              for t in self.match_schema.types),
+                "nulls": tuple(jnp.ones((self.OUT,), dtype=jnp.bool_)
+                               for _ in self.match_schema.types),
+                "ts": jnp.zeros((self.OUT,), dtype=jnp.int64),
+                "n": jnp.int64(0),
+                "lost": jnp.int64(0),
+            }
+            evs = (batch.ts, batch.kind, batch.valid,
+                   tuple(batch.cols), tuple(batch.nulls))
+            (table, out), _ = jax.lax.scan(event_body, (table, out), evs)
+            match_batch = EventBatch(
+                ts=out["ts"],
+                cols=out["cols"],
+                nulls=out["nulls"],
+                kind=jnp.zeros((self.OUT,), jnp.int32),
+                valid=jnp.arange(self.OUT) < out["n"],
+            )
+            table = {**table, "overflow": table["overflow"] + out["lost"]}
+            return table, match_batch
+
+        return step
+
+    # -- helpers ---------------------------------------------------------
+    def _append_rows(self, table, appends, counter):
+        """Place append-candidate rows into free table slots."""
+        M = self.M
+        free = ~table["valid"]
+        # free slot ranking: invalid rows first by index
+        free_rank = jnp.cumsum(free.astype(jnp.int32)) - 1  # rank per pos
+        free_pos = jnp.argsort(~free)  # free positions first
+        n_free = jnp.sum(free.astype(jnp.int32))
+        total_lost = jnp.int64(0)
+
+        k = jnp.int32(0)
+        out_table = table
+        for name, mask, target_state, clear_from in appends:
+            cnt = jnp.cumsum(mask.astype(jnp.int32)) - 1  # per-source rank
+            dest_rank = k + cnt
+            ok = mask & (dest_rank < n_free)
+            lost = jnp.sum((mask & ~ok).astype(jnp.int64))
+            total_lost = total_lost + lost
+            dest = free_pos[jnp.clip(dest_rank, 0, M - 1)]
+            dest = jnp.where(ok, dest, M)  # M => dropped
+            out_table = self._scatter_append(
+                out_table, table, dest, ok, target_state, clear_from,
+                counter)
+            k = k + jnp.sum(mask.astype(jnp.int32))
+        out_table = {**out_table,
+                     "overflow": out_table["overflow"] + total_lost}
+        return out_table
+
+    def _scatter_append(self, table, src_table, dest, ok, target_state,
+                        clear_from, counter):
+        """Copy source rows (with slots >= clear_from cleared) into dest
+        positions as fresh pendings."""
+        M = self.M
+        d = jnp.where(ok, dest, M)
+        state = table["state"].at[d].set(target_state, mode="drop")
+        valid = table["valid"].at[d].set(True, mode="drop")
+        born = table["born"].at[d].set(counter, mode="drop")
+        min_at = table["min_at"].at[d].set(jnp.int64(-1), mode="drop")
+        table = {**table, "min_at": min_at}
+        seq = table["seq"].at[d].set(
+            table["next_seq"] + jnp.cumsum(ok.astype(jnp.int64)) - 1,
+            mode="drop")
+        next_seq = table["next_seq"] + jnp.sum(ok.astype(jnp.int64))
+        new_slots = []
+        any_kept_slot = jnp.zeros((M,), jnp.bool_)
+        ts0 = table["ts0"]
+        has_ts0 = table["has_ts0"]
+        for j, spec in enumerate(self.slots):
+            sbuf = src_table["slots"][j]
+            tbuf = table["slots"][j]
+            cleared = j >= clear_from  # [M] bool (clear this slot?)
+            keep = ~cleared
+            cols = tuple(
+                tc.at[d].set(jnp.where(keep[:, None], sc,
+                                       jnp.zeros_like(sc)), mode="drop")
+                for tc, sc in zip(tbuf["cols"], sbuf["cols"]))
+            nulls = tuple(
+                tn.at[d].set(jnp.where(keep[:, None], sn,
+                                       jnp.ones_like(sn)), mode="drop")
+                for tn, sn in zip(tbuf["nulls"], sbuf["nulls"]))
+            ts = tbuf["ts"].at[d].set(
+                jnp.where(keep[:, None], sbuf["ts"],
+                          jnp.zeros_like(sbuf["ts"])), mode="drop")
+            n = tbuf["n"].at[d].set(
+                jnp.where(keep, sbuf["n"], 0), mode="drop")
+            any_kept_slot = any_kept_slot | (keep & (sbuf["n"] > 0))
+            new_slots.append({"cols": cols, "nulls": nulls, "ts": ts,
+                              "n": n})
+        # ts0 of the appended row: kept slots' first ts if any, else unset
+        src_ts0_keep = any_kept_slot
+        ts0 = ts0.at[d].set(jnp.where(src_ts0_keep, src_table["ts0"], 0),
+                            mode="drop")
+        has_ts0 = has_ts0.at[d].set(src_ts0_keep, mode="drop")
+        return {**table, "state": state, "valid": valid, "born": born,
+                "seq": seq, "next_seq": next_seq,
+                "slots": tuple(new_slots), "ts0": ts0, "has_ts0": has_ts0}
+
+    def _emit(self, out, table_before, slots_upd, out_rows, ev_ts, seq):
+        """Scatter completed matches into the output buffer in seq order."""
+        M = self.M
+        OUT = self.OUT
+        order = jnp.argsort(jnp.where(out_rows, seq, POS_INF))
+        take = order  # first n_out entries are emitting rows
+        n_emit = jnp.sum(out_rows.astype(jnp.int64))
+        dest = out["n"] + jnp.arange(M, dtype=jnp.int64)
+        ok = (jnp.arange(M) < n_emit) & (dest < OUT)
+        d = jnp.where(ok, dest, OUT)
+        lost = jnp.maximum(n_emit - jnp.sum(ok.astype(jnp.int64)), 0)
+        cols = list(out["cols"])
+        nulls = list(out["nulls"])
+        for j, spec in enumerate(self.slots):
+            buf = slots_upd[j]
+            for a in range(len(spec.schema.types)):
+                for c in range(spec.cap):
+                    ci = self.col_index[(j, a, c)]
+                    src_v = buf["cols"][a][take, c]
+                    src_n = buf["nulls"][a][take, c]
+                    cols[ci] = cols[ci].at[d].set(src_v, mode="drop")
+                    nulls[ci] = nulls[ci].at[d].set(src_n, mode="drop")
+        ts = out["ts"].at[d].set(ev_ts, mode="drop")
+        return {"cols": tuple(cols), "nulls": tuple(nulls), "ts": ts,
+                "n": out["n"] + jnp.minimum(n_emit, OUT - out["n"]),
+                "lost": out["lost"] + lost}
+
+    def _virtual_start(self, table, out, ev_ts, ev_kind, ev_valid, ev_cols,
+                       ev_nulls, counter):
+        """Implicit always-armed start states: test the event directly
+        against an empty pending (one virtual row)."""
+        starts = [st for st in self.states if st.always_armed]
+        if not starts:
+            return table, out
+        for st in starts:
+            env = self._virtual_env(st, ev_cols, ev_nulls)
+            if st.cond is not None:
+                c = st.cond.fn(env)
+                ok = c.values & ~c.nulls
+                # scalar eval (virtual row): reduce if vectorized over M
+                ok = jnp.reshape(ok, (-1,))[0] if ok.ndim else ok
+            else:
+                ok = jnp.bool_(True)
+            hit = ok & ev_valid & (ev_kind == CURRENT)
+            if st.is_counting:
+                reached_min = st.min_count <= 1
+                if st.next_idx == -1 and reached_min:
+                    out = self._emit_virtual(out, st, ev_cols, ev_nulls,
+                                             ev_ts, hit)
+                # one absorbing row (its next-state persona activates via
+                # min_at once min is reached — same-row aliasing)
+                table = self._spawn_virtual(
+                    table, st, ev_cols, ev_nulls, ev_ts, hit, counter,
+                    as_state=st.idx, n0=1,
+                    min_reached=reached_min)
+            else:
+                if st.next_idx == -1:
+                    out = self._emit_virtual(out, st, ev_cols, ev_nulls,
+                                             ev_ts, hit)
+                else:
+                    table = self._spawn_virtual(
+                        table, st, ev_cols, ev_nulls, ev_ts, hit, counter,
+                        as_state=st.next_idx, n0=1, min_reached=False)
+        return table, out
+
+    def _virtual_env(self, st, ev_cols, ev_nulls):
+        env = {}
+        for j, spec in enumerate(self.slots):
+            for a in range(len(spec.schema.types)):
+                for c in range(spec.cap):
+                    if j == st.slot and c == 0:
+                        env[("slot", j, a, c)] = Col(ev_cols[a], ev_nulls[a])
+                    else:
+                        env[("slot", j, a, c)] = Col(
+                            jnp.zeros((), dtype=np_dtype(
+                                spec.schema.types[a])),
+                            jnp.ones((), dtype=jnp.bool_))
+                for kback in range(min(spec.cap, 4)):
+                    key = ("slot_last", j, a, kback)
+                    if j == st.slot and kback == 0:
+                        env[key] = Col(ev_cols[a], ev_nulls[a])
+                    else:
+                        env[key] = Col(
+                            jnp.zeros((), dtype=np_dtype(
+                                spec.schema.types[a])),
+                            jnp.ones((), dtype=jnp.bool_))
+        return env
+
+    def _spawn_virtual(self, table, st, ev_cols, ev_nulls, ev_ts, hit,
+                       counter, as_state: int, n0: int,
+                       min_reached: bool = False):
+        """Append one row capturing the event at st.slot."""
+        M = self.M
+        free = ~table["valid"]
+        first_free = jnp.argmax(free)
+        ok = hit & jnp.any(free)
+        d = jnp.where(ok, first_free, M)
+        state = table["state"].at[d].set(jnp.int32(as_state), mode="drop")
+        valid = table["valid"].at[d].set(True, mode="drop")
+        born = table["born"].at[d].set(counter, mode="drop")
+        seq = table["seq"].at[d].set(table["next_seq"], mode="drop")
+        next_seq = table["next_seq"] + ok.astype(jnp.int64)
+        overflow = table["overflow"] + (hit & ~ok).astype(jnp.int64)
+        slots = []
+        for j, spec in enumerate(self.slots):
+            buf = table["slots"][j]
+            if j == st.slot:
+                cols = tuple(
+                    col.at[d, 0].set(ev_cols[a], mode="drop")
+                    for a, col in enumerate(buf["cols"]))
+                nulls = tuple(
+                    nl.at[d, 0].set(ev_nulls[a], mode="drop")
+                    for a, nl in enumerate(buf["nulls"]))
+                ts = buf["ts"].at[d, 0].set(ev_ts, mode="drop")
+                n = buf["n"].at[d].set(jnp.int32(n0), mode="drop")
+                # clear higher positions
+                if spec.cap > 1:
+                    rest = jnp.arange(spec.cap)[None, :] >= n0
+                    m_row = (jnp.arange(M) == d)[:, None] & rest
+                    cols = tuple(jnp.where(m_row, jnp.zeros_like(c), c)
+                                 for c in cols)
+                    nulls = tuple(jnp.where(m_row, True, nl)
+                                  for nl in nulls)
+                slots.append({"cols": cols, "nulls": nulls, "ts": ts,
+                              "n": n})
+            else:
+                # cleared slot
+                m_row = (jnp.arange(M) == d)[:, None]
+                cols = tuple(jnp.where(m_row, jnp.zeros_like(c), c)
+                             for c in buf["cols"])
+                nulls = tuple(jnp.where(m_row, True, nl)
+                              for nl in buf["nulls"])
+                ts = jnp.where(m_row, 0, buf["ts"])
+                n = jnp.where(jnp.arange(M) == d, 0, buf["n"])
+                slots.append({"cols": cols, "nulls": nulls, "ts": ts,
+                              "n": n})
+        ts0 = table["ts0"].at[d].set(ev_ts, mode="drop")
+        has_ts0 = table["has_ts0"].at[d].set(True, mode="drop")
+        min_at = table["min_at"].at[d].set(
+            counter if min_reached else jnp.int64(-1), mode="drop")
+        return {**table, "state": state, "valid": valid, "born": born,
+                "seq": seq, "next_seq": next_seq, "overflow": overflow,
+                "slots": tuple(slots), "ts0": ts0, "has_ts0": has_ts0,
+                "min_at": min_at}
+
+    def _emit_virtual(self, out, st, ev_cols, ev_nulls, ev_ts, hit):
+        OUT = self.OUT
+        d = jnp.where(hit & (out["n"] < OUT), out["n"], OUT)
+        cols = list(out["cols"])
+        nulls = list(out["nulls"])
+        j = st.slot
+        spec = self.slots[j]
+        for a in range(len(spec.schema.types)):
+            ci = self.col_index[(j, a, 0)]
+            cols[ci] = cols[ci].at[d].set(ev_cols[a], mode="drop")
+            nulls[ci] = nulls[ci].at[d].set(ev_nulls[a], mode="drop")
+        ts = out["ts"].at[d].set(ev_ts, mode="drop")
+        emitted = (hit & (out["n"] < OUT)).astype(jnp.int64)
+        lost = (hit & (out["n"] >= OUT)).astype(jnp.int64)
+        return {"cols": tuple(cols), "nulls": tuple(nulls), "ts": ts,
+                "n": out["n"] + emitted, "lost": out["lost"] + lost}

@@ -1,12 +1,22 @@
 """Test configuration: force a virtual 8-device CPU platform so sharding /
-multi-chip paths are exercised without TPU hardware, and keep compiles fast.
+multi-chip paths are exercised without TPU hardware, and keep compiles fast
+(no remote TPU round-trips).
 
-Must run before jax (or siddhi_tpu) is imported anywhere in the test process.
+The axon sitecustomize registers the TPU backend and calls
+jax.config.update("jax_platforms", "axon,cpu") at interpreter start, which
+overrides the JAX_PLATFORMS env var — so the env var alone is not enough;
+the config must be updated back after import.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8, jax.devices()

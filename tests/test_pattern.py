@@ -1,0 +1,229 @@
+"""Pattern / sequence NFA tests, modeled on the reference corpus
+(modules/siddhi-core/src/test/.../query/pattern/EveryPatternTestCase.java,
+CountPatternTestCase.java, WithinPatternTestCase.java and query/sequence/).
+"""
+import pytest
+
+from siddhi_tpu import Event, QueryCallback, SiddhiManager, StreamCallback
+
+PLAYBACK = "@app:playback "
+
+TWO_STREAMS = PLAYBACK + """
+    define stream Stream1 (symbol string, price float, volume int);
+    define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+def build(ql, targets=("Out",)):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    for t in targets:
+        rt.add_callback(t, StreamCallback(fn=lambda evs: got.extend(evs)))
+    rt.start()
+    return rt, got
+
+
+class TestBasicPattern:
+    def test_two_state_cross_predicate(self):
+        # EveryPatternTestCase.testQuery1 (without every): one match
+        rt, got = build(TWO_STREAMS + """
+            @info(name = 'q')
+            from e1=Stream1[price > 20.0] -> e2=Stream2[price > e1.price]
+            select e1.symbol as symbol1, e2.symbol as symbol2
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        s1.send(Event(1000, ("WSO2", 55.6, 100)))
+        s2.send(Event(1100, ("IBM", 55.7, 100)))
+        rt.shutdown()
+        assert [e.data for e in got] == [("WSO2", "IBM")]
+
+    def test_non_every_matches_once(self):
+        # without 'every' the start state is armed exactly once: the first
+        # qualifying Stream1 event captures it; later pairs don't match
+        rt, got = build(TWO_STREAMS + """
+            from e1=Stream1[price > 20.0] -> e2=Stream2[price > e1.price]
+            select e1.price as p1, e2.price as p2
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        s1.send(Event(1000, ("A", 30.0, 1)))
+        s2.send(Event(1100, ("B", 40.0, 1)))
+        s1.send(Event(1200, ("C", 50.0, 1)))
+        s2.send(Event(1300, ("D", 60.0, 1)))
+        rt.shutdown()
+        assert [e.data for e in got] == [(30.0, 40.0)]
+
+    def test_second_stream1_event_ignored(self):
+        rt, got = build(TWO_STREAMS + """
+            from e1=Stream1[price > 20.0] -> e2=Stream2[price > e1.price]
+            select e1.price as p1, e2.price as p2
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        s1.send(Event(1000, ("A", 55.5, 1)))
+        s1.send(Event(1100, ("B", 54.0, 1)))  # no pending left at e1
+        s2.send(Event(1200, ("C", 57.5, 1)))
+        rt.shutdown()
+        assert [e.data for e in got] == [(55.5, 57.5)]
+
+
+class TestEveryPattern:
+    def test_every_first_state(self):
+        # every e1=A -> e2=B: every A event starts a partial; one B
+        # completes all of them (in arrival order)
+        rt, got = build(TWO_STREAMS + """
+            from every e1=Stream1[price > 20.0]
+                 -> e2=Stream2[price > e1.price]
+            select e1.price as p1, e2.price as p2
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        s1.send(Event(1000, ("A", 30.0, 1)))
+        s1.send(Event(1100, ("B", 40.0, 1)))
+        s2.send(Event(1200, ("C", 45.0, 1)))
+        rt.shutdown()
+        assert [e.data for e in got] == [(30.0, 45.0), (40.0, 45.0)]
+
+    def test_every_scope_rearm(self):
+        # every (A -> B): a new cycle starts only after completion
+        rt, got = build(TWO_STREAMS + """
+            from every (e1=Stream1[price > 20.0]
+                 -> e2=Stream2[price > e1.price])
+            select e1.price as p1, e2.price as p2
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        s1.send(Event(1000, ("A", 30.0, 1)))
+        s1.send(Event(1100, ("B", 40.0, 1)))   # ignored: scope busy
+        s2.send(Event(1200, ("C", 45.0, 1)))   # completes (30, 45)
+        s1.send(Event(1300, ("D", 50.0, 1)))   # new cycle
+        s2.send(Event(1400, ("E", 55.0, 1)))   # completes (50, 55)
+        rt.shutdown()
+        assert [e.data for e in got] == [(30.0, 45.0), (50.0, 55.0)]
+
+
+class TestSequence:
+    def test_strict_sequence(self):
+        # e1=A, e2=B: B must be the very next Stream1 event after A
+        rt, got = build(PLAYBACK + """
+            define stream S (symbol string, price float);
+            from e1=S[price > 20.0], e2=S[price > e1.price]
+            select e1.price as p1, e2.price as p2
+            insert into Out;
+        """)
+        h = rt.get_input_handler("S")
+        h.send(Event(1000, ("A", 30.0)))
+        h.send(Event(1100, ("B", 25.0)))   # kills [A] (25 < 30); arms [B]
+        h.send(Event(1200, ("C", 45.0)))   # completes (25, 45)
+        rt.shutdown()
+        assert [e.data for e in got] == [(25.0, 45.0)]
+
+
+class TestCountPattern:
+    def test_count_min_max(self):
+        # e1=A<2:5> -> e2=B: two A's reach min; B completes with the list
+        rt, got = build(TWO_STREAMS + """
+            from e1=Stream1[price > 20.0]<2:5> -> e2=Stream2[volume == 100]
+            select e1[0].price as p0, e1[1].price as p1, e2.symbol as sym
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        s1.send(Event(1000, ("A", 25.0, 1)))
+        s1.send(Event(1100, ("B", 30.0, 1)))
+        s2.send(Event(1200, ("C", 0.0, 100)))
+        rt.shutdown()
+        assert [e.data for e in got] == [(25.0, 30.0, "C")]
+
+    def test_count_absorbs_beyond_min(self):
+        # the forwarded pending shares the capture list with the absorbing
+        # pending (reference aliases the StateEvent): a third A appears in
+        # the match
+        rt, got = build(TWO_STREAMS + """
+            from e1=Stream1[price > 20.0]<2:5> -> e2=Stream2[volume == 100]
+            select e1[0].price as p0, e1[2].price as p2, e2.symbol as sym
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        for i, p in enumerate((25.0, 30.0, 35.0)):
+            s1.send(Event(1000 + i * 100, ("X", p, 1)))
+        s2.send(Event(1400, ("C", 0.0, 100)))
+        rt.shutdown()
+        assert [e.data for e in got] == [(25.0, 35.0, "C")]
+
+    def test_kleene_plus_every(self):
+        # every A<1:> -> B (the pattern-syntax Kleene plus): overlapping
+        # suffix matches
+        rt, got = build(TWO_STREAMS + """
+            from every e1=Stream1[price > 20.0]<1:>
+                 -> e2=Stream2[volume == 100]
+            select e1[0].price as p0, e2.symbol as sym
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        s1.send(Event(1000, ("A", 25.0, 1)))
+        s1.send(Event(1100, ("B", 30.0, 1)))
+        s2.send(Event(1200, ("C", 0.0, 100)))
+        rt.shutdown()
+        assert sorted(e.data for e in got) == [(25.0, "C"), (30.0, "C")]
+
+
+class TestWithin:
+    def test_within_expires_partials(self):
+        rt, got = build(TWO_STREAMS + """
+            from e1=Stream1[price > 20.0] -> e2=Stream2[price > e1.price]
+            within 1 sec
+            select e1.price as p1, e2.price as p2
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        s1.send(Event(1000, ("A", 30.0, 1)))
+        s2.send(Event(2500, ("B", 40.0, 1)))  # 1.5s later: partial expired
+        rt.shutdown()
+        assert got == []
+
+    def test_within_allows_fast_match(self):
+        rt, got = build(TWO_STREAMS + """
+            from e1=Stream1[price > 20.0] -> e2=Stream2[price > e1.price]
+            within 1 sec
+            select e1.price as p1, e2.price as p2
+            insert into Out;
+        """)
+        s1 = rt.get_input_handler("Stream1")
+        s2 = rt.get_input_handler("Stream2")
+        s1.send(Event(1000, ("A", 30.0, 1)))
+        s2.send(Event(1800, ("B", 40.0, 1)))
+        rt.shutdown()
+        assert [e.data for e in got] == [(30.0, 40.0)]
+
+
+class TestFiveStateSequence:
+    def test_order_payment_flow(self):
+        # the north-star shape: multi-state chain with cross-state
+        # predicates (BASELINE.md config 4 extended to 5 states)
+        rt, got = build(PLAYBACK + """
+            define stream Ev (kind int, key int, val float);
+            from e1=Ev[kind == 1] -> e2=Ev[kind == 2 and key == e1.key]
+                 -> e3=Ev[kind == 3 and key == e1.key]
+                 -> e4=Ev[kind == 4 and key == e1.key]
+                 -> e5=Ev[kind == 5 and key == e1.key]
+            select e1.key as key, e5.val as final
+            insert into Out;
+        """)
+        h = rt.get_input_handler("Ev")
+        for i, (k, key, v) in enumerate([
+                (1, 7, 1.0), (2, 7, 2.0), (9, 9, 0.0), (3, 7, 3.0),
+                (4, 7, 4.0), (5, 7, 5.0)]):
+            h.send(Event(1000 + i * 10, (k, key, v)))
+        rt.shutdown()
+        assert [e.data for e in got] == [(7, 5.0)]
