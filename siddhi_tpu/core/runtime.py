@@ -27,9 +27,13 @@ import numpy as np
 from ..lang import ast as A
 from ..ops.aggregators import AggregateOp
 from ..ops.expr import CompileError, SingleStreamScope, compile_expression
+from ..ops.join import (JoinCombinedScope, JoinCross, JoinSideScope,
+                        combined_schema)
 from ..ops.nfa import MatchScope, NfaCompiler, NfaEngine
 from ..ops.operators import FilterOp, Operator
 from ..ops.selector import ProjectOp, selector_needs_aggregation
+from ..ops.table import (TableFilterOp, TableOutputOp, TableRuntime,
+                         expr_mentions_table)
 from ..ops.windows import (POS_INF, LengthBatchWindowOp, LengthWindowOp,
                            TimeBatchWindowOp, TimeWindowOp, WindowOp)
 from .event import (CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema,
@@ -107,6 +111,8 @@ class QueryRuntime(Receiver):
         # path used by bench.py and device-to-device chaining
         self.batch_callbacks: list[Callable] = []
         self.states = tuple(op.init_state() for op in operators)
+        self.table_deps = sorted({t for op in operators
+                                  for t in op.table_ids()})
         self._step: Optional[Callable] = None
         self._lock = threading.Lock()
         self._has_timers = any(
@@ -119,10 +125,14 @@ class QueryRuntime(Receiver):
         ops = self.operators
         has_timers = self._has_timers
 
-        def step(states, batch: EventBatch, now):
+        def step(states, tstates, batch: EventBatch, now):
             new_states = []
             for op, st in zip(ops, states):
-                st, batch = op.step(st, batch, now)
+                if op.needs_tables:
+                    st, batch, tstates = op.step_tables(st, batch, now,
+                                                        tstates)
+                else:
+                    st, batch = op.step(st, batch, now)
                 new_states.append(st)
             if has_timers:
                 dues = [op.next_due(st) for op, st in zip(ops, new_states)
@@ -133,7 +143,7 @@ class QueryRuntime(Receiver):
                     due = jnp.minimum(due, d)
             else:
                 due = jnp.int64(2 ** 62)
-            return tuple(new_states), batch, due
+            return tuple(new_states), tstates, batch, due
 
         return jax.jit(step)
 
@@ -168,9 +178,22 @@ class QueryRuntime(Receiver):
         now_dev = jnp.asarray(now, dtype=jnp.int64)
         with self._lock:
             step = self._step_for(batch.capacity)
-            self.states, out, due = step(self.states, batch, now_dev)
+            with self._table_locks():
+                tstates = {t: self.app.tables[t].state
+                           for t in self.table_deps}
+                self.states, tstates, out, due = step(
+                    self.states, tstates, batch, now_dev)
+                for t in self.table_deps:
+                    self.app.tables[t].state = tstates[t]
         self._dispatch_output(out, timestamp,
                               due=due if self._has_timers else None)
+
+    def _table_locks(self):
+        import contextlib
+        stack = contextlib.ExitStack()
+        for t in self.table_deps:  # sorted — consistent lock order
+            stack.enter_context(self.app.tables[t].lock)
+        return stack
 
     def _dispatch_output(self, out, timestamp: int, due=None) -> None:
         """Raw-batch observers, timer scheduling, and (only when someone
@@ -208,20 +231,8 @@ class QueryRuntime(Receiver):
         self._sched_due = None
         if not self.app.running:
             return
-        cap = BATCH_BUCKETS[0]
-        batch = batch_from_rows(self.in_schema, [], [], cap)
-        # one TIMER row carrying the due timestamp
-        from .event import TIMER
-        ts = np.zeros((cap,), dtype=np.int64)
-        ts[0] = due
-        kind = np.zeros((cap,), dtype=np.int32)
-        kind[0] = TIMER
-        valid = np.zeros((cap,), dtype=np.bool_)
-        valid[0] = True
-        batch = EventBatch(ts=ts, cols=batch.cols, nulls=batch.nulls,
-                           kind=kind, valid=valid)
         now = max(due, self.app.current_time())
-        self.process_batch(batch, due, now=now)
+        self.process_batch(_timer_batch(self.in_schema, due), due, now=now)
 
 
 class StreamCallbackReceiver(Receiver):
@@ -272,13 +283,18 @@ class PatternQueryRuntime(QueryRuntime):
             nfa_step = self.engine.make_stream_step(stream_id)
             sel_ops = self.operators
 
-            def step(nfa_state, sel_states, batch: EventBatch, now):
+            def step(nfa_state, sel_states, tstates, batch: EventBatch,
+                     now):
                 nfa_state, match = nfa_step(nfa_state, batch, now)
                 new_sel = []
                 for op, st in zip(sel_ops, sel_states):
-                    st, match = op.step(st, match, now)
+                    if op.needs_tables:
+                        st, match, tstates = op.step_tables(st, match, now,
+                                                            tstates)
+                    else:
+                        st, match = op.step(st, match, now)
                     new_sel.append(st)
-                return nfa_state, tuple(new_sel), match
+                return nfa_state, tuple(new_sel), tstates, match
 
             fn = jax.jit(step)
             self._stream_steps[stream_id] = fn
@@ -294,9 +310,161 @@ class PatternQueryRuntime(QueryRuntime):
         now = jnp.asarray(self.app.current_time(), dtype=jnp.int64)
         with self._lock:
             step = self._step_for_stream(stream_id)
-            self.nfa_state, self.states, out = step(
-                self.nfa_state, self.states, batch, now)
+            with self._table_locks():
+                tstates = {t: self.app.tables[t].state
+                           for t in self.table_deps}
+                self.nfa_state, self.states, tstates, out = step(
+                    self.nfa_state, self.states, tstates, batch, now)
+                for t in self.table_deps:
+                    self.app.tables[t].state = tstates[t]
         self._dispatch_output(out, timestamp)
+
+
+class JoinStreamReceiver(Receiver):
+    def __init__(self, runtime: "JoinQueryRuntime", side: str):
+        self.runtime = runtime
+        self.side = side
+
+    def receive(self, events):
+        self.runtime.process_side_events(self.side, events)
+
+    def process_batch(self, batch, last_ts):
+        self.runtime.process_side_batch(self.side, batch, last_ts)
+
+
+class JoinQueryRuntime(QueryRuntime):
+    """Two-stream windowed join (JoinStreamRuntime + cross-wired
+    JoinProcessors in the reference). Each side runs [filters..., window];
+    the window output crosses the opposite window's findable buffer."""
+
+    def __init__(self, name: str, left_ops, right_ops, crosses,
+                 sel_ops, in_schemas, out_schema_override, app,
+                 side_tables=None):
+        super().__init__(name, sel_ops, out_schema_override, app)
+        self.out_schema = sel_ops[-1].out_schema if sel_ops \
+            else out_schema_override
+        self.side_ops = {"L": left_ops, "R": right_ops}
+        self.crosses = crosses  # {"L": JoinCross|None, "R": ...}
+        self.in_schemas = in_schemas  # {"L": schema, "R": schema}
+        self.side_tables = side_tables or {}  # {"L"/"R": TableRuntime}
+        self.side_states = {
+            s: tuple(op.init_state() for op in ops)
+            for s, ops in self.side_ops.items()}
+        self.table_deps = sorted(set(self.table_deps) | {
+            t.table_id for t in self.side_tables.values()})
+        self._side_steps: dict = {}
+        self._has_timers = any(
+            isinstance(op, WindowOp) and
+            op.next_due(op.init_state()) is not None
+            for ops in self.side_ops.values() for op in ops)
+        self.overflow = 0
+
+    def receive(self, events):
+        raise RuntimeError("join runtimes consume via JoinStreamReceivers")
+
+    def _step_for_side(self, side: str) -> Callable:
+        fn = self._side_steps.get(side)
+        if fn is None:
+            my_ops = self.side_ops[side]
+            opp = "R" if side == "L" else "L"
+            opp_window = self.side_ops[opp][-1]
+            cross = self.crosses[side]
+            sel_ops = self.operators
+            has_timers = self._has_timers
+
+            opp_table = self.side_tables.get(opp)
+
+            def step(my_states, opp_states, sel_states, tstates, batch,
+                     now):
+                new_my = []
+                for op, st in zip(my_ops, my_states):
+                    st, batch = op.step(st, batch, now)
+                    new_my.append(st)
+                if cross is not None:
+                    if opp_table is not None:
+                        opp_buf = opp_table.buffer(
+                            tstates[opp_table.table_id])
+                    else:
+                        opp_buf = opp_window.findable_buffer(opp_states[-1])
+                    joined, lost = cross.cross(batch, opp_buf)
+                else:
+                    cap = 16
+                    sch = combined_schema("#j", self.in_schemas["L"],
+                                          self.in_schemas["R"])
+                    joined = EventBatch.empty(sch, cap)
+                    lost = jnp.int64(0)
+                new_sel = []
+                for op, st in zip(sel_ops, sel_states):
+                    if op.needs_tables:
+                        st, joined, tstates = op.step_tables(
+                            st, joined, now, tstates)
+                    else:
+                        st, joined = op.step(st, joined, now)
+                    new_sel.append(st)
+                if has_timers:
+                    dues = [op.next_due(st) for op, st in
+                            zip(my_ops, new_my) if isinstance(op, WindowOp)]
+                    dues = [d for d in dues if d is not None]
+                    due = dues[0] if dues else jnp.int64(2 ** 62)
+                    for d in dues[1:]:
+                        due = jnp.minimum(due, d)
+                else:
+                    due = jnp.int64(2 ** 62)
+                return (tuple(new_my), tuple(new_sel), tstates, joined,
+                        lost, due)
+
+            fn = jax.jit(step)
+            self._side_steps[side] = fn
+        return fn
+
+    def process_side_events(self, side: str, events) -> None:
+        for batch, last_ts in self.encode_chunks(self.in_schemas[side],
+                                                 events):
+            self.process_side_batch(side, batch, last_ts)
+
+    def process_side_batch(self, side: str, batch: EventBatch,
+                           timestamp: int, now: Optional[int] = None) -> None:
+        if now is None:
+            now = self.app.current_time()
+        now_dev = jnp.asarray(now, dtype=jnp.int64)
+        opp = "R" if side == "L" else "L"
+        with self._lock:
+            step = self._step_for_side(side)
+            with self._table_locks():
+                tstates = {t: self.app.tables[t].state
+                           for t in self.table_deps}
+                my, sel, tstates, out, lost, due = step(
+                    self.side_states[side], self.side_states[opp],
+                    self.states, tstates, batch, now_dev)
+                for t in self.table_deps:
+                    self.app.tables[t].state = tstates[t]
+            self.side_states[side] = my
+            self.states = sel
+        self._dispatch_output(out, timestamp,
+                              due=due if self._has_timers else None)
+
+    def _on_timer(self, due: int) -> None:
+        self._sched_due = None
+        if not self.app.running:
+            return
+        now = max(due, self.app.current_time())
+        for side in ("L", "R"):
+            batch = _timer_batch(self.in_schemas[side], due)
+            self.process_side_batch(side, batch, due, now=now)
+
+
+def _timer_batch(schema: StreamSchema, due: int) -> EventBatch:
+    from .event import TIMER
+    cap = BATCH_BUCKETS[0]
+    batch = batch_from_rows(schema, [], [], cap)
+    ts = np.zeros((cap,), dtype=np.int64)
+    ts[0] = due
+    kind = np.zeros((cap,), dtype=np.int32)
+    kind[0] = TIMER
+    valid = np.zeros((cap,), dtype=np.bool_)
+    valid[0] = True
+    return EventBatch(ts=ts, cols=batch.cols, nulls=batch.nulls,
+                      kind=kind, valid=valid)
 
 
 class SiddhiAppRuntime:
@@ -312,6 +480,7 @@ class SiddhiAppRuntime:
         self.schemas: dict[str, StreamSchema] = {}
         self.input_handlers: dict[str, InputHandler] = {}
         self.queries: dict[str, QueryRuntime] = {}
+        self.tables: dict[str, TableRuntime] = {}
         self.running = False
         self._playback = False
         self._playback_time: Optional[int] = None
@@ -395,6 +564,8 @@ class Planner:
         self.app = app
         self.ast = app.ast
 
+    DEFAULT_TABLE_CAP = 8192
+
     def plan(self) -> None:
         app, ast = self.app, self.ast
         # 1. defined streams -> junctions + input handlers
@@ -403,6 +574,18 @@ class Planner:
                 Attribute(a.name, a.type) for a in sd.attributes))
             j = app.junction_for(sid, schema)
             app.input_handlers[sid] = InputHandler(sid, j, app)
+        # 1b. defined tables (@PrimaryKey -> upsert semantics)
+        for tid, td in ast.table_definitions.items():
+            schema = StreamSchema(tid, tuple(
+                Attribute(a.name, a.type) for a in td.attributes))
+            pk = []
+            pka = A.find_annotation(td.annotations, "PrimaryKey")
+            if pka is not None:
+                for nm in pka.positional or list(pka.elements.values()):
+                    pk.append(schema.index_of(nm.strip("'\"")))
+            app.tables[tid] = TableRuntime(tid, schema,
+                                           capacity=self.DEFAULT_TABLE_CAP,
+                                           pk_indices=pk)
         # playback mode
         pb = A.find_annotation(ast.annotations, "playback")
         if pb is not None:
@@ -467,10 +650,12 @@ class Planner:
         name = q.name or default_name
         if isinstance(q.input, A.StateInputStream):
             return self.plan_pattern_query(q, name)
+        if isinstance(q.input, A.JoinInputStream):
+            return self.plan_join_query(q, name)
         if not isinstance(q.input, A.SingleInputStream):
             raise CompileError(
-                f"query '{name}': only single-stream and pattern queries "
-                "supported in this stage")
+                f"query '{name}': only single-stream, join, and pattern "
+                "queries supported in this stage")
         sin = q.input
         schema = app.schemas.get(sin.stream_id)
         if schema is None:
@@ -479,12 +664,14 @@ class Planner:
         scope = SingleStreamScope(schema, aliases=(sin.alias,))
 
         out = q.output
-        if isinstance(out, (A.InsertIntoStream, A.ReturnStream)):
+        if isinstance(out, (A.InsertIntoStream, A.ReturnStream,
+                            A.DeleteStream, A.UpdateStream,
+                            A.UpdateOrInsertStream)):
             out_type = out.output_event_type
         else:
-            raise CompileError(f"query '{name}': table output not yet "
-                               "supported")
-        target = out.target if isinstance(out, A.InsertIntoStream) else name
+            raise CompileError(f"query '{name}': unsupported output "
+                               f"{type(out).__name__}")
+        target = getattr(out, "target", None) or name
         current_on = out_type in ("current", "all")
         expired_on = out_type in ("expired", "all")
         needs_agg = selector_needs_aggregation(q.selector)
@@ -497,6 +684,10 @@ class Planner:
                     raise CompileError(
                         f"query '{name}': filter after window not yet "
                         "supported")
+                if expr_mentions_table(h.expression):
+                    operators.append(TableFilterOp(
+                        h.expression, schema, app.tables, scope))
+                    continue
                 cond = compile_expression(h.expression, scope)
                 if cond.type is not AttrType.BOOL:
                     raise CompileError(f"query '{name}': filter must be BOOL")
@@ -531,19 +722,145 @@ class Planner:
             operators.append(ProjectOp(
                 q.selector, schema, target, scope,
                 current_on=current_on, expired_on=expired_on))
+        self.append_table_output(operators, out, name)
 
         if name in app.queries:
             raise CompileError(f"duplicate query name '{name}'")
         qr = QueryRuntime(name, operators, schema, app)
         app.junctions[sin.stream_id].subscribe(qr)
         app.queries[name] = qr
-        if isinstance(out, A.InsertIntoStream):
+        self.wire_stream_output(qr, out, out_type)
+
+    def append_table_output(self, operators: list, out, name: str) -> None:
+        """Insert/delete/update/update-or-insert into a table becomes a
+        terminal TableOutputOp (reference: OutputParser table callbacks)."""
+        from ..ops.selector import OutputScope
+        app = self.app
+        sel_schema = operators[-1].out_schema
+        escope = OutputScope(sel_schema)
+        if isinstance(out, A.InsertIntoStream) and out.target in app.tables:
+            operators.append(TableOutputOp(
+                "insert", app.tables[out.target], None, None, escope,
+                sel_schema))
+        elif isinstance(out, (A.DeleteStream, A.UpdateStream,
+                              A.UpdateOrInsertStream)):
+            tr = app.tables.get(out.target)
+            if tr is None:
+                raise CompileError(
+                    f"query '{name}': '{out.target}' is not a defined "
+                    "table")
+            kind = {"DeleteStream": "delete", "UpdateStream": "update",
+                    "UpdateOrInsertStream": "update_or_insert"}[
+                type(out).__name__]
+            set_clause = getattr(out, "set_clause", None)
+            if kind != "delete" and not set_clause:
+                # no SET: every table attribute updated from the same-named
+                # output attribute (UpdateTableCallback default)
+                set_clause = [
+                    (A.Variable(attribute=att.name),
+                     A.Variable(attribute=att.name))
+                    for att in tr.schema.attributes
+                    if att.name in sel_schema.names]
+            operators.append(TableOutputOp(
+                kind, tr, out.on, set_clause, escope, sel_schema))
+
+    def wire_stream_output(self, qr, out, out_type: str) -> None:
+        app = self.app
+        if isinstance(out, A.InsertIntoStream) and \
+                out.target not in app.tables:
             tj = app.junction_for(out.target, qr.out_schema)
             if out.target not in app.input_handlers:
                 app.input_handlers[out.target] = InputHandler(out.target, tj,
                                                               app)
             qr.output_handlers.append(
                 InsertIntoStreamHandler(tj, out_type))
+
+    # -- join queries ----------------------------------------------------
+    def plan_join_query(self, q: A.Query, name: str) -> None:
+        app = self.app
+        jin: A.JoinInputStream = q.input
+        out = q.output
+        if isinstance(out, (A.InsertIntoStream, A.ReturnStream)):
+            out_type = out.output_event_type
+        else:
+            raise CompileError(f"query '{name}': table output not yet "
+                               "supported")
+        target = out.target if isinstance(out, A.InsertIntoStream) else name
+        current_on = out_type in ("current", "all")
+        expired_on = out_type in ("expired", "all")
+        needs_agg = selector_needs_aggregation(q.selector)
+
+        def side_chain(sin: A.SingleInputStream, side_name: str):
+            schema = app.schemas.get(sin.stream_id)
+            if schema is None:
+                raise CompileError(
+                    f"query '{name}': undefined stream '{sin.stream_id}'")
+            scope = SingleStreamScope(schema, aliases=(sin.alias,))
+            ops: list[Operator] = []
+            window = None
+            for h in sin.handlers:
+                if isinstance(h, A.Filter):
+                    if window is not None:
+                        raise CompileError(
+                            f"query '{name}': filter after window")
+                    cond = compile_expression(h.expression, scope)
+                    ops.append(FilterOp(cond, schema))
+                elif isinstance(h, A.WindowHandler):
+                    cls = self.window_class(h)
+                    expired_enabled = expired_on if cls.is_batch \
+                        else True  # joins need expired pairs for aggregates
+                    window = self.make_window(h, schema, expired_enabled)
+                    ops.append(window)
+                else:
+                    raise CompileError(
+                        f"query '{name}': stream function in join not "
+                        "supported")
+            if window is None:
+                raise CompileError(
+                    f"query '{name}': join sides need explicit windows "
+                    "(the reference's default-window insertion is not "
+                    "implemented yet)")
+            return schema, ops
+
+        l_schema, l_ops = side_chain(jin.left, "L")
+        r_schema, r_ops = side_chain(jin.right, "R")
+        side_scope = JoinSideScope(l_schema, jin.left.alias,
+                                   r_schema, jin.right.alias)
+        jschema = combined_schema(target, l_schema, r_schema)
+        crosses = {"L": None, "R": None}
+        if jin.unidirectional != "right":
+            crosses["L"] = JoinCross(True, l_schema, r_schema, jin.on,
+                                     side_scope, jin.join_type)
+        if jin.unidirectional != "left":
+            crosses["R"] = JoinCross(False, l_schema, r_schema, jin.on,
+                                     side_scope, jin.join_type)
+
+        sel_scope = JoinCombinedScope(side_scope, len(l_schema.types))
+        if needs_agg:
+            sel_ops: list[Operator] = [AggregateOp(
+                q.selector, jschema, target, sel_scope,
+                batch_mode=False, expired_possible=True,
+                current_on=current_on, expired_on=expired_on)]
+        else:
+            sel_ops = [ProjectOp(q.selector, jschema, target, sel_scope,
+                                 current_on=current_on,
+                                 expired_on=expired_on)]
+
+        if name in app.queries:
+            raise CompileError(f"duplicate query name '{name}'")
+        qr = JoinQueryRuntime(name, l_ops, r_ops, crosses, sel_ops,
+                              {"L": l_schema, "R": r_schema}, jschema, app)
+        app.junctions[jin.left.stream_id].subscribe(
+            JoinStreamReceiver(qr, "L"))
+        app.junctions[jin.right.stream_id].subscribe(
+            JoinStreamReceiver(qr, "R"))
+        app.queries[name] = qr
+        if isinstance(out, A.InsertIntoStream):
+            tj = app.junction_for(out.target, qr.out_schema)
+            if out.target not in app.input_handlers:
+                app.input_handlers[out.target] = InputHandler(
+                    out.target, tj, app)
+            qr.output_handlers.append(InsertIntoStreamHandler(tj, out_type))
 
     # -- pattern / sequence queries --------------------------------------
     def plan_pattern_query(self, q: A.Query, name: str) -> None:

@@ -1,0 +1,190 @@
+"""Window×window joins as masked cross products.
+
+Reference mapping:
+- query/input/stream/join/JoinProcessor.java:78-190 — the post-window
+  JoinProcessor triggers on each window-output event (CURRENT and EXPIRED,
+  preserving the type on the joined row), find()s the opposite window with
+  the compiled on-condition, builds two-slot StateEvents; outer joins emit
+  one-sided rows when nothing matches; RESET rows pass through one-sided;
+  TIMER is consumed.
+- JoinInputStreamParser.java:75 — two SingleStreamRuntimes cross-wired.
+
+TPU design: the trigger side's window-output batch [B] is crossed with the
+opposite window's buffer [W] in one shot — the on-condition compiles to a
+broadcast [B, W] boolean grid (columns enter as [B,1] / [1,W]); surviving
+pairs are compacted to a static JOIN_CAP with one stable sort keyed
+(trigger row, buffer position), which reproduces the reference's
+iteration order exactly. Overflow is counted, never silent.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.event import (CURRENT, EXPIRED, RESET, Attribute, EventBatch,
+                          StreamSchema)
+from ..core.types import AttrType, np_dtype
+from ..lang import ast as A
+from .expr import Col, CompileError, Scope, compile_expression
+
+POS_INF = jnp.int64(2 ** 62)
+
+
+class JoinSideScope(Scope):
+    """Resolves variables to ('L'/'R', attr_idx) over the two sides."""
+
+    def __init__(self, left_schema: StreamSchema, left_alias,
+                 right_schema: StreamSchema, right_alias):
+        self.sides = {
+            "L": (left_schema, {left_schema.stream_id, left_alias} - {None}),
+            "R": (right_schema,
+                  {right_schema.stream_id, right_alias} - {None}),
+        }
+
+    def resolve(self, var: A.Variable):
+        ref = var.stream_ref
+        if ref is not None:
+            for tag, (schema, names) in self.sides.items():
+                if ref in names:
+                    idx = schema.index_of(var.attribute)
+                    return (tag, idx), schema.types[idx]
+            raise CompileError(f"unknown stream reference '{ref}' in join")
+        hits = []
+        for tag, (schema, _) in self.sides.items():
+            if var.attribute in schema.names:
+                hits.append((tag, schema))
+        if len(hits) == 1:
+            tag, schema = hits[0]
+            idx = schema.index_of(var.attribute)
+            return (tag, idx), schema.types[idx]
+        raise CompileError(
+            f"attribute '{var.attribute}' is "
+            + ("ambiguous" if hits else "unknown") + " across join sides")
+
+
+class JoinCombinedScope(Scope):
+    """Selector scope over the combined (left ++ right) joined batch."""
+
+    def __init__(self, side_scope: JoinSideScope, left_n: int):
+        self.side_scope = side_scope
+        self.left_n = left_n
+
+    def resolve(self, var: A.Variable):
+        (tag, idx), t = self.side_scope.resolve(var)
+        return ("attr", idx if tag == "L" else self.left_n + idx), t
+
+
+def combined_schema(out_id: str, left: StreamSchema,
+                    right: StreamSchema) -> StreamSchema:
+    attrs = []
+    for att in left.attributes:
+        attrs.append(Attribute(att.name, att.type))
+    for att in right.attributes:
+        attrs.append(Attribute(att.name, att.type))
+    return StreamSchema(out_id, tuple(attrs))
+
+
+class JoinCross:
+    """One trigger direction of a join: cross the trigger side's
+    window-output batch with the opposite window buffer."""
+
+    def __init__(self, trigger_is_left: bool, left_schema: StreamSchema,
+                 right_schema: StreamSchema, on: Optional[A.Expression],
+                 side_scope: JoinSideScope, join_type: str,
+                 join_cap: int = 1024):
+        self.trigger_is_left = trigger_is_left
+        self.left_schema = left_schema
+        self.right_schema = right_schema
+        self.join_type = join_type
+        self.cap = join_cap
+        self.cond = None
+        if on is not None:
+            self.cond = compile_expression(on, side_scope)
+            if self.cond.type is not AttrType.BOOL:
+                raise CompileError("join ON condition must be BOOL")
+        # does the trigger side emit unmatched one-sided rows?
+        self.outer = (
+            join_type == "full_outer"
+            or (join_type == "left_outer" and trigger_is_left)
+            or (join_type == "right_outer" and not trigger_is_left))
+
+    def cross(self, trig: EventBatch, opp_buf: dict) -> EventBatch:
+        """trig: trigger window output [B]; opp_buf: opposite window buffer
+        dict (ts/seq/cols/nulls/valid, rows in seq order)."""
+        B = trig.capacity
+        W = opp_buf["seq"].shape[0]
+        env = {}
+        lsch = self.left_schema
+        rsch = self.right_schema
+        if self.trigger_is_left:
+            for i in range(len(lsch.types)):
+                env[("L", i)] = Col(trig.cols[i][:, None],
+                                    trig.nulls[i][:, None])
+            for i in range(len(rsch.types)):
+                env[("R", i)] = Col(opp_buf["cols"][i][None, :],
+                                    opp_buf["nulls"][i][None, :])
+        else:
+            for i in range(len(lsch.types)):
+                env[("L", i)] = Col(opp_buf["cols"][i][None, :],
+                                    opp_buf["nulls"][i][None, :])
+            for i in range(len(rsch.types)):
+                env[("R", i)] = Col(trig.cols[i][:, None],
+                                    trig.nulls[i][:, None])
+        env["__ts__"] = Col(trig.ts[:, None], jnp.zeros((B, 1), jnp.bool_))
+
+        if self.cond is not None:
+            c = self.cond.fn(env)
+            grid = jnp.broadcast_to(c.values & ~c.nulls, (B, W))
+        else:
+            grid = jnp.ones((B, W), jnp.bool_)
+
+        joinable = trig.valid & ((trig.kind == CURRENT) |
+                                 (trig.kind == EXPIRED))
+        pair = grid & joinable[:, None] & opp_buf["valid"][None, :]
+        matched_any = jnp.any(pair, axis=1)
+        lone = joinable & ~matched_any if self.outer else \
+            jnp.zeros((B,), jnp.bool_)
+        reset = trig.valid & (trig.kind == RESET)
+
+        # flatten pairs + one-sided rows, ordered (trigger row, buffer pos);
+        # one-sided rows sort before any pair of the same trigger row
+        rows = jnp.arange(B, dtype=jnp.int64)
+        pk = (rows[:, None] * (W + 1) + 1 +
+              jnp.arange(W, dtype=jnp.int64)[None, :])
+        pair_keys = jnp.where(pair, pk, POS_INF).reshape(-1)
+        lone_keys = jnp.where(lone | reset, rows * (W + 1), POS_INF)
+        keys = jnp.concatenate([pair_keys, lone_keys])
+        order = jnp.argsort(keys)[:self.cap]
+        valid_out = keys[order] < POS_INF
+
+        # gather: index < B*W -> pair, else one-sided row (index - B*W)
+        is_pair = order < B * W
+        ti = jnp.where(is_pair, order // W, order - B * W)  # trigger row
+        oi = jnp.where(is_pair, order % W, 0)               # opposite row
+
+        n_l = len(lsch.types)
+        n_r = len(rsch.types)
+        cols, nulls = [], []
+        opp_invalid = ~is_pair  # one-sided: opposite side nulled
+        for i in range(n_l + n_r):
+            if self.trigger_is_left:
+                from_trigger = i < n_l
+                a = i if from_trigger else i - n_l
+            else:
+                from_trigger = i >= n_l
+                a = i - n_l if from_trigger else i
+            if from_trigger:
+                cols.append(trig.cols[a][ti])
+                nulls.append(trig.nulls[a][ti])
+            else:
+                cols.append(opp_buf["cols"][a][oi])
+                nulls.append(opp_buf["nulls"][a][oi] | opp_invalid)
+        return EventBatch(
+            ts=trig.ts[ti],
+            cols=tuple(cols),
+            nulls=tuple(nulls),
+            kind=trig.kind[ti],
+            valid=valid_out,
+        ), jnp.maximum(
+            jnp.sum((keys < POS_INF).astype(jnp.int64)) - self.cap, 0)

@@ -18,11 +18,17 @@ from .expr import CompiledExpr, env_from_batch
 class Operator:
     """Stateless by default. State must be a pytree of device arrays."""
 
+    needs_tables = False  # when True, step_tables(state, batch, now,
+    # tstates) -> (state', batch', tstates') is called instead of step
+
     def init_state(self) -> Any:
         return ()
 
     def step(self, state, batch: EventBatch, now):
         raise NotImplementedError
+
+    def table_ids(self) -> tuple:
+        return ()
 
     @property
     def out_schema(self):

@@ -1,0 +1,380 @@
+"""In-memory event tables as device-resident column stores.
+
+Reference mapping:
+- table/InMemoryTable.java:58-200 (add/delete/update/updateOrAdd/find/
+  contains over an EventHolder)
+- table/holder/ListEventHolder.java / IndexEventHolder.java:60-110 (list
+  scan vs primary-key map; here: one columnar buffer, with primary-key
+  upsert semantics when @PrimaryKey is declared)
+- util/parser/OperatorParser.java:62 (compiled conditions; here conditions
+  compile to broadcast [B, T] grids like joins)
+- query/output/callback/{InsertIntoTable,DeleteTable,UpdateTable,
+  UpdateOrInsertTable}Callback.java (query outputs into tables — modeled
+  as terminal TableOutputOps on the query's operator chain)
+
+Shared mutable state: the table's arrays live on the TableRuntime; every
+query step that touches tables receives the current state dict and returns
+an updated one (the host runtime serializes access with per-table locks in
+a fixed order). Capacity is static with an overflow counter.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.event import (CURRENT, EXPIRED, Attribute, EventBatch,
+                          StreamSchema)
+from ..core.types import AttrType, np_dtype
+from ..lang import ast as A
+from .expr import Col, CompileError, Scope, compile_expression
+from .keyed import hash_columns
+from .operators import Operator
+
+POS_INF = jnp.int64(2 ** 62)
+
+
+class TableRuntime:
+    """One `define table` instance (shared across queries)."""
+
+    def __init__(self, table_id: str, schema: StreamSchema,
+                 capacity: int = 8192, pk_indices: Optional[list] = None):
+        self.table_id = table_id
+        self.schema = schema
+        self.cap = capacity
+        self.pk = tuple(pk_indices or ())
+        self.lock = threading.Lock()
+        self.state = self.init_state()
+
+    def init_state(self) -> dict:
+        T = self.cap
+        return {
+            "cols": tuple(jnp.zeros((T,), dtype=np_dtype(t))
+                          for t in self.schema.types),
+            "nulls": tuple(jnp.zeros((T,), dtype=jnp.bool_)
+                           for _ in self.schema.types),
+            "ts": jnp.zeros((T,), dtype=jnp.int64),
+            "seq": jnp.zeros((T,), dtype=jnp.int64),
+            "valid": jnp.zeros((T,), dtype=jnp.bool_),
+            "next_seq": jnp.int64(0),
+            "overflow": jnp.int64(0),
+        }
+
+    # -- pure ops over (state, batch) ------------------------------------
+    def insert(self, state: dict, batch: EventBatch, row_mask) -> dict:
+        """Append masked batch rows. With a primary key, an existing row
+        with the same key is replaced in place (IndexEventHolder.add)."""
+        T = self.cap
+        B = batch.capacity
+        adding = row_mask & batch.valid
+        if self.pk:
+            bkeys = hash_columns([batch.cols[i] for i in self.pk],
+                                 [batch.nulls[i] for i in self.pk])
+            tkeys = hash_columns([state["cols"][i] for i in self.pk],
+                                 [state["nulls"][i] for i in self.pk])
+            # match each adding row to an existing row with the same key
+            eq = (bkeys[:, None] == tkeys[None, :]) & adding[:, None] & \
+                state["valid"][None, :]
+            hit_row = jnp.where(jnp.any(eq, axis=1),
+                                jnp.argmax(eq, axis=1), T)
+            replaces = hit_row < T
+            state = self._scatter_rows(state, batch,
+                                       adding & replaces, hit_row,
+                                       keep_seq=True)
+            adding = adding & ~replaces
+            # duplicate keys WITHIN the batch: later row wins (sequential
+            # add semantics) — handled by scatter order below (row order)
+        free = ~state["valid"]
+        free_pos = jnp.argsort(~free)
+        n_free = jnp.sum(free.astype(jnp.int64))
+        rank = jnp.cumsum(adding.astype(jnp.int64)) - 1
+        ok = adding & (rank < n_free)
+        dest = jnp.where(ok, free_pos[jnp.clip(rank, 0, T - 1)], T)
+        state = self._scatter_rows(state, batch, ok, dest, keep_seq=False)
+        lost = jnp.sum((adding & ~ok).astype(jnp.int64))
+        return {**state, "overflow": state["overflow"] + lost}
+
+    def _scatter_rows(self, state, batch, ok, dest, keep_seq):
+        d = jnp.where(ok, dest, self.cap)
+        cols = tuple(tc.at[d].set(bc, mode="drop")
+                     for tc, bc in zip(state["cols"], batch.cols))
+        nulls = tuple(tn.at[d].set(bn, mode="drop")
+                      for tn, bn in zip(state["nulls"], batch.nulls))
+        ts = state["ts"].at[d].set(batch.ts, mode="drop")
+        if keep_seq:
+            seq = state["seq"]
+            next_seq = state["next_seq"]
+        else:
+            n_ok = jnp.cumsum(ok.astype(jnp.int64)) - 1
+            seq = state["seq"].at[d].set(state["next_seq"] + n_ok,
+                                         mode="drop")
+            next_seq = state["next_seq"] + jnp.sum(ok.astype(jnp.int64))
+        valid = state["valid"].at[d].set(True, mode="drop")
+        return {**state, "cols": cols, "nulls": nulls, "ts": ts,
+                "seq": seq, "valid": valid, "next_seq": next_seq}
+
+    def buffer(self, state: dict) -> dict:
+        """Findable view (same layout as a window buffer), in seq order."""
+        order = jnp.argsort(jnp.where(state["valid"], state["seq"],
+                                      POS_INF))
+        return {
+            "cols": tuple(c[order] for c in state["cols"]),
+            "nulls": tuple(n[order] for n in state["nulls"]),
+            "ts": state["ts"][order],
+            "seq": state["seq"][order],
+            "valid": state["valid"][order],
+        }
+
+
+class TableOnScope(Scope):
+    """Scope for table `on` conditions and IN-table expressions: table
+    attributes resolve to ('T', idx) ([1, T] lanes), everything else
+    delegates to the event scope wrapped as ('S', key) ([B, 1] lanes)."""
+
+    def __init__(self, table_id: str, table_schema: StreamSchema,
+                 event_scope: Scope, table_alias: Optional[str] = None):
+        self.table_id = table_id
+        self.table_schema = table_schema
+        self.event_scope = event_scope
+        self.table_alias = table_alias
+
+    def resolve(self, var: A.Variable):
+        ref = var.stream_ref
+        if ref is not None and ref in (self.table_id, self.table_alias):
+            idx = self.table_schema.index_of(var.attribute)
+            return ("T", idx), self.table_schema.types[idx]
+        if ref is None and var.attribute in self.table_schema.names:
+            # bare names prefer the table side (reference: matching meta
+            # puts the store event first)
+            try:
+                key, t = self.event_scope.resolve(var)
+                # ambiguous: table wins only if event scope lacks it
+            except CompileError:
+                idx = self.table_schema.index_of(var.attribute)
+                return ("T", idx), self.table_schema.types[idx]
+            idx = self.table_schema.index_of(var.attribute)
+            return ("T", idx), self.table_schema.types[idx]
+        key, t = self.event_scope.resolve(var)
+        return ("S", key), t
+
+
+def grid_env(table_buf: dict, batch_env: dict) -> dict:
+    """Build the [B, T] broadcast env for a table condition."""
+    env = {}
+    for k, colv in batch_env.items():
+        if isinstance(colv, Col):
+            v = colv.values
+            n = colv.nulls
+            if getattr(v, "ndim", 0) >= 1:
+                v = v[:, None]
+            if getattr(n, "ndim", 0) >= 1:
+                n = n[:, None]
+            env[("S", k)] = Col(v, n)
+            if k == "__ts__":
+                env[k] = Col(v, n)
+        else:
+            env[k] = colv  # __now__ scalar
+    for i in range(len(table_buf["cols"])):
+        env[("T", i)] = Col(table_buf["cols"][i][None, :],
+                            table_buf["nulls"][i][None, :])
+    return env
+
+
+class TableOutputOp(Operator):
+    """Terminal operator writing query output into a table:
+    insert / delete / update / update-or-insert. The batch flows through
+    unchanged (callbacks still observe the events)."""
+
+    needs_tables = True
+
+    def table_ids(self):
+        return (self.table.table_id,)
+
+    def __init__(self, kind: str, table: TableRuntime,
+                 on: Optional[A.Expression], set_clause,
+                 event_scope: Scope, in_schema: StreamSchema):
+        self.kind = kind
+        self.table = table
+        self.in_schema = in_schema
+        self.cond = None
+        self.set_compiled = []
+        if on is not None:
+            scope = TableOnScope(table.table_id, table.schema, event_scope)
+            self.cond = compile_expression(on, scope)
+            if self.cond.type is not AttrType.BOOL:
+                raise CompileError("table ON condition must be BOOL")
+        for var, expr in (set_clause or []):
+            tidx = table.schema.index_of(var.attribute)
+            scope = TableOnScope(table.table_id, table.schema, event_scope)
+            ce = compile_expression(expr, scope)
+            self.set_compiled.append((tidx, ce))
+
+    @property
+    def out_schema(self):
+        return self.in_schema
+
+    def step_tables(self, state, batch: EventBatch, now, tstates: dict):
+        from .expr import env_from_batch
+        tid = self.table.table_id
+        tstate = tstates[tid]
+        acting = batch.valid & (batch.kind == CURRENT)
+        if self.kind == "insert":
+            tstate = self.table.insert(tstate, batch, acting)
+        else:
+            benv = env_from_batch(batch)
+            benv["__now__"] = now
+            wrapped = {k: v for k, v in benv.items()}
+            genv = grid_env(tstate, wrapped)
+            if self.cond is not None:
+                c = self.cond.fn(genv)
+                grid = jnp.broadcast_to(
+                    c.values & ~c.nulls,
+                    (batch.capacity, self.table.cap))
+            else:
+                grid = jnp.ones((batch.capacity, self.table.cap),
+                                jnp.bool_)
+            grid = grid & acting[:, None] & tstate["valid"][None, :]
+            touched = jnp.any(grid, axis=0)  # table rows hit by any event
+            if self.kind == "delete":
+                tstate = {**tstate, "valid": tstate["valid"] & ~touched}
+            elif self.kind in ("update", "update_or_insert"):
+                # per table row: the LAST matching event provides values
+                # (sequential update semantics)
+                src = jnp.where(
+                    jnp.any(grid, axis=0),
+                    (batch.capacity - 1) -
+                    jnp.argmax(grid[::-1, :], axis=0),
+                    0)
+                cols = list(tstate["cols"])
+                nulls = list(tstate["nulls"])
+                for tidx, ce in self.set_compiled:
+                    # evaluate per (event,row) then gather source event
+                    vc = ce.fn(genv)
+                    vals = jnp.broadcast_to(
+                        vc.values, (batch.capacity, self.table.cap))
+                    nls = jnp.broadcast_to(
+                        vc.nulls, (batch.capacity, self.table.cap))
+                    rowv = jnp.take_along_axis(vals, src[None, :],
+                                               axis=0)[0]
+                    rown = jnp.take_along_axis(nls, src[None, :],
+                                               axis=0)[0]
+                    cols[tidx] = jnp.where(touched, rowv, cols[tidx])
+                    nulls[tidx] = jnp.where(touched, rown, nulls[tidx])
+                tstate = {**tstate, "cols": tuple(cols),
+                          "nulls": tuple(nulls)}
+                if self.kind == "update_or_insert":
+                    unmatched = acting & ~jnp.any(grid, axis=1)
+                    tstate = self.table.insert(tstate, batch, unmatched)
+            else:
+                raise AssertionError(self.kind)
+        tstates = {**tstates, tid: tstate}
+        return state, batch, tstates
+
+
+class InTableRewriter:
+    """Extracts `expr IN table` subexpressions from a filter, replacing
+    them with __in_<k>__ placeholder variables whose [B] values are
+    containment results (InConditionExpressionExecutor)."""
+
+    def __init__(self, tables: dict, event_scope: Scope):
+        self.tables = tables
+        self.event_scope = event_scope
+        self.found: list = []  # (TableRuntime, compiled grid condition)
+
+    def rewrite(self, expr: A.Expression) -> A.Expression:
+        if isinstance(expr, A.InTable):
+            tr = self.tables.get(expr.table_id)
+            if tr is None:
+                raise CompileError(f"undefined table '{expr.table_id}'")
+            scope = TableOnScope(tr.table_id, tr.schema, self.event_scope)
+            ce = compile_expression(expr.expr, scope)
+            if ce.type is not AttrType.BOOL:
+                raise CompileError("IN <table> expression must be BOOL")
+            k = len(self.found)
+            self.found.append((tr, ce))
+            return A.Variable(attribute=f"__in_{k}__")
+        if isinstance(expr, A.MathOp):
+            return A.MathOp(expr.op, self.rewrite(expr.left),
+                            self.rewrite(expr.right))
+        if isinstance(expr, A.Compare):
+            return A.Compare(expr.op, self.rewrite(expr.left),
+                             self.rewrite(expr.right))
+        if isinstance(expr, A.And):
+            return A.And(self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, A.Or):
+            return A.Or(self.rewrite(expr.left), self.rewrite(expr.right))
+        if isinstance(expr, A.Not):
+            return A.Not(self.rewrite(expr.expr))
+        if isinstance(expr, A.IsNull) and expr.expr is not None:
+            return A.IsNull(expr=self.rewrite(expr.expr))
+        return expr
+
+
+class InTableScope(Scope):
+    def __init__(self, base: Scope, n: int):
+        self.base = base
+        self.n = n
+
+    def resolve(self, var: A.Variable):
+        if var.stream_ref is None and var.attribute and \
+                var.attribute.startswith("__in_") and \
+                var.attribute.endswith("__"):
+            return ("in", int(var.attribute[5:-2])), AttrType.BOOL
+        return self.base.resolve(var)
+
+
+class TableFilterOp(Operator):
+    """FilterOp variant whose condition contains IN-table containment."""
+
+    needs_tables = True
+
+    def table_ids(self):
+        return tuple(tr.table_id for tr, _ in self.contains)
+
+    def __init__(self, cond_ast: A.Expression, schema: StreamSchema,
+                 tables: dict, event_scope: Scope):
+        rewriter = InTableRewriter(tables, event_scope)
+        rewritten = rewriter.rewrite(cond_ast)
+        self.contains = rewriter.found
+        self.cond = compile_expression(
+            rewritten, InTableScope(event_scope, len(self.contains)))
+        if self.cond.type is not AttrType.BOOL:
+            raise CompileError("filter must be BOOL")
+        self.schema = schema
+
+    @property
+    def out_schema(self):
+        return self.schema
+
+    def step_tables(self, state, batch: EventBatch, now, tstates: dict):
+        from ..core.event import TIMER
+        from .expr import env_from_batch
+        env = env_from_batch(batch)
+        env["__now__"] = now
+        for k, (tr, ce) in enumerate(self.contains):
+            tstate = tstates[tr.table_id]
+            genv = grid_env(tstate, env)
+            c = ce.fn(genv)
+            grid = jnp.broadcast_to(c.values & ~c.nulls,
+                                    (batch.capacity, tr.cap))
+            grid = grid & tstate["valid"][None, :]
+            env[("in", k)] = Col(jnp.any(grid, axis=1),
+                                 jnp.zeros((batch.capacity,), jnp.bool_))
+        c = self.cond.fn(env)
+        keep = (c.values & ~c.nulls) | (batch.kind == TIMER)
+        return state, batch.mask(keep), tstates
+
+
+def expr_mentions_table(expr: A.Expression) -> bool:
+    if isinstance(expr, A.InTable):
+        return True
+    if isinstance(expr, (A.MathOp, A.Compare, A.And, A.Or)):
+        return expr_mentions_table(expr.left) or \
+            expr_mentions_table(expr.right)
+    if isinstance(expr, A.Not):
+        return expr_mentions_table(expr.expr)
+    if isinstance(expr, A.IsNull) and expr.expr is not None:
+        return expr_mentions_table(expr.expr)
+    return False

@@ -166,6 +166,14 @@ class WindowOp(Operator):
         if this window never needs timer wakeups."""
         return None
 
+    def findable_buffer(self, state) -> dict:
+        """The window content a join/table find() searches (= the
+        reference's expiredEventQueue handed to OperatorParser in
+        compileCondition, e.g. TimeWindowProcessor.java:172-184)."""
+        raise CompileError(
+            f"window '{type(self).__name__}' is not findable (cannot be "
+            "used in joins)")
+
 
 # ---------------------------------------------------------------------------
 # sliding windows
@@ -236,6 +244,9 @@ class TimeWindowOp(WindowOp):
         buf = state["buf"]
         due = jnp.where(buf["valid"], buf["ts"] + self.T, POS_INF)
         return jnp.min(due)
+
+    def findable_buffer(self, state):
+        return state["buf"]
 
 
 class LengthWindowOp(WindowOp):
@@ -313,6 +324,9 @@ class LengthWindowOp(WindowOp):
         result = emission_sort(out, emit_row, phase, oseq, valid, P + B)
         buf, _ = keep_newest(pool, ~evicted, max(L, 1))
         return ({"buf": buf, "next_seq": next_seq}, result)
+
+    def findable_buffer(self, state):
+        return state["buf"]
 
 
 # ---------------------------------------------------------------------------
@@ -416,6 +430,9 @@ class LengthBatchWindowOp(WindowOp):
         return ({"cur": new_cur, "exp": new_exp, "next_seq": next_seq},
                 result)
 
+    def findable_buffer(self, state):
+        return state["exp"]
+
 
 class TimeBatchWindowOp(WindowOp):
     """#window.timeBatch(T [, startTime]): tumbling time window. Flush
@@ -504,3 +521,6 @@ class TimeBatchWindowOp(WindowOp):
     def next_due(self, state):
         ne = state["next_emit"]
         return jnp.where(ne == -1, POS_INF, ne)
+
+    def findable_buffer(self, state):
+        return state["exp"]

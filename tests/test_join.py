@@ -1,0 +1,124 @@
+"""Windowed join tests, modeled on the reference corpus
+(modules/siddhi-core/src/test/.../query/join/JoinTestCase.java,
+OuterJoinTestCase.java): two streams with windows, on-condition,
+inner/outer/unidirectional variants.
+"""
+import pytest
+
+from siddhi_tpu import Event, QueryCallback, SiddhiManager, StreamCallback
+
+PLAYBACK = "@app:playback "
+
+STREAMS = PLAYBACK + """
+    define stream StockStream (symbol string, price float, volume int);
+    define stream TwitterStream (user string, tweet string, company string);
+"""
+
+
+def build(ql, targets=("Out",)):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    for t in targets:
+        rt.add_callback(t, StreamCallback(fn=lambda evs: got.extend(evs)))
+    rt.start()
+    return rt, got
+
+
+class TestInnerJoin:
+    QL = STREAMS + """
+        @info(name = 'q')
+        from StockStream#window.time(1 sec) join TwitterStream#window.time(1 sec)
+        on StockStream.symbol == TwitterStream.company
+        select StockStream.symbol as symbol, TwitterStream.tweet as tweet,
+               StockStream.price as price
+        insert into Out;
+    """
+
+    def test_basic_match(self):
+        rt, got = build(self.QL)
+        stock = rt.get_input_handler("StockStream")
+        twitter = rt.get_input_handler("TwitterStream")
+        stock.send(Event(1000, ("WSO2", 55.5, 100)))
+        twitter.send(Event(1100, ("user1", "hello", "WSO2")))
+        stock.send(Event(1200, ("IBM", 75.5, 100)))  # no tweet match
+        rt.shutdown()
+        assert [e.data for e in got] == [("WSO2", "hello", 55.5)]
+
+    def test_both_directions_trigger(self):
+        rt, got = build(self.QL)
+        stock = rt.get_input_handler("StockStream")
+        twitter = rt.get_input_handler("TwitterStream")
+        twitter.send(Event(1000, ("u", "t1", "WSO2")))
+        stock.send(Event(1100, ("WSO2", 10.0, 1)))   # stock triggers
+        twitter.send(Event(1200, ("u", "t2", "WSO2")))  # twitter triggers
+        rt.shutdown()
+        assert [e.data for e in got] == [
+            ("WSO2", "t1", 10.0), ("WSO2", "t2", 10.0)]
+
+    def test_window_expiry_limits_matches(self):
+        rt, got = build(self.QL)
+        stock = rt.get_input_handler("StockStream")
+        twitter = rt.get_input_handler("TwitterStream")
+        stock.send(Event(1000, ("WSO2", 10.0, 1)))
+        twitter.send(Event(2500, ("u", "late", "WSO2")))  # stock expired
+        rt.shutdown()
+        assert got == []
+
+
+class TestJoinAggregation:
+    def test_join_time_window_sum(self):
+        # BASELINE config 3 shape: join + aggregation
+        ql = STREAMS + """
+            from StockStream#window.time(1 sec) join
+                 TwitterStream#window.time(1 sec)
+            on StockStream.symbol == TwitterStream.company
+            select StockStream.symbol as symbol, sum(StockStream.volume)
+                   as vol
+            insert into Out;
+        """
+        rt, got = build(ql)
+        stock = rt.get_input_handler("StockStream")
+        twitter = rt.get_input_handler("TwitterStream")
+        twitter.send(Event(1000, ("u", "t", "WSO2")))
+        stock.send(Event(1100, ("WSO2", 10.0, 5)))
+        stock.send(Event(1200, ("WSO2", 11.0, 7)))
+        rt.shutdown()
+        assert [e.data for e in got] == [("WSO2", 5), ("WSO2", 12)]
+
+
+class TestOuterJoin:
+    def test_left_outer(self):
+        ql = STREAMS + """
+            from StockStream#window.length(5) left outer join
+                 TwitterStream#window.length(5)
+            on StockStream.symbol == TwitterStream.company
+            select StockStream.symbol as symbol, TwitterStream.tweet as tweet
+            insert into Out;
+        """
+        rt, got = build(ql)
+        stock = rt.get_input_handler("StockStream")
+        twitter = rt.get_input_handler("TwitterStream")
+        stock.send(Event(1000, ("WSO2", 10.0, 1)))   # no match -> (WSO2, null)
+        twitter.send(Event(1100, ("u", "t1", "WSO2")))  # right trigger joins
+        stock.send(Event(1200, ("WSO2", 11.0, 2)))   # match
+        rt.shutdown()
+        assert [e.data for e in got] == [
+            ("WSO2", None), ("WSO2", "t1"), ("WSO2", "t1")]
+
+    def test_unidirectional(self):
+        ql = STREAMS + """
+            from StockStream#window.length(5) unidirectional join
+                 TwitterStream#window.length(5)
+            on StockStream.symbol == TwitterStream.company
+            select StockStream.symbol as symbol, TwitterStream.tweet as tweet
+            insert into Out;
+        """
+        rt, got = build(ql)
+        stock = rt.get_input_handler("StockStream")
+        twitter = rt.get_input_handler("TwitterStream")
+        twitter.send(Event(1000, ("u", "t1", "WSO2")))  # must NOT trigger
+        stock.send(Event(1100, ("WSO2", 10.0, 1)))      # triggers
+        twitter.send(Event(1200, ("u", "t2", "WSO2")))  # must NOT trigger
+        rt.shutdown()
+        assert [e.data for e in got] == [("WSO2", "t1")]
