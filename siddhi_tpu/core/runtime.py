@@ -357,10 +357,15 @@ class JoinQueryRuntime(QueryRuntime):
             isinstance(op, WindowOp) and
             op.next_due(op.init_state()) is not None
             for ops in self.side_ops.values() for op in ops)
-        self.overflow = 0
+        self._overflow_dev = jnp.int64(0)
 
     def receive(self, events):
         raise RuntimeError("join runtimes consume via JoinStreamReceivers")
+
+    @property
+    def overflow(self) -> int:
+        """Total join pairs dropped at the join_cap limit so far."""
+        return int(jax.device_get(self._overflow_dev))
 
     def _step_for_side(self, side: str) -> Callable:
         fn = self._side_steps.get(side)
@@ -440,6 +445,9 @@ class JoinQueryRuntime(QueryRuntime):
                     self.app.tables[t].state = tstates[t]
             self.side_states[side] = my
             self.states = sel
+            # join pairs beyond join_cap are dropped by JoinCross.cross —
+            # counted here, never silent (join.py design contract)
+            self._overflow_dev = self._overflow_dev + lost
         self._dispatch_output(out, timestamp,
                               due=due if self._has_timers else None)
 

@@ -146,16 +146,17 @@ class TableOnScope(Scope):
             idx = self.table_schema.index_of(var.attribute)
             return ("T", idx), self.table_schema.types[idx]
         if ref is None and var.attribute in self.table_schema.names:
-            # bare names prefer the table side (reference: matching meta
-            # puts the store event first)
+            # bare names bind to the event side when it has the attribute
+            # (`delete T on symbol == T.symbol`: bare `symbol` is the
+            # incoming event's, matching the reference's meta resolution
+            # order, ExpressionParser.java:1330-1339); the table column is
+            # the fallback only when the event scope lacks the name
             try:
                 key, t = self.event_scope.resolve(var)
-                # ambiguous: table wins only if event scope lacks it
-            except CompileError:
+                return ("S", key), t
+            except (CompileError, KeyError):
                 idx = self.table_schema.index_of(var.attribute)
                 return ("T", idx), self.table_schema.types[idx]
-            idx = self.table_schema.index_of(var.attribute)
-            return ("T", idx), self.table_schema.types[idx]
         key, t = self.event_scope.resolve(var)
         return ("S", key), t
 

@@ -1,0 +1,128 @@
+"""In-memory table runtime tests: insert / delete / update /
+update-or-insert, including bare-name ON conditions (the reference resolves
+bare attribute names to the event side first — ExpressionParser.java:1330).
+"""
+import numpy as np
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.types import GLOBAL_STRINGS
+
+
+def table_rows(rt, table_id):
+    """Decode a table's device state into {tuple(values)} (valid rows)."""
+    tr = rt.tables[table_id]
+    import jax
+    st = jax.device_get(tr.state)
+    rows = set()
+    for r in range(tr.cap):
+        if not st["valid"][r]:
+            continue
+        vals = []
+        for i, t in enumerate(tr.schema.types):
+            from siddhi_tpu.core.types import AttrType
+            if st["nulls"][i][r]:
+                vals.append(None)
+            elif t is AttrType.STRING:
+                vals.append(GLOBAL_STRINGS.decode(st["cols"][i][r]))
+            elif t in (AttrType.FLOAT, AttrType.DOUBLE):
+                vals.append(round(float(st["cols"][i][r]), 4))
+            else:
+                vals.append(int(st["cols"][i][r]))
+        rows.add(tuple(vals))
+    return rows
+
+
+def make_app(extra_query):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(f"""
+        @app:playback
+        define stream StockStream (symbol string, price float, volume long);
+        define stream OpStream (symbol string, price float, volume long);
+        define table StockTable (symbol string, price float, volume long);
+        @info(name = 'fill')
+        from StockStream select symbol, price, volume insert into StockTable;
+        {extra_query}
+    """)
+    rt.start()
+    return rt
+
+
+def send(rt, stream, ts, data):
+    from siddhi_tpu.core.stream import Event
+    rt.get_input_handler(stream).send(Event(timestamp=ts, data=tuple(data)))
+
+
+def fill(rt):
+    send(rt, "StockStream", 1000, ("IBM", 10.0, 100))
+    send(rt, "StockStream", 1001, ("WSO2", 20.0, 200))
+    send(rt, "StockStream", 1002, ("GOOG", 30.0, 300))
+
+
+def test_insert_and_contents():
+    rt = make_app("")
+    fill(rt)
+    assert table_rows(rt, "StockTable") == {
+        ("IBM", 10.0, 100), ("WSO2", 20.0, 200), ("GOOG", 30.0, 300)}
+    rt.shutdown()
+
+
+def test_delete_bare_name_on_condition():
+    """`on symbol == StockTable.symbol`: bare `symbol` must bind to the
+    deleting event, NOT the table column (which would delete every row)."""
+    rt = make_app("""
+        @info(name = 'del')
+        from OpStream select symbol, price, volume
+        delete StockTable on symbol == StockTable.symbol;
+    """)
+    fill(rt)
+    send(rt, "OpStream", 2000, ("WSO2", 0.0, 0))
+    assert table_rows(rt, "StockTable") == {
+        ("IBM", 10.0, 100), ("GOOG", 30.0, 300)}
+    rt.shutdown()
+
+
+def test_update_bare_name_set_and_on():
+    rt = make_app("""
+        @info(name = 'upd')
+        from OpStream select symbol, price, volume
+        update StockTable
+        set StockTable.price = price
+        on StockTable.symbol == symbol;
+    """)
+    fill(rt)
+    send(rt, "OpStream", 2000, ("IBM", 99.5, 0))
+    assert table_rows(rt, "StockTable") == {
+        ("IBM", 99.5, 100), ("WSO2", 20.0, 200), ("GOOG", 30.0, 300)}
+    rt.shutdown()
+
+
+def test_update_default_set_clause():
+    """No SET: every table attribute updates from the same-named output
+    attribute (UpdateTableCallback default) — values from the EVENT."""
+    rt = make_app("""
+        @info(name = 'upd')
+        from OpStream select symbol, price, volume
+        update StockTable on StockTable.symbol == symbol;
+    """)
+    fill(rt)
+    send(rt, "OpStream", 2000, ("GOOG", 77.0, 700))
+    assert table_rows(rt, "StockTable") == {
+        ("IBM", 10.0, 100), ("WSO2", 20.0, 200), ("GOOG", 77.0, 700)}
+    rt.shutdown()
+
+
+def test_update_or_insert():
+    rt = make_app("""
+        @info(name = 'uoi')
+        from OpStream select symbol, price, volume
+        update or insert into StockTable
+        set StockTable.volume = volume
+        on StockTable.symbol == symbol;
+    """)
+    fill(rt)
+    send(rt, "OpStream", 2000, ("IBM", 0.0, 111))   # update existing
+    send(rt, "OpStream", 2001, ("MSFT", 40.0, 400))  # insert new
+    assert table_rows(rt, "StockTable") == {
+        ("IBM", 10.0, 111), ("WSO2", 20.0, 200), ("GOOG", 30.0, 300),
+        ("MSFT", 40.0, 400)}
+    rt.shutdown()
