@@ -38,12 +38,13 @@ from ..ops.windows import (POS_INF, LengthBatchWindowOp, LengthWindowOp,
                            TimeBatchWindowOp, TimeWindowOp, WindowOp)
 from .event import (CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema,
                     batch_from_rows, rows_from_batch)
+from .ingest import PackedChunk, unpack_parts
 from .scheduler import Scheduler
 from .stream import (Event, InputHandler, QueryCallback, Receiver,
                      StreamCallback, StreamJunction)
 from .types import AttrType
 
-BATCH_BUCKETS = (16, 128, 1024, 8192, 65536)
+BATCH_BUCKETS = (16, 128, 1024, 8192, 65536, 262144, 1048576)
 
 WINDOW_CLASSES = {
     "time": TimeWindowOp,
@@ -114,6 +115,10 @@ class QueryRuntime(Receiver):
         self.table_deps = sorted({t for op in operators
                                   for t in op.table_ids()})
         self._step: Optional[Callable] = None
+        self._packed_step: Optional[Callable] = None
+        # device-resident emitted-row counter: accumulated inside the
+        # packed step (zero host syncs); read once via stats()
+        self._emitted_dev = jnp.int64(0)
         self._lock = threading.Lock()
         self._has_timers = any(
             isinstance(op, WindowOp) and op.next_due(op.init_state())
@@ -152,6 +157,69 @@ class QueryRuntime(Receiver):
         if self._step is None:
             self._step = self._make_step()
         return self._step
+
+    def _make_packed_step(self):
+        """Fused unpack + operator chain over a PackedChunk's lanes (the
+        high-throughput ingest path, see core/ingest.py)."""
+        ops = self.operators
+        has_timers = self._has_timers
+        schema = self.in_schema
+
+        def pstep(states, tstates, emitted, parts, base_ts, n, now):
+            batch = unpack_parts(schema, parts, base_ts, n)
+            new_states = []
+            for op, st in zip(ops, states):
+                if op.needs_tables:
+                    st, batch, tstates = op.step_tables(st, batch, now,
+                                                        tstates)
+                else:
+                    st, batch = op.step(st, batch, now)
+                new_states.append(st)
+            if has_timers:
+                dues = [op.next_due(st) for op, st in zip(ops, new_states)
+                        if isinstance(op, WindowOp)]
+                dues = [d for d in dues if d is not None]
+                due = dues[0]
+                for d in dues[1:]:
+                    due = jnp.minimum(due, d)
+            else:
+                due = jnp.int64(2 ** 62)
+            emitted = emitted + batch.count().astype(jnp.int64)
+            return tuple(new_states), tstates, emitted, batch, due
+
+        return jax.jit(pstep)
+
+    def process_packed(self, chunk: PackedChunk) -> None:
+        now = self.app.current_time()
+        with self._lock:
+            if self._packed_step is None:
+                self._packed_step = self._make_packed_step()
+            with self._table_locks():
+                tstates = {t: self.app.tables[t].state
+                           for t in self.table_deps}
+                (self.states, tstates, self._emitted_dev, out,
+                 due) = self._packed_step(
+                    self.states, tstates, self._emitted_dev, chunk.parts,
+                    np.int64(chunk.base_ts), np.int32(chunk.n),
+                    np.int64(now))
+                for t in self.table_deps:
+                    self.app.tables[t].state = tstates[t]
+        self._dispatch_output(out, chunk.last_ts,
+                              due=due if self._has_timers else None)
+
+    def stats(self) -> dict:
+        """Runtime counters (device-synced on read)."""
+        return {"emitted": int(jax.device_get(self._emitted_dev)),
+                "overflow": self.overflow_total()}
+
+    def overflow_total(self) -> int:
+        """Sum of overflow counters across operator states (windows etc.;
+        the 'counted, never silent' contract)."""
+        total = 0
+        for st in jax.device_get(self.states):
+            if isinstance(st, dict) and "overflow" in st:
+                total += int(st["overflow"])
+        return total
 
     # -- runtime ---------------------------------------------------------
     @staticmethod
@@ -257,6 +325,9 @@ class PatternStreamReceiver(Receiver):
     def process_batch(self, batch, last_ts):
         self.runtime.process_pattern_batch(self.stream_id, batch, last_ts)
 
+    def process_packed(self, chunk):
+        self.runtime.process_pattern_packed(self.stream_id, chunk)
+
 
 class PatternQueryRuntime(QueryRuntime):
     """Pattern/sequence query: the NFA engine feeds the selector chain.
@@ -277,14 +348,16 @@ class PatternQueryRuntime(QueryRuntime):
         raise RuntimeError(
             "pattern runtimes consume via per-stream PatternStreamReceivers")
 
-    def _step_for_stream(self, stream_id: str) -> Callable:
-        fn = self._stream_steps.get(stream_id)
+    def _step_for_stream(self, stream_id: str,
+                         packed: bool = False) -> Callable:
+        key = (stream_id, packed)
+        fn = self._stream_steps.get(key)
         if fn is None:
             nfa_step = self.engine.make_stream_step(stream_id)
             sel_ops = self.operators
+            schema = self.app.schemas[stream_id]
 
-            def step(nfa_state, sel_states, tstates, batch: EventBatch,
-                     now):
+            def run(nfa_state, sel_states, tstates, batch, now):
                 nfa_state, match = nfa_step(nfa_state, batch, now)
                 new_sel = []
                 for op, st in zip(sel_ops, sel_states):
@@ -296,9 +369,36 @@ class PatternQueryRuntime(QueryRuntime):
                     new_sel.append(st)
                 return nfa_state, tuple(new_sel), tstates, match
 
+            if packed:
+                def step(nfa_state, sel_states, tstates, emitted, parts,
+                         base_ts, n, now):
+                    batch = unpack_parts(schema, parts, base_ts, n)
+                    nfa_state, sel, tstates, match = run(
+                        nfa_state, sel_states, tstates, batch, now)
+                    emitted = emitted + match.count().astype(jnp.int64)
+                    return nfa_state, sel, tstates, emitted, match
+            else:
+                step = run
             fn = jax.jit(step)
-            self._stream_steps[stream_id] = fn
+            self._stream_steps[key] = fn
         return fn
+
+    def process_pattern_packed(self, stream_id: str,
+                               chunk: PackedChunk) -> None:
+        now = np.int64(self.app.current_time())
+        with self._lock:
+            step = self._step_for_stream(stream_id, packed=True)
+            with self._table_locks():
+                tstates = {t: self.app.tables[t].state
+                           for t in self.table_deps}
+                (self.nfa_state, self.states, tstates, self._emitted_dev,
+                 out) = step(self.nfa_state, self.states, tstates,
+                             self._emitted_dev, chunk.parts,
+                             np.int64(chunk.base_ts), np.int32(chunk.n),
+                             now)
+                for t in self.table_deps:
+                    self.app.tables[t].state = tstates[t]
+        self._dispatch_output(out, chunk.last_ts)
 
     def process_stream_events(self, stream_id: str, events) -> None:
         schema = self.app.schemas[stream_id]
@@ -330,6 +430,9 @@ class JoinStreamReceiver(Receiver):
 
     def process_batch(self, batch, last_ts):
         self.runtime.process_side_batch(self.side, batch, last_ts)
+
+    def process_packed(self, chunk):
+        self.runtime.process_side_packed(self.side, chunk)
 
 
 class JoinQueryRuntime(QueryRuntime):
@@ -367,8 +470,8 @@ class JoinQueryRuntime(QueryRuntime):
         """Total join pairs dropped at the join_cap limit so far."""
         return int(jax.device_get(self._overflow_dev))
 
-    def _step_for_side(self, side: str) -> Callable:
-        fn = self._side_steps.get(side)
+    def _step_for_side(self, side: str, packed: bool = False) -> Callable:
+        fn = self._side_steps.get((side, packed))
         if fn is None:
             my_ops = self.side_ops[side]
             opp = "R" if side == "L" else "L"
@@ -418,9 +521,44 @@ class JoinQueryRuntime(QueryRuntime):
                 return (tuple(new_my), tuple(new_sel), tstates, joined,
                         lost, due)
 
-            fn = jax.jit(step)
-            self._side_steps[side] = fn
+            if packed:
+                my_schema = self.in_schemas[side]
+
+                def pstep(my_states, opp_states, sel_states, tstates,
+                          emitted, parts, base_ts, n, now):
+                    batch = unpack_parts(my_schema, parts, base_ts, n)
+                    my, sel, tstates, joined, lost, due = step(
+                        my_states, opp_states, sel_states, tstates, batch,
+                        now)
+                    emitted = emitted + joined.count().astype(jnp.int64)
+                    return my, sel, tstates, emitted, joined, lost, due
+
+                fn = jax.jit(pstep)
+            else:
+                fn = jax.jit(step)
+            self._side_steps[(side, packed)] = fn
         return fn
+
+    def process_side_packed(self, side: str, chunk: PackedChunk) -> None:
+        now = np.int64(self.app.current_time())
+        opp = "R" if side == "L" else "L"
+        with self._lock:
+            step = self._step_for_side(side, packed=True)
+            with self._table_locks():
+                tstates = {t: self.app.tables[t].state
+                           for t in self.table_deps}
+                (my, sel, tstates, self._emitted_dev, out, lost,
+                 due) = step(self.side_states[side], self.side_states[opp],
+                             self.states, tstates, self._emitted_dev,
+                             chunk.parts, np.int64(chunk.base_ts),
+                             np.int32(chunk.n), now)
+                for t in self.table_deps:
+                    self.app.tables[t].state = tstates[t]
+            self.side_states[side] = my
+            self.states = sel
+            self._overflow_dev = self._overflow_dev + lost
+        self._dispatch_output(out, chunk.last_ts,
+                              due=due if self._has_timers else None)
 
     def process_side_events(self, side: str, events) -> None:
         for batch, last_ts in self.encode_chunks(self.in_schemas[side],

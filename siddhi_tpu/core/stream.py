@@ -109,8 +109,14 @@ class InputHandler:
         """Columnar ingest: numpy timestamp + data column arrays
         (STRING columns as dictionary codes). Device batches with no
         per-row Python — the framework's intended high-throughput operating
-        mode. Capacities are bucketed so jit caches stay warm."""
+        mode. Capacities are bucketed so jit caches stay warm.
+
+        When every subscriber supports the packed path, chunks travel as
+        delta/lane-packed 32-bit arrays with one device transfer and zero
+        per-batch host syncs (core/ingest.py); otherwise falls back to the
+        EventBatch path."""
         from .event import batch_from_columns
+        from .ingest import PackedChunk
         from .runtime import BATCH_BUCKETS, bucket_capacity
         if not self.app.running:
             raise RuntimeError(
@@ -118,13 +124,23 @@ class InputHandler:
         n = len(ts)
         if n == 0:
             return
+        packed_ok = all(hasattr(r, "process_packed")
+                        for r in self.junction.receivers)
         max_cap = BATCH_BUCKETS[-1]
         for start in range(0, n, max_cap):
             t = ts[start:start + max_cap]
             c = [col[start:start + max_cap] for col in cols]
+            last_ts = int(t[-1])
+            if packed_ok:
+                chunk = PackedChunk.build(self.junction.schema, t, c,
+                                          bucket_capacity(len(t)))
+                if chunk is not None:
+                    self.app.on_ingest_ts(last_ts)
+                    for r in list(self.junction.receivers):
+                        r.process_packed(chunk)
+                    continue
             batch = batch_from_columns(self.junction.schema, t, c,
                                        capacity=bucket_capacity(len(t)))
-            last_ts = int(t[-1])
             self.app.on_ingest_ts(last_ts)
             self.junction.publish_batch(batch, last_ts)
 
