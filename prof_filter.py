@@ -1,0 +1,91 @@
+"""Ad-hoc profiling of the filter ingest hot path on the real device."""
+import time
+
+import numpy as np
+
+import jax
+import siddhi_tpu
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.types import GLOBAL_STRINGS
+from siddhi_tpu.core.ingest import PackedChunk, PackedEncoder
+
+print("devices:", jax.devices())
+
+mgr = SiddhiManager()
+rt = mgr.create_siddhi_app_runtime("""
+    @app:playback
+    define stream StockStream (symbol string, price float, volume long);
+    @info(name = 'q')
+    from StockStream[price > 100.0]
+    select symbol, price
+    insert into OutputStream;
+""")
+q = rt.queries["q"]
+matched = []
+q.batch_callbacks.append(lambda out: matched.append(out.count()))
+rt.start()
+h = rt.get_input_handler("StockStream")
+
+BATCH = 65536
+NB = 8
+rng = np.random.default_rng(7)
+syms = np.array([GLOBAL_STRINGS.encode(s)
+                 for s in ("IBM", "WSO2", "GOOG", "MSFT")], np.int32)
+ts0 = 1_700_000_000_000
+batches = []
+for b in range(NB):
+    ts = ts0 + np.arange(b * BATCH, (b + 1) * BATCH, dtype=np.int64)
+    sym = syms[rng.integers(0, len(syms), BATCH)]
+    price = rng.uniform(0, 200, BATCH).astype(np.float32)
+    vol = rng.integers(1, 1000, BATCH, dtype=np.int64)
+    batches.append((ts, [sym, price, vol]))
+
+# warmup
+h.send_arrays(*batches[0])
+matched[0].block_until_ready()
+matched.clear()
+
+schema = rt.schemas["StockStream"]
+enc = PackedEncoder(schema)
+
+# 1. host encode only
+t0 = time.perf_counter()
+for ts, cols in batches:
+    enc.encode(ts, cols, BATCH, 0)
+t_pack = time.perf_counter() - t0
+buf, etuple, _ = enc.encode(batches[0][0], batches[0][1], BATCH, 0)
+print(f"encode: {t_pack/NB*1000:.1f} ms/batch  enc={etuple} "
+      f"bytes={buf.nbytes} ({buf.nbytes/BATCH:.1f} B/event)")
+
+# 2. encode + device_put (blocking)
+t0 = time.perf_counter()
+chunks = []
+for ts, cols in batches:
+    c = PackedChunk.build(enc, ts, cols, BATCH, now=int(ts[-1]))
+    chunks.append(c)
+jax.block_until_ready([c.buf for c in chunks])
+t_put = time.perf_counter() - t0
+print(f"encode+device_put: {t_put/NB*1000:.1f} ms/batch")
+
+# 3. step only (data already on device)
+step = q._packed_step_for(chunks[0].enc, BATCH)
+out = step(q.states, {}, q._emitted_dev, chunks[0].buf)
+jax.block_until_ready(out)
+t0 = time.perf_counter()
+outs = []
+for c in chunks:
+    outs.append(step(q.states, {}, q._emitted_dev, c.buf))
+jax.block_until_ready(outs)
+t_step = time.perf_counter() - t0
+print(f"step (pre-staged): {t_step/NB*1000:.1f} ms/batch")
+
+# 4. end-to-end send_arrays
+t0 = time.perf_counter()
+for ts, cols in batches:
+    h.send_arrays(ts, cols)
+for m in matched:
+    m.block_until_ready()
+t_e2e = time.perf_counter() - t0
+print(f"send_arrays e2e: {t_e2e/NB*1000:.1f} ms/batch "
+      f"({NB*BATCH/t_e2e:,.0f} ev/s)")
+rt.shutdown()

@@ -2,20 +2,27 @@
 
 The reference's ingest hot path is InputHandler.send -> Disruptor ring
 buffer (stream/StreamJunction.java:255-313). The TPU equivalent is bound by
-the host->device link, so the wire format matters:
+the host->device link (potentially a slow tunnel: ~10 MB/s with ~70 ms
+round-trip latency was measured on this image), so the wire format matters
+more than anything else on the ingest side:
 
-- every 64-bit column (LONG/DOUBLE and the timestamp lane) is split into
-  two 1-D 32-bit lanes host-side and recombined on device: the TPU runtime
-  transfers 1-D 32-bit arrays several times faster than int64 (which takes
-  a slow conversion path) or 2-D arrays (layout tiling);
-- timestamps are delta-encoded against the chunk's first timestamp (int32
-  offsets + one int64 base scalar): monotonic ms deltas are tiny and
-  compress to almost nothing on compressing transports;
-- the hi lanes of small-valued LONG columns are constant zero and likewise
-  compress away;
-- chunks are zero-padded to the bucket capacity (zero tails are free);
+- EVERYTHING for a chunk travels in ONE 1-D uint8 buffer = one transfer =
+  one RTT (a tuple of per-column arrays pays the round-trip per array);
+- every dynamic scalar (row count, base timestamp, processing time, per-
+  column bases) is embedded in the buffer header, so the jitted step takes
+  no separate scalar arguments at all;
+- each column is adaptively narrowed per chunk: constant columns ship zero
+  bytes (base in the header), integer/string/long columns ship min-offset
+  deltas in the narrowest of u8/u16/u32, timestamps detect arithmetic
+  progressions ('aff': zero bytes + stride in the header), bools bit-pack
+  to 1 bit/row, floats ship raw bits;
+- encodings are STICKY per stream (they only ever widen), because the
+  encoding tuple is part of the jit cache key — flapping between widths
+  would trigger recompiles;
+- chunks are zero-padded to the bucket capacity (zero tails compress to
+  nothing on compressing transports and cost little raw);
 - the validity mask / kind lane / null masks are NOT transferred at all —
-  they are reconstructed on device from the row count.
+  they are reconstructed on device from the header row count.
 
 The jitted query step fuses unpacking with the operator chain, so ingest
 costs one device_put per chunk and zero per-batch host round-trips.
@@ -31,119 +38,277 @@ import numpy as np
 from .event import EventBatch, StreamSchema
 from .types import AttrType
 
-# lanes per attribute type in the packed wire format
-_WIDE = (AttrType.LONG, AttrType.DOUBLE)
+_INT_FAMILY = (AttrType.INT, AttrType.STRING, AttrType.LONG)
+
+# lane byte-width per row for each encoding code
+_CODE_BYTES = {"c": 0, "aff": 0, "d8": 1, "d16": 2, "d32": 4,
+               "f32": 4, "f64": 8, "raw64": 8}
+# widening order within each family (sticky codes only move right)
+_ORDER = ("c", "aff", "b1", "f32", "f64", "d8", "d16", "d32", "raw64")
+_RANK = {c: i for i, c in enumerate(_ORDER)}
 
 
-def lanes_of(t: AttrType) -> int:
-    return 2 if t in _WIDE else 1
+def _pad8(x: int) -> int:
+    return (x + 7) & ~7
 
 
-def _split64(a: np.ndarray, capacity: int):
-    """64-bit numpy column -> (lo, hi) uint32 lanes, zero-padded."""
-    n = a.shape[0]
-    v = a.view(np.uint32).reshape(-1, 2)
-    lo = np.zeros((capacity,), np.uint32)
-    hi = np.zeros((capacity,), np.uint32)
-    lo[:n] = v[:, 0]
-    hi[:n] = v[:, 1]
-    return lo, hi
+def _lane_bytes(code: str, capacity: int) -> int:
+    if code == "b1":
+        return capacity // 8
+    return _CODE_BYTES[code] * capacity
 
 
-def pack_columns(schema: StreamSchema, ts: np.ndarray, cols: Sequence,
-                 capacity: int):
-    """Host side: (ts, data columns) -> (parts tuple, base_ts, n).
+def layout(n_cols: int, enc: tuple, capacity: int):
+    """(header bytes, per-lane byte offsets, total buffer bytes).
 
-    Returns None if the chunk cannot be delta-encoded (timestamp span
-    exceeding int32 ms range ~ 24 days) — callers fall back to the
-    EventBatch path.
-    """
-    ts = np.asarray(ts, dtype=np.int64)
-    n = ts.shape[0]
-    assert n <= capacity, (n, capacity)
-    base = int(ts[0]) if n else 0
-    span_ok = n == 0 or (int(ts[-1]) - base < 2 ** 31 and
-                         int(ts.min()) >= base - 2 ** 31)
-    if not span_ok:
-        return None
-    off = np.zeros((capacity,), np.int32)
-    off[:n] = ts - base
-    parts = [off]
-    for t, c in zip(schema.types, cols):
-        c = np.asarray(c)
-        if t in _WIDE:
-            want = np.int64 if t is AttrType.LONG else np.float64
-            if c.dtype != want:
-                c = c.astype(want)
-            parts.extend(_split64(c, capacity))
-        elif t is AttrType.FLOAT:
-            buf = np.zeros((capacity,), np.float32)
-            buf[:n] = c
-            parts.append(buf)
-        elif t is AttrType.BOOL:
-            buf = np.zeros((capacity,), np.bool_)
-            buf[:n] = c
-            parts.append(buf)
-        else:  # INT, STRING dictionary codes
-            buf = np.zeros((capacity,), np.int32)
-            buf[:n] = c
-            parts.append(buf)
-    return tuple(parts), base, n
+    enc = (ts_code, col_code...). Header int64 slots:
+    [0]=n, [1]=base_ts, [2]=now, [3]=ts_stride, [4+i]=col i base."""
+    H = (4 + n_cols) * 8
+    offs = []
+    o = H
+    for code in enc:
+        offs.append(o)
+        o += _pad8(_lane_bytes(code, capacity))
+    return H, offs, o
 
 
-def _join64(lo, hi):
-    return (lo.astype(jnp.uint64) |
-            (hi.astype(jnp.uint64) << jnp.uint64(32)))
+def _int_code(span: int) -> str:
+    if span < 2 ** 8:
+        return "d8"
+    if span < 2 ** 16:
+        return "d16"
+    if span < 2 ** 32:
+        return "d32"
+    return "raw64"
 
 
-def unpack_parts(schema: StreamSchema, parts, base_ts, n) -> EventBatch:
-    """Device side (inside jit): packed lanes -> EventBatch.
+class PackedEncoder:
+    """Per-stream sticky encoding chooser: codes only widen across chunks
+    (each distinct encoding tuple is a separate XLA compile)."""
+
+    def __init__(self, schema: StreamSchema):
+        self.schema = schema
+        self._ts_code = "aff"
+        self._col_codes = ["c"] * len(schema.types)
+
+    def _widen(self, cur: str, cand: str) -> str:
+        return cand if _RANK[cand] > _RANK[cur] else cur
+
+    def encode(self, ts: np.ndarray, cols: Sequence, capacity: int,
+               now: int):
+        """-> (buf np.uint8[total], enc tuple, n)."""
+        assert capacity % 8 == 0, capacity
+        ts = np.ascontiguousarray(ts, dtype=np.int64)
+        n = int(ts.shape[0])
+        types = self.schema.types
+
+        # --- choose codes -------------------------------------------------
+        if n >= 2:
+            stride = int(ts[1]) - int(ts[0])
+            is_aff = bool(np.all(np.diff(ts) == stride))
+        else:
+            stride, is_aff = 0, True
+        tmin = int(ts.min()) if n else 0
+        base_ts = int(ts[0]) if is_aff and n else tmin
+        span_code = _int_code(int(ts.max()) - tmin) if n else "d8"
+        ts_cand = "aff" if is_aff else span_code
+        self._ts_code = self._widen(self._ts_code, ts_cand)
+        if self._ts_code != "aff":
+            # once on a delta code, the width must cover THIS chunk's span
+            # even when the chunk itself is affine (offsets would wrap)
+            self._ts_code = self._widen(self._ts_code, span_code)
+        ts_code = self._ts_code
+        if ts_code != "aff":
+            base_ts = tmin  # offsets must be non-negative
+
+        ncols = []
+        bases = []
+        for i, t in enumerate(types):
+            c = np.ascontiguousarray(np.asarray(cols[i]))
+            if t in _INT_FAMILY:
+                want = np.int64 if t is AttrType.LONG else np.int32
+                if c.dtype != want:
+                    c = c.astype(want)
+                lo = int(c.min()) if n else 0
+                hi = int(c.max()) if n else 0
+                cand = "c" if lo == hi else _int_code(hi - lo)
+                base = lo
+            elif t is AttrType.FLOAT:
+                c = c.astype(np.float32) if c.dtype != np.float32 else c
+                u = c.view(np.uint32)
+                cand = "c" if (n and (u == u[0]).all()) or n == 0 else "f32"
+                base = int(np.int64(np.float64(c[0]).view(np.int64))) \
+                    if (cand == "c" and n) else 0
+            elif t is AttrType.DOUBLE:
+                c = c.astype(np.float64) if c.dtype != np.float64 else c
+                u = c.view(np.uint64)
+                cand = "c" if (n and (u == u[0]).all()) or n == 0 else "f64"
+                base = int(c[:1].view(np.int64)[0]) if (cand == "c" and n) \
+                    else 0
+            elif t is AttrType.BOOL:
+                c = c.astype(np.bool_) if c.dtype != np.bool_ else c
+                if n and (c == c[0]).all():
+                    cand, base = "c", int(c[0])
+                elif n == 0:
+                    cand, base = "c", 0
+                else:
+                    cand, base = "b1", 0
+            else:
+                raise TypeError(f"cannot pack column type {t}")
+            code = self._widen(self._col_codes[i], cand)
+            self._col_codes[i] = code
+            if code != "c" and t in _INT_FAMILY:
+                base = lo  # delta base even when chunk is constant
+            ncols.append((code, c))
+            bases.append(base)
+
+        enc = (ts_code,) + tuple(code for code, _ in ncols)
+
+        # --- assemble the single buffer ----------------------------------
+        H, offs, total = layout(len(types), enc, capacity)
+        buf = np.zeros((total,), np.uint8)
+        hdr = buf[:H].view(np.int64)
+        hdr[0] = n
+        hdr[1] = base_ts
+        hdr[2] = now
+        hdr[3] = stride
+        for i, b in enumerate(bases):
+            hdr[4 + i] = b
+
+        def put(o: int, arr: np.ndarray):
+            raw = arr.view(np.uint8)
+            buf[o:o + raw.nbytes] = raw
+
+        # ts lane
+        if ts_code == "d8":
+            put(offs[0], (ts - base_ts).astype(np.uint8))
+        elif ts_code == "d16":
+            put(offs[0], (ts - base_ts).astype(np.uint16))
+        elif ts_code == "d32":
+            put(offs[0], (ts - base_ts).astype(np.uint32))
+        elif ts_code == "raw64":
+            put(offs[0], ts)
+
+        for i, ((code, c), base) in enumerate(zip(ncols, bases)):
+            o = offs[1 + i]
+            if code == "c":
+                continue
+            if code == "b1":
+                bits = np.zeros((capacity,), np.bool_)
+                bits[:n] = c
+                put(o, np.packbits(bits, bitorder="little"))
+            elif code == "f32":
+                put(o, c)
+            elif code == "f64":
+                put(o, c)
+            elif code == "raw64":
+                put(o, c.astype(np.int64))
+            else:  # d8/d16/d32 deltas
+                dt = {"d8": np.uint8, "d16": np.uint16,
+                      "d32": np.uint32}[code]
+                put(o, (c.astype(np.int64) - base).astype(dt))
+        return buf, enc, n
+
+
+def _bitcast_lane(buf, offset: int, capacity: int, width: int, dtype):
+    raw = jax.lax.dynamic_slice(buf, (offset,), (capacity * width,))
+    if width == 1:
+        return raw.astype(dtype) if dtype != jnp.uint8 else raw
+    return jax.lax.bitcast_convert_type(raw.reshape(capacity, width), dtype)
+
+
+def unpack_buffer(schema: StreamSchema, enc: tuple, capacity: int, buf):
+    """Device side (inside jit): single packed buffer -> (EventBatch, now).
 
     Rows >= n are padding; nulls are all-false (the packed path carries no
     nulls — null-bearing sends use the row path)."""
-    capacity = parts[0].shape[0]
-    ts = base_ts.astype(jnp.int64) + parts[0].astype(jnp.int64)
+    types = schema.types
+    C = len(types)
+    H, offs, total = layout(C, enc, capacity)
+    hdr = jax.lax.bitcast_convert_type(buf[:H].reshape(4 + C, 8), jnp.int64)
+    n, base_ts, now, stride = hdr[0], hdr[1], hdr[2], hdr[3]
+    rows = jnp.arange(capacity, dtype=jnp.int64)
+    valid = rows < n
+
+    ts_code = enc[0]
+    if ts_code == "aff":
+        ts = base_ts + stride * rows
+    elif ts_code == "raw64":
+        ts = _bitcast_lane(buf, offs[0], capacity, 8, jnp.int64)
+    else:
+        w = {"d8": 1, "d16": 2, "d32": 4}[ts_code]
+        dt = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}[w]
+        ts = base_ts + _bitcast_lane(buf, offs[0], capacity, w,
+                                     dt).astype(jnp.int64)
+    ts = jnp.where(valid, ts, base_ts)
+
     cols = []
-    i = 1
-    for t in schema.types:
-        if t is AttrType.LONG:
-            cols.append(_join64(parts[i], parts[i + 1]).astype(jnp.int64))
-            i += 2
+    for i, t in enumerate(types):
+        code = enc[1 + i]
+        o = offs[1 + i]
+        base = hdr[4 + i]
+        if t in _INT_FAMILY:
+            out_dt = jnp.int64 if t is AttrType.LONG else jnp.int32
+            if code == "c":
+                col = jnp.full((capacity,), base).astype(out_dt)
+            elif code == "raw64":
+                col = _bitcast_lane(buf, o, capacity, 8, jnp.int64)
+                col = col.astype(out_dt)
+            else:
+                w = {"d8": 1, "d16": 2, "d32": 4}[code]
+                dt = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32}[w]
+                col = (base + _bitcast_lane(buf, o, capacity, w,
+                                            dt).astype(jnp.int64))
+                col = col.astype(out_dt)
+        elif t is AttrType.FLOAT:
+            if code == "c":
+                f = jax.lax.bitcast_convert_type(base, jnp.float64)
+                col = jnp.full((capacity,), f.astype(jnp.float32))
+            else:
+                col = _bitcast_lane(buf, o, capacity, 4, jnp.float32)
         elif t is AttrType.DOUBLE:
-            u = _join64(parts[i], parts[i + 1])
-            cols.append(jax.lax.bitcast_convert_type(u, jnp.float64))
-            i += 2
-        else:
-            cols.append(parts[i])
-            i += 1
-    valid = jnp.arange(capacity, dtype=jnp.int32) < n
-    # padding rows get ts 0 would disturb nothing (valid=False), but keep
-    # them at base_ts so monotonic-time invariants hold under lax ops
-    return EventBatch(
-        ts=jnp.where(valid, ts, base_ts.astype(jnp.int64)),
+            if code == "c":
+                col = jnp.full(
+                    (capacity,),
+                    jax.lax.bitcast_convert_type(base, jnp.float64))
+            else:
+                col = _bitcast_lane(buf, o, capacity, 8, jnp.float64)
+        else:  # BOOL
+            if code == "c":
+                col = jnp.full((capacity,), base != 0)
+            else:
+                bytes_ = buf[o:o + capacity // 8]
+                idx = jnp.arange(capacity)
+                col = ((bytes_[idx >> 3] >> (idx & 7).astype(jnp.uint8))
+                       & 1).astype(jnp.bool_)
+        cols.append(col)
+
+    batch = EventBatch(
+        ts=ts,
         cols=tuple(cols),
         nulls=tuple(jnp.zeros((capacity,), jnp.bool_) for _ in cols),
         kind=jnp.zeros((capacity,), jnp.int32),
         valid=valid,
     )
+    return batch, now
 
 
 class PackedChunk:
     """One device-resident packed chunk, shared by every subscriber of a
     junction (transferred once)."""
 
-    __slots__ = ("parts", "base_ts", "n", "last_ts")
+    __slots__ = ("buf", "enc", "capacity", "n", "last_ts")
 
-    def __init__(self, parts, base_ts: int, n: int, last_ts: int):
-        self.parts = parts          # tuple of device arrays
-        self.base_ts = base_ts      # host int
-        self.n = n                  # host int (rows used)
+    def __init__(self, buf, enc: tuple, capacity: int, n: int,
+                 last_ts: int):
+        self.buf = buf              # ONE device uint8 array
+        self.enc = enc              # static encoding tuple (jit cache key)
+        self.capacity = capacity
+        self.n = n
         self.last_ts = last_ts
 
     @classmethod
-    def build(cls, schema: StreamSchema, ts, cols, capacity: int):
-        packed = pack_columns(schema, ts, cols, capacity)
-        if packed is None:
-            return None
-        parts, base, n = packed
-        return cls(jax.device_put(parts), base, n, int(ts[-1]))
+    def build(cls, encoder: PackedEncoder, ts, cols, capacity: int,
+              now: int):
+        buf, enc, n = encoder.encode(ts, cols, capacity, now)
+        return cls(jax.device_put(buf), enc, capacity, n, int(ts[-1]))

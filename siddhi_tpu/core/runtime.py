@@ -38,7 +38,7 @@ from ..ops.windows import (POS_INF, LengthBatchWindowOp, LengthWindowOp,
                            TimeBatchWindowOp, TimeWindowOp, WindowOp)
 from .event import (CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema,
                     batch_from_rows, rows_from_batch)
-from .ingest import PackedChunk, unpack_parts
+from .ingest import PackedChunk, unpack_buffer
 from .scheduler import Scheduler
 from .stream import (Event, InputHandler, QueryCallback, Receiver,
                      StreamCallback, StreamJunction)
@@ -65,14 +65,38 @@ class OutputHandler:
     def handle(self, timestamp: int, rows: list) -> None:
         raise NotImplementedError
 
+    def handle_device_batch(self, out, timestamp: int) -> bool:
+        """Try to consume the DEVICE output batch without host row decode
+        (device-to-device query chaining). Returns True when consumed —
+        the row path is then skipped for this handler."""
+        return False
+
 
 class InsertIntoStreamHandler(OutputHandler):
     """Publish query output into a stream junction; EXPIRED events become
-    CURRENT on insert (InsertIntoStreamCallback.java:52-55)."""
+    CURRENT on insert (InsertIntoStreamCallback.java:52-55).
+
+    When every downstream receiver takes device batches, the output
+    EventBatch is handed over directly — no host decode per hop
+    (the reference's InsertIntoStreamCallback also stays in-memory;
+    here 'in-memory' means on-device)."""
 
     def __init__(self, junction: StreamJunction, output_event_type: str):
         self.junction = junction
         self.output_event_type = output_event_type
+
+    def handle_device_batch(self, out, timestamp: int) -> bool:
+        receivers = self.junction.receivers
+        if not receivers:
+            return True  # nobody listening — drop without decode
+        if all(hasattr(r, "process_batch") for r in receivers):
+            out = EventBatch(
+                ts=out.ts, cols=out.cols, nulls=out.nulls,
+                kind=jnp.where(out.valid, jnp.int32(CURRENT), out.kind),
+                valid=out.valid)
+            self.junction.publish_batch(out, timestamp)
+            return True
+        return False
 
     def handle(self, timestamp, rows):
         events = [Event(timestamp=ts, data=vals) for ts, kind, vals in rows]
@@ -99,6 +123,11 @@ class QueryCallbackHandler(OutputHandler):
 class QueryRuntime(Receiver):
     """One query: an operator chain jitted into a single device step."""
 
+    # explicit packed-ingest capability (send_arrays gates on this, NOT on
+    # hasattr(process_packed): subclasses that need dedicated per-stream
+    # receivers override it back to False)
+    supports_packed = True
+
     def __init__(self, name: str, operators: list[Operator],
                  in_schema: StreamSchema, app: "SiddhiAppRuntime"):
         self.name = name
@@ -115,7 +144,7 @@ class QueryRuntime(Receiver):
         self.table_deps = sorted({t for op in operators
                                   for t in op.table_ids()})
         self._step: Optional[Callable] = None
-        self._packed_step: Optional[Callable] = None
+        self._packed_steps: dict = {}  # (enc, capacity) -> jitted step
         # device-resident emitted-row counter: accumulated inside the
         # packed step (zero host syncs); read once via stats()
         self._emitted_dev = jnp.int64(0)
@@ -130,43 +159,7 @@ class QueryRuntime(Receiver):
         ops = self.operators
         has_timers = self._has_timers
 
-        def step(states, tstates, batch: EventBatch, now):
-            new_states = []
-            for op, st in zip(ops, states):
-                if op.needs_tables:
-                    st, batch, tstates = op.step_tables(st, batch, now,
-                                                        tstates)
-                else:
-                    st, batch = op.step(st, batch, now)
-                new_states.append(st)
-            if has_timers:
-                dues = [op.next_due(st) for op, st in zip(ops, new_states)
-                        if isinstance(op, WindowOp)]
-                dues = [d for d in dues if d is not None]
-                due = dues[0]
-                for d in dues[1:]:
-                    due = jnp.minimum(due, d)
-            else:
-                due = jnp.int64(2 ** 62)
-            return tuple(new_states), tstates, batch, due
-
-        return jax.jit(step)
-
-    def _step_for(self, capacity: int) -> Callable:
-        # one jit wrapper; XLA specializes per batch-capacity shape
-        if self._step is None:
-            self._step = self._make_step()
-        return self._step
-
-    def _make_packed_step(self):
-        """Fused unpack + operator chain over a PackedChunk's lanes (the
-        high-throughput ingest path, see core/ingest.py)."""
-        ops = self.operators
-        has_timers = self._has_timers
-        schema = self.in_schema
-
-        def pstep(states, tstates, emitted, parts, base_ts, n, now):
-            batch = unpack_parts(schema, parts, base_ts, n)
+        def step(states, tstates, emitted, batch: EventBatch, now):
             new_states = []
             for op, st in zip(ops, states):
                 if op.needs_tables:
@@ -187,21 +180,60 @@ class QueryRuntime(Receiver):
             emitted = emitted + batch.count().astype(jnp.int64)
             return tuple(new_states), tstates, emitted, batch, due
 
-        return jax.jit(pstep)
+        return jax.jit(step)
+
+    def _step_for(self, capacity: int) -> Callable:
+        # one jit wrapper; XLA specializes per batch-capacity shape
+        if self._step is None:
+            self._step = self._make_step()
+        return self._step
+
+    def _packed_step_for(self, enc: tuple, capacity: int) -> Callable:
+        """Fused unpack + operator chain over a PackedChunk's single buffer
+        (the high-throughput ingest path, see core/ingest.py). One compile
+        per (encoding tuple, capacity); encodings are sticky so this stays
+        small."""
+        fn = self._packed_steps.get((enc, capacity))
+        if fn is None:
+            ops = self.operators
+            has_timers = self._has_timers
+            schema = self.in_schema
+
+            def pstep(states, tstates, emitted, buf):
+                batch, now = unpack_buffer(schema, enc, capacity, buf)
+                new_states = []
+                for op, st in zip(ops, states):
+                    if op.needs_tables:
+                        st, batch, tstates = op.step_tables(st, batch, now,
+                                                            tstates)
+                    else:
+                        st, batch = op.step(st, batch, now)
+                    new_states.append(st)
+                if has_timers:
+                    dues = [op.next_due(st) for op, st in
+                            zip(ops, new_states) if isinstance(op, WindowOp)]
+                    dues = [d for d in dues if d is not None]
+                    due = dues[0]
+                    for d in dues[1:]:
+                        due = jnp.minimum(due, d)
+                else:
+                    due = jnp.int64(2 ** 62)
+                emitted = emitted + batch.count().astype(jnp.int64)
+                return tuple(new_states), tstates, emitted, batch, due
+
+            fn = jax.jit(pstep)
+            self._packed_steps[(enc, capacity)] = fn
+        return fn
 
     def process_packed(self, chunk: PackedChunk) -> None:
-        now = self.app.current_time()
         with self._lock:
-            if self._packed_step is None:
-                self._packed_step = self._make_packed_step()
+            step = self._packed_step_for(chunk.enc, chunk.capacity)
             with self._table_locks():
                 tstates = {t: self.app.tables[t].state
                            for t in self.table_deps}
                 (self.states, tstates, self._emitted_dev, out,
-                 due) = self._packed_step(
-                    self.states, tstates, self._emitted_dev, chunk.parts,
-                    np.int64(chunk.base_ts), np.int32(chunk.n),
-                    np.int64(now))
+                 due) = step(self.states, tstates, self._emitted_dev,
+                             chunk.buf)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
         self._dispatch_output(out, chunk.last_ts,
@@ -249,8 +281,8 @@ class QueryRuntime(Receiver):
             with self._table_locks():
                 tstates = {t: self.app.tables[t].state
                            for t in self.table_deps}
-                self.states, tstates, out, due = step(
-                    self.states, tstates, batch, now_dev)
+                self.states, tstates, self._emitted_dev, out, due = step(
+                    self.states, tstates, self._emitted_dev, batch, now_dev)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
         self._dispatch_output(out, timestamp,
@@ -264,12 +296,14 @@ class QueryRuntime(Receiver):
         return stack
 
     def _dispatch_output(self, out, timestamp: int, due=None) -> None:
-        """Raw-batch observers, timer scheduling, and (only when someone
-        listens) host row decode + handler/callback delivery."""
+        """Raw-batch observers, device-to-device chaining, timer
+        scheduling, and (only when someone still needs rows) host decode +
+        handler/callback delivery."""
         for cb in self.batch_callbacks:
             cb(out)
-        decode = bool(self.output_handlers or
-                      self.callback_handler.callbacks)
+        row_handlers = [h for h in self.output_handlers
+                        if not h.handle_device_batch(out, timestamp)]
+        decode = bool(row_handlers or self.callback_handler.callbacks)
         if decode and due is not None:
             out_host, due_host = jax.device_get((out, due))
             self._schedule(int(due_host))
@@ -282,7 +316,7 @@ class QueryRuntime(Receiver):
         out_rows = rows_from_batch(self.out_schema.types, out_host)
         if not out_rows:
             return
-        for h in self.output_handlers:
+        for h in row_handlers:
             h.handle(timestamp, out_rows)
         self.callback_handler.handle(timestamp, out_rows)
 
@@ -315,6 +349,8 @@ class PatternStreamReceiver(Receiver):
     """Junction subscriber feeding one stream of a pattern query
     (= PatternMultiProcessStreamReceiver, .../state/receiver/*.java:29)."""
 
+    supports_packed = True
+
     def __init__(self, runtime: "PatternQueryRuntime", stream_id: str):
         self.runtime = runtime
         self.stream_id = stream_id
@@ -337,6 +373,8 @@ class PatternQueryRuntime(QueryRuntime):
     The base-class `states` tuple holds the selector operator states; the
     NFA pending table lives in `nfa_state`."""
 
+    supports_packed = False  # consumes via PatternStreamReceivers only
+
     def __init__(self, name: str, engine: NfaEngine,
                  sel_ops: list[Operator], app: "SiddhiAppRuntime"):
         super().__init__(name, sel_ops, engine.match_schema, app)
@@ -348,9 +386,14 @@ class PatternQueryRuntime(QueryRuntime):
         raise RuntimeError(
             "pattern runtimes consume via per-stream PatternStreamReceivers")
 
+    def overflow_total(self) -> int:
+        """Include the NFA pending-table overflow counter."""
+        total = super().overflow_total()
+        return total + int(jax.device_get(self.nfa_state["overflow"]))
+
     def _step_for_stream(self, stream_id: str,
-                         packed: bool = False) -> Callable:
-        key = (stream_id, packed)
+                         packed_key=None) -> Callable:
+        key = (stream_id, packed_key)
         fn = self._stream_steps.get(key)
         if fn is None:
             nfa_step = self.engine.make_stream_step(stream_id)
@@ -369,33 +412,37 @@ class PatternQueryRuntime(QueryRuntime):
                     new_sel.append(st)
                 return nfa_state, tuple(new_sel), tstates, match
 
-            if packed:
-                def step(nfa_state, sel_states, tstates, emitted, parts,
-                         base_ts, n, now):
-                    batch = unpack_parts(schema, parts, base_ts, n)
+            if packed_key is not None:
+                enc, capacity = packed_key
+
+                def step(nfa_state, sel_states, tstates, emitted, buf):
+                    batch, now = unpack_buffer(schema, enc, capacity, buf)
                     nfa_state, sel, tstates, match = run(
                         nfa_state, sel_states, tstates, batch, now)
                     emitted = emitted + match.count().astype(jnp.int64)
                     return nfa_state, sel, tstates, emitted, match
             else:
-                step = run
+                def step(nfa_state, sel_states, tstates, emitted, batch,
+                         now):
+                    nfa_state, sel, tstates, match = run(
+                        nfa_state, sel_states, tstates, batch, now)
+                    emitted = emitted + match.count().astype(jnp.int64)
+                    return nfa_state, sel, tstates, emitted, match
             fn = jax.jit(step)
             self._stream_steps[key] = fn
         return fn
 
     def process_pattern_packed(self, stream_id: str,
                                chunk: PackedChunk) -> None:
-        now = np.int64(self.app.current_time())
         with self._lock:
-            step = self._step_for_stream(stream_id, packed=True)
+            step = self._step_for_stream(stream_id,
+                                         (chunk.enc, chunk.capacity))
             with self._table_locks():
                 tstates = {t: self.app.tables[t].state
                            for t in self.table_deps}
                 (self.nfa_state, self.states, tstates, self._emitted_dev,
                  out) = step(self.nfa_state, self.states, tstates,
-                             self._emitted_dev, chunk.parts,
-                             np.int64(chunk.base_ts), np.int32(chunk.n),
-                             now)
+                             self._emitted_dev, chunk.buf)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
         self._dispatch_output(out, chunk.last_ts)
@@ -413,14 +460,17 @@ class PatternQueryRuntime(QueryRuntime):
             with self._table_locks():
                 tstates = {t: self.app.tables[t].state
                            for t in self.table_deps}
-                self.nfa_state, self.states, tstates, out = step(
-                    self.nfa_state, self.states, tstates, batch, now)
+                (self.nfa_state, self.states, tstates, self._emitted_dev,
+                 out) = step(self.nfa_state, self.states, tstates,
+                             self._emitted_dev, batch, now)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
         self._dispatch_output(out, timestamp)
 
 
 class JoinStreamReceiver(Receiver):
+    supports_packed = True
+
     def __init__(self, runtime: "JoinQueryRuntime", side: str):
         self.runtime = runtime
         self.side = side
@@ -439,6 +489,8 @@ class JoinQueryRuntime(QueryRuntime):
     """Two-stream windowed join (JoinStreamRuntime + cross-wired
     JoinProcessors in the reference). Each side runs [filters..., window];
     the window output crosses the opposite window's findable buffer."""
+
+    supports_packed = False  # consumes via JoinStreamReceivers only
 
     def __init__(self, name: str, left_ops, right_ops, crosses,
                  sel_ops, in_schemas, out_schema_override, app,
@@ -470,8 +522,17 @@ class JoinQueryRuntime(QueryRuntime):
         """Total join pairs dropped at the join_cap limit so far."""
         return int(jax.device_get(self._overflow_dev))
 
-    def _step_for_side(self, side: str, packed: bool = False) -> Callable:
-        fn = self._side_steps.get((side, packed))
+    def overflow_total(self) -> int:
+        """Selector + both side-chains' window overflow + join-cap drops."""
+        total = super().overflow_total()
+        for states in self.side_states.values():
+            for st in jax.device_get(states):
+                if isinstance(st, dict) and "overflow" in st:
+                    total += int(st["overflow"])
+        return total + self.overflow
+
+    def _step_for_side(self, side: str, packed_key=None) -> Callable:
+        fn = self._side_steps.get((side, packed_key))
         if fn is None:
             my_ops = self.side_ops[side]
             opp = "R" if side == "L" else "L"
@@ -521,12 +582,14 @@ class JoinQueryRuntime(QueryRuntime):
                 return (tuple(new_my), tuple(new_sel), tstates, joined,
                         lost, due)
 
-            if packed:
+            if packed_key is not None:
                 my_schema = self.in_schemas[side]
+                enc, capacity = packed_key
 
                 def pstep(my_states, opp_states, sel_states, tstates,
-                          emitted, parts, base_ts, n, now):
-                    batch = unpack_parts(my_schema, parts, base_ts, n)
+                          emitted, buf):
+                    batch, now = unpack_buffer(my_schema, enc, capacity,
+                                               buf)
                     my, sel, tstates, joined, lost, due = step(
                         my_states, opp_states, sel_states, tstates, batch,
                         now)
@@ -535,23 +598,29 @@ class JoinQueryRuntime(QueryRuntime):
 
                 fn = jax.jit(pstep)
             else:
-                fn = jax.jit(step)
-            self._side_steps[(side, packed)] = fn
+                def ustep(my_states, opp_states, sel_states, tstates,
+                          emitted, batch, now):
+                    my, sel, tstates, joined, lost, due = step(
+                        my_states, opp_states, sel_states, tstates, batch,
+                        now)
+                    emitted = emitted + joined.count().astype(jnp.int64)
+                    return my, sel, tstates, emitted, joined, lost, due
+
+                fn = jax.jit(ustep)
+            self._side_steps[(side, packed_key)] = fn
         return fn
 
     def process_side_packed(self, side: str, chunk: PackedChunk) -> None:
-        now = np.int64(self.app.current_time())
         opp = "R" if side == "L" else "L"
         with self._lock:
-            step = self._step_for_side(side, packed=True)
+            step = self._step_for_side(side, (chunk.enc, chunk.capacity))
             with self._table_locks():
                 tstates = {t: self.app.tables[t].state
                            for t in self.table_deps}
                 (my, sel, tstates, self._emitted_dev, out, lost,
                  due) = step(self.side_states[side], self.side_states[opp],
                              self.states, tstates, self._emitted_dev,
-                             chunk.parts, np.int64(chunk.base_ts),
-                             np.int32(chunk.n), now)
+                             chunk.buf)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
             self.side_states[side] = my
@@ -576,9 +645,10 @@ class JoinQueryRuntime(QueryRuntime):
             with self._table_locks():
                 tstates = {t: self.app.tables[t].state
                            for t in self.table_deps}
-                my, sel, tstates, out, lost, due = step(
-                    self.side_states[side], self.side_states[opp],
-                    self.states, tstates, batch, now_dev)
+                (my, sel, tstates, self._emitted_dev, out, lost,
+                 due) = step(self.side_states[side], self.side_states[opp],
+                             self.states, tstates, self._emitted_dev,
+                             batch, now_dev)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
             self.side_states[side] = my

@@ -84,6 +84,7 @@ class InputHandler:
         self.stream_id = stream_id
         self.junction = junction
         self.app = app_runtime
+        self._encoder = None  # lazy sticky PackedEncoder (core/ingest.py)
 
     def send(self, data) -> None:
         if not self.app.running:
@@ -111,12 +112,12 @@ class InputHandler:
         per-row Python — the framework's intended high-throughput operating
         mode. Capacities are bucketed so jit caches stay warm.
 
-        When every subscriber supports the packed path, chunks travel as
-        delta/lane-packed 32-bit arrays with one device transfer and zero
-        per-batch host syncs (core/ingest.py); otherwise falls back to the
-        EventBatch path."""
+        When every subscriber supports the packed path, a chunk travels as
+        ONE adaptively-encoded uint8 buffer with one device transfer and
+        zero per-batch host syncs (core/ingest.py); otherwise the
+        EventBatch path is used."""
         from .event import batch_from_columns
-        from .ingest import PackedChunk
+        from .ingest import PackedChunk, PackedEncoder
         from .runtime import BATCH_BUCKETS, bucket_capacity
         if not self.app.running:
             raise RuntimeError(
@@ -124,25 +125,26 @@ class InputHandler:
         n = len(ts)
         if n == 0:
             return
-        packed_ok = all(hasattr(r, "process_packed")
+        packed_ok = all(getattr(r, "supports_packed", False)
                         for r in self.junction.receivers)
         max_cap = BATCH_BUCKETS[-1]
         for start in range(0, n, max_cap):
             t = ts[start:start + max_cap]
             c = [col[start:start + max_cap] for col in cols]
             last_ts = int(t[-1])
-            if packed_ok:
-                chunk = PackedChunk.build(self.junction.schema, t, c,
-                                          bucket_capacity(len(t)))
-                if chunk is not None:
-                    self.app.on_ingest_ts(last_ts)
-                    for r in list(self.junction.receivers):
-                        r.process_packed(chunk)
-                    continue
-            batch = batch_from_columns(self.junction.schema, t, c,
-                                       capacity=bucket_capacity(len(t)))
             self.app.on_ingest_ts(last_ts)
-            self.junction.publish_batch(batch, last_ts)
+            if packed_ok:
+                if self._encoder is None:
+                    self._encoder = PackedEncoder(self.junction.schema)
+                chunk = PackedChunk.build(
+                    self._encoder, t, c, bucket_capacity(len(t)),
+                    now=self.app.current_time())
+                for r in list(self.junction.receivers):
+                    r.process_packed(chunk)
+            else:
+                batch = batch_from_columns(self.junction.schema, t, c,
+                                           capacity=bucket_capacity(len(t)))
+                self.junction.publish_batch(batch, last_ts)
 
 
 class StreamCallback(Receiver):

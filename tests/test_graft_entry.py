@@ -18,7 +18,7 @@ def test_entry_executes():
     fn, args = ge.entry()
     out = fn(*args)
     jax.block_until_ready(out)
-    states, tstates, out_batch, due = out
+    states, tstates, emitted, out_batch, due = out
     assert out_batch.valid.shape[0] > 0
 
 

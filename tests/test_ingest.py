@@ -1,0 +1,113 @@
+"""Packed single-buffer ingest round-trips (core/ingest.py).
+
+Every adaptive encoding must reconstruct the exact EventBatch on device,
+and sticky codes must only ever widen (jit-cache stability) while still
+covering each chunk's span.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from siddhi_tpu.core.event import Attribute, StreamSchema
+from siddhi_tpu.core.ingest import PackedEncoder, unpack_buffer
+from siddhi_tpu.core.types import AttrType
+
+
+def roundtrip(schema, enc, ts, cols, cap, now=7):
+    buf, e, n = enc.encode(np.asarray(ts, np.int64), cols, cap, now)
+    batch, now_dev = jax.jit(
+        lambda b: unpack_buffer(schema, e, cap, b))(buf)
+    return batch, int(now_dev), e
+
+
+def test_all_type_roundtrip():
+    schema = StreamSchema("S", (
+        Attribute("i", AttrType.INT), Attribute("l", AttrType.LONG),
+        Attribute("f", AttrType.FLOAT), Attribute("d", AttrType.DOUBLE),
+        Attribute("b", AttrType.BOOL), Attribute("s", AttrType.STRING)))
+    enc = PackedEncoder(schema)
+    ts = np.array([5, 9, 100, 101], np.int64)
+    cols = [np.array([-3, 7, 2, 0], np.int32),
+            np.array([2 ** 40, -2 ** 40, 0, 17], np.int64),
+            np.array([1.5, -2.25, np.inf, 0.0], np.float32),
+            np.array([1e300, -0.5, np.nan, 3.0], np.float64),
+            np.array([True, False, True, True], np.bool_),
+            np.array([1, 2, 1, 3], np.int32)]
+    batch, now, e = roundtrip(schema, enc, ts, cols, 8, now=42)
+    assert now == 42
+    assert np.asarray(batch.valid).sum() == 4
+    assert (np.asarray(batch.ts)[:4] == ts).all()
+    for got, want in zip(batch.cols, cols):
+        g = np.asarray(got)[:4]
+        if want.dtype.kind == "f":
+            assert np.array_equal(g, want, equal_nan=True), (g, want)
+        else:
+            assert (g == want).all(), (g, want)
+
+
+def test_constant_columns_ship_zero_bytes():
+    schema = StreamSchema("S", (Attribute("a", AttrType.INT),
+                                Attribute("p", AttrType.DOUBLE)))
+    enc = PackedEncoder(schema)
+    ts = np.arange(16, dtype=np.int64)
+    cols = [np.full(16, 9, np.int32), np.full(16, 2.5, np.float64)]
+    batch, _, e = roundtrip(schema, enc, ts, cols, 16)
+    assert e == ("aff", "c", "c")
+    assert (np.asarray(batch.cols[0])[:16] == 9).all()
+    assert (np.asarray(batch.cols[1])[:16] == 2.5).all()
+
+
+def test_sticky_codes_only_widen():
+    schema = StreamSchema("S", (Attribute("a", AttrType.LONG),))
+    enc = PackedEncoder(schema)
+    _, _, e1 = roundtrip(schema, enc, [1, 2], [np.array([0, 3], np.int64)],
+                         8)
+    assert e1[1] == "d8"
+    _, _, e2 = roundtrip(schema, enc, [3, 4],
+                         [np.array([0, 2 ** 20], np.int64)], 8)
+    assert e2[1] == "d32"
+    # narrow chunk again: code must STAY d32 (no recompile flapping)
+    _, _, e3 = roundtrip(schema, enc, [5, 6], [np.array([1, 2], np.int64)],
+                         8)
+    assert e3[1] == "d32"
+
+
+def test_affine_ts_wide_span_after_sticky_widening():
+    """Regression: a widened sticky ts code must cover an affine chunk's
+    span (offsets beyond the code width would silently wrap)."""
+    schema = StreamSchema("S", (Attribute("a", AttrType.INT),))
+    enc = PackedEncoder(schema)
+    roundtrip(schema, enc, np.array([0, 1, 3, 300], np.int64),
+              [np.zeros(4, np.int32)], 8)  # non-affine -> d16
+    ts = np.arange(64, dtype=np.int64) * 100000  # affine, span 6.3M
+    batch, _, e = roundtrip(schema, enc, ts, [np.zeros(64, np.int32)], 64)
+    assert (np.asarray(batch.ts)[:64] == ts).all()
+
+
+def test_huge_long_values_raw64():
+    schema = StreamSchema("S", (Attribute("a", AttrType.LONG),))
+    enc = PackedEncoder(schema)
+    vals = np.array([-2 ** 62, 2 ** 62, 0], np.int64)
+    batch, _, e = roundtrip(schema, enc, [1, 2, 3], [vals], 8)
+    assert e[1] == "raw64"
+    assert (np.asarray(batch.cols[0])[:3] == vals).all()
+
+
+def test_non_monotonic_ts():
+    schema = StreamSchema("S", (Attribute("a", AttrType.INT),))
+    enc = PackedEncoder(schema)
+    ts = np.array([100, 50, 200, 10], np.int64)
+    batch, _, e = roundtrip(schema, enc, ts, [np.zeros(4, np.int32)], 8)
+    assert (np.asarray(batch.ts)[:4] == ts).all()
+
+
+def test_bool_bitpack_roundtrip():
+    schema = StreamSchema("S", (Attribute("b", AttrType.BOOL),))
+    enc = PackedEncoder(schema)
+    rng = np.random.default_rng(0)
+    vals = rng.integers(0, 2, 64).astype(np.bool_)
+    batch, _, e = roundtrip(schema, enc, np.arange(64, dtype=np.int64),
+                            [vals], 64)
+    assert e[1] == "b1"
+    assert (np.asarray(batch.cols[0])[:64] == vals).all()
