@@ -1,16 +1,25 @@
 """Benchmark driver: prints ONE JSON line
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "configs": {...}}.
 
-Workload: BASELINE.md config 1 (StockStream filter, stateless) until the
-NFA engine lands; then the north-star 5-state sequence pattern over a
-1M-event replay takes over.
+Covers all five BASELINE.md configs:
+  1. filter        — StockStream stateless filter (SimpleFilterSingleQueryPerformance.java:51)
+  2. window_agg    — lengthBatch(1000) + avg/sum (SimpleWindowSingleQueryPerformance.java)
+  3. join          — 1s time-window join on symbol
+  4. seq2          — 2-state sequence with cross-state predicate, within 5s
+  5. kleene        — every (A+ -> B) with count() and within (variable-length NFA)
+plus the north-star workload:
+  seq5             — 5-state pattern chain over a single-event replay,
+                     with p50/p99 per-chunk match latency.
+
+The headline metric/value is the north-star seq5 events/s.
 
 vs_baseline: the reference repo publishes no numbers (BASELINE.md) and this
-image has no JVM (`java` not found), so the Java single-thread figure cannot
-be measured here. vs_baseline is computed against the figure recorded in
-BASELINE.md §Assumed (1.0M events/s single-thread Java for the filter
-config — the reference harness's typical order of magnitude on commodity
-CPUs); it is an assumption, not a measurement, until a JVM is available.
+image has no JVM, so single-thread Java figures CANNOT be measured here.
+Every entry therefore carries "baseline": "assumed" — the denominators below
+are order-of-magnitude guesses for single-thread Java Siddhi on commodity
+CPUs (the reference harness's typical range), NOT measurements:
+  filter 1.0M ev/s, window_agg 700k, join 400k, seq2 400k, kleene 200k,
+  seq5 300k.
 """
 from __future__ import annotations
 
@@ -19,17 +28,41 @@ import time
 
 import numpy as np
 
+import jax
 import siddhi_tpu
 from siddhi_tpu import SiddhiManager
 from siddhi_tpu.core.types import GLOBAL_STRINGS
 
-ASSUMED_JAVA_FILTER_EPS = 1_000_000.0
+ASSUMED = {
+    "filter": 1_000_000.0,
+    "window_agg": 700_000.0,
+    "join": 400_000.0,
+    "seq2": 400_000.0,
+    "kleene": 200_000.0,
+    "seq5": 300_000.0,
+}
 
-N_EVENTS = 1_000_000
-BATCH = 65_536
+SYMS = ("IBM", "WSO2", "GOOG", "MSFT")
+TS0 = 1_700_000_000_000
 
 
-def bench_filter() -> dict:
+def _entry(name, events, seconds, extra=None):
+    eps = events / seconds
+    d = {"value": round(eps, 1), "unit": "events/s",
+         "events": events, "seconds": round(seconds, 3),
+         "vs_baseline": round(eps / ASSUMED[name], 3),
+         "baseline": "assumed"}
+    if extra:
+        d.update(extra)
+    return d
+
+
+def _drain(outs):
+    jax.block_until_ready([o.valid for o in outs])
+    outs.clear()
+
+
+def bench_filter(n=1_000_000):
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime("""
         @app:playback
@@ -40,47 +73,249 @@ def bench_filter() -> dict:
         insert into OutputStream;
     """)
     q = rt.queries["q"]
-    matched = []
-    q.batch_callbacks.append(lambda out: matched.append(out.count()))
+    outs = []
+    q.batch_callbacks.append(outs.append)
     rt.start()
     h = rt.get_input_handler("StockStream")
-
     rng = np.random.default_rng(7)
-    syms = np.array([GLOBAL_STRINGS.encode(s)
-                     for s in ("IBM", "WSO2", "GOOG", "MSFT")], np.int32)
-    n_batches = N_EVENTS // BATCH
-    batches = []
-    ts0 = 1_700_000_000_000
-    for b in range(n_batches):
-        ts = ts0 + np.arange(b * BATCH, (b + 1) * BATCH, dtype=np.int64)
-        sym = syms[rng.integers(0, len(syms), BATCH)]
-        price = rng.uniform(0, 200, BATCH).astype(np.float32)
-        vol = rng.integers(1, 1000, BATCH, dtype=np.int64)
-        batches.append((ts, [sym, price, vol]))
-
-    # warmup / compile
-    h.send_arrays(*batches[0])
-    matched[0].block_until_ready()
-    matched.clear()
-
+    syms = np.array([GLOBAL_STRINGS.encode(s) for s in SYMS], np.int32)
+    ts = TS0 + np.arange(n, dtype=np.int64)
+    sym = syms[rng.integers(0, len(syms), n)]
+    price = rng.uniform(0, 200, n).astype(np.float32)
+    vol = rng.integers(1, 1000, n, dtype=np.int64)
+    h.send_arrays(ts, [sym, price, vol])           # warmup/compile
+    _drain(outs)
     t0 = time.perf_counter()
-    for ts, cols in batches:
-        h.send_arrays(ts, cols)
-    for m in matched:
-        m.block_until_ready()
+    h.send_arrays(ts, [sym, price, vol])
+    _drain(outs)
     dt = time.perf_counter() - t0
-    total = n_batches * BATCH
-    n_matched = int(sum(int(m) for m in matched))
     rt.shutdown()
-    assert n_matched > 0
-    eps = total / dt
-    return {
-        "metric": "filter_events_per_sec",
-        "value": round(eps, 1),
+    return _entry("filter", n, dt)
+
+
+def bench_window_agg(n=1_000_000):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:playback
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name = 'q')
+        from StockStream#window.lengthBatch(1000)
+        select avg(price) as ap, sum(volume) as sv
+        insert into OutputStream;
+    """)
+    q = rt.queries["q"]
+    outs = []
+    q.batch_callbacks.append(outs.append)
+    rt.start()
+    h = rt.get_input_handler("StockStream")
+    rng = np.random.default_rng(8)
+    syms = np.array([GLOBAL_STRINGS.encode(s) for s in SYMS], np.int32)
+    ts = TS0 + np.arange(n, dtype=np.int64)
+    sym = syms[rng.integers(0, len(syms), n)]
+    price = rng.uniform(0, 200, n).astype(np.float32)
+    vol = rng.integers(1, 1000, n, dtype=np.int64)
+    h.send_arrays(ts, [sym, price, vol])
+    _drain(outs)
+    t0 = time.perf_counter()
+    h.send_arrays(ts, [sym, price, vol])
+    _drain(outs)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    return _entry("window_agg", n, dt)
+
+
+def bench_join(n_side=131_072, chunk=8192):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:playback
+        define stream StockStream (symbol string, price float);
+        define stream TwitterStream (symbol string, tweets int);
+        @info(name = 'q')
+        from StockStream#window.time(1 sec) join TwitterStream#window.time(1 sec)
+        on StockStream.symbol == TwitterStream.symbol
+        select StockStream.symbol, price, tweets
+        insert into OutputStream;
+    """)
+    q = rt.queries["q"]
+    outs = []
+    q.batch_callbacks.append(outs.append)
+    rt.start()
+    hs = rt.get_input_handler("StockStream")
+    ht = rt.get_input_handler("TwitterStream")
+    rng = np.random.default_rng(9)
+    syms = np.array([GLOBAL_STRINGS.encode(s) for s in SYMS], np.int32)
+
+    def mk(i, n):
+        # ~1000 events/s/side -> ~1s window holds ~1000 rows/side
+        ts = TS0 + (np.arange(n, dtype=np.int64) + i * n)
+        sym = syms[rng.integers(0, len(syms), n)]
+        return ts, sym
+
+    # warmup both sides
+    ts, sym = mk(0, chunk)
+    hs.send_arrays(ts, [sym, rng.uniform(0, 200, chunk).astype(np.float32)])
+    ht.send_arrays(ts, [sym, rng.integers(0, 50, chunk).astype(np.int32)])
+    _drain(outs)
+
+    n_chunks = n_side // chunk
+    t0 = time.perf_counter()
+    for i in range(1, n_chunks + 1):
+        ts, sym = mk(i, chunk)
+        hs.send_arrays(ts, [sym,
+                            rng.uniform(0, 200, chunk).astype(np.float32)])
+        ht.send_arrays(ts, [sym,
+                            rng.integers(0, 50, chunk).astype(np.int32)])
+    _drain(outs)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    return _entry("join", 2 * n_chunks * chunk, dt)
+
+
+def bench_seq2(n=262_144, chunk=65_536):
+    """2-state sequence: Order -> Payment[oid == e1.oid] within 5 sec."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:playback
+        define stream OrderS (oid int, amt float);
+        define stream PayS (pid int, oid int);
+        @info(name = 'q')
+        from e1=OrderS[amt > 10.0] -> e2=PayS[oid == e1.oid] within 5 sec
+        select e1.oid as o, e2.pid as p
+        insert into Out;
+    """)
+    q = rt.queries["q"]
+    outs = []
+    q.batch_callbacks.append(outs.append)
+    rt.start()
+    ho = rt.get_input_handler("OrderS")
+    hp = rt.get_input_handler("PayS")
+    rng = np.random.default_rng(10)
+
+    def send(i, m):
+        ts = TS0 + np.arange(m, dtype=np.int64) + i * m
+        oid = rng.integers(0, 1000, m).astype(np.int32)
+        ho.send_arrays(ts, [oid, rng.uniform(0, 100, m).astype(np.float32)])
+        hp.send_arrays(ts + m, [np.arange(m, dtype=np.int32), oid])
+
+    send(0, chunk)
+    _drain(outs)
+    n_chunks = n // chunk
+    t0 = time.perf_counter()
+    for i in range(1, n_chunks + 1):
+        send(i, chunk)
+    _drain(outs)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    return _entry("seq2", 2 * n_chunks * chunk, dt)
+
+
+def bench_kleene(n=262_144, chunk=65_536):
+    """every (A+ -> B) with count() and within — variable-length NFA."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:playback
+        define stream A (v int);
+        define stream B (v int);
+        @info(name = 'q')
+        from every e1=A[v > 10]+, e2=B[v > e1.v] within 10 sec
+        select count(e1.v) as n, e2.v as bv
+        insert into Out;
+    """)
+    q = rt.queries["q"]
+    outs = []
+    q.batch_callbacks.append(outs.append)
+    rt.start()
+    ha = rt.get_input_handler("A")
+    hb = rt.get_input_handler("B")
+    rng = np.random.default_rng(11)
+
+    def send(i, m):
+        ts = TS0 + np.arange(m, dtype=np.int64) + i * m
+        ha.send_arrays(ts, [rng.integers(0, 100, m).astype(np.int32)])
+        hb.send_arrays(ts + m, [rng.integers(0, 100, m).astype(np.int32)])
+
+    send(0, chunk)
+    _drain(outs)
+    n_chunks = n // chunk
+    t0 = time.perf_counter()
+    for i in range(1, n_chunks + 1):
+        send(i, chunk)
+    _drain(outs)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    return _entry("kleene", 2 * n_chunks * chunk, dt)
+
+
+def bench_seq5(n=1_048_576, chunk=65_536):
+    """North star: 5-state pattern chain over a 1M-event replay, with
+    per-chunk p50/p99 match latency (arrival -> match visible)."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:playback
+        define stream T (sym string, stage int, v int);
+        @info(name = 'q')
+        from every e1=T[stage == 1] -> e2=T[stage == 2 and sym == e1.sym]
+          -> e3=T[stage == 3 and sym == e1.sym]
+          -> e4=T[stage == 4 and sym == e1.sym]
+          -> e5=T[stage == 5 and sym == e1.sym]
+        within 60 sec
+        select e1.sym as sym, e1.v as v1, e5.v as v5
+        insert into Out;
+    """)
+    q = rt.queries["q"]
+    outs = []
+    q.batch_callbacks.append(outs.append)
+    rt.start()
+    h = rt.get_input_handler("T")
+    rng = np.random.default_rng(12)
+    syms = np.array([GLOBAL_STRINGS.encode(s) for s in SYMS], np.int32)
+
+    def mk(i, m):
+        ts = TS0 + np.arange(m, dtype=np.int64) + i * m
+        sym = syms[rng.integers(0, len(syms), m)]
+        stage = rng.integers(1, 6, m).astype(np.int32)
+        v = rng.integers(0, 1000, m).astype(np.int32)
+        return ts, [sym, stage, v]
+
+    h.send_arrays(*mk(0, chunk))
+    _drain(outs)
+    n_chunks = n // chunk
+    lat = []
+    t0 = time.perf_counter()
+    for i in range(1, n_chunks + 1):
+        c0 = time.perf_counter()
+        h.send_arrays(*mk(i, chunk))
+        _drain(outs)   # per-chunk sync: latency = send -> matches visible
+        lat.append(time.perf_counter() - c0)
+    dt = time.perf_counter() - t0
+    rt.shutdown()
+    lat_ms = np.array(lat) * 1000.0
+    return _entry("seq5", n_chunks * chunk, dt, extra={
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),
+        "chunk": chunk,
+    })
+
+
+def main():
+    configs = {}
+    configs["filter"] = bench_filter()
+    configs["window_agg"] = bench_window_agg()
+    configs["join"] = bench_join()
+    configs["seq2"] = bench_seq2()
+    configs["kleene"] = bench_kleene()
+    configs["seq5"] = bench_seq5()
+    head = configs["seq5"]
+    print(json.dumps({
+        "metric": "seq5_events_per_sec",
+        "value": head["value"],
         "unit": "events/s",
-        "vs_baseline": round(eps / ASSUMED_JAVA_FILTER_EPS, 3),
-    }
+        "vs_baseline": head["vs_baseline"],
+        "baseline": "assumed",
+        "p99_match_latency_ms": head["p99_ms"],
+        "configs": configs,
+    }))
 
 
 if __name__ == "__main__":
-    print(json.dumps(bench_filter()))
+    main()

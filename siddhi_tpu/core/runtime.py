@@ -30,6 +30,7 @@ from ..ops.expr import CompileError, SingleStreamScope, compile_expression
 from ..ops.join import (JoinCombinedScope, JoinCross, JoinSideScope,
                         combined_schema)
 from ..ops.nfa import MatchScope, NfaCompiler, NfaEngine
+from ..ops.nfa_parallel import ParallelNfaEngine, parallel_supported
 from ..ops.operators import FilterOp, Operator
 from ..ops.selector import ProjectOp, selector_needs_aggregation
 from ..ops.table import (TableFilterOp, TableOutputOp, TableRuntime,
@@ -380,7 +381,9 @@ class PatternQueryRuntime(QueryRuntime):
         super().__init__(name, sel_ops, engine.match_schema, app)
         self.engine = engine
         self.nfa_state = engine.init_state()
-        self._stream_steps: dict[str, Callable] = {}
+        self._stream_steps: dict = {}
+        self._timer_step: Optional[Callable] = None
+        self._due_fn: Optional[Callable] = None
 
     def receive(self, events: list[Event]) -> None:
         raise RuntimeError(
@@ -390,6 +393,43 @@ class PatternQueryRuntime(QueryRuntime):
         """Include the NFA pending-table overflow counter."""
         total = super().overflow_total()
         return total + int(jax.device_get(self.nfa_state["overflow"]))
+
+    # -- absent-pattern timers -------------------------------------------
+    def _schedule_absent(self) -> None:
+        """After a step: schedule a wakeup at the earliest live absent
+        deadline (AbsentStreamPreStateProcessor's scheduler role)."""
+        if not getattr(self.engine, "has_absent", False):
+            return
+        if self._due_fn is None:
+            eng = self.engine
+            self._due_fn = jax.jit(eng.next_due)
+        due = int(jax.device_get(self._due_fn(self.nfa_state)))
+        self._schedule(due)
+
+    def _on_timer(self, due: int) -> None:
+        self._sched_due = None
+        if not self.app.running:
+            return
+        if self._timer_step is None:
+            tstep = self.engine.make_timer_step()
+            sel_ops = self.operators
+
+            def full(nfa_state, sel_states, emitted, now):
+                nfa_state, match = tstep(nfa_state, now)
+                new_sel = []
+                for op, st in zip(sel_ops, sel_states):
+                    st, match = op.step(st, match, now)
+                    new_sel.append(st)
+                emitted = emitted + match.count().astype(jnp.int64)
+                return nfa_state, tuple(new_sel), emitted, match
+
+            self._timer_step = jax.jit(full)
+        with self._lock:
+            (self.nfa_state, self.states, self._emitted_dev,
+             out) = self._timer_step(self.nfa_state, self.states,
+                                     self._emitted_dev, np.int64(due))
+        self._dispatch_output(out, due)
+        self._schedule_absent()
 
     def _step_for_stream(self, stream_id: str,
                          packed_key=None) -> Callable:
@@ -1094,7 +1134,15 @@ class Planner:
 
         compiler = NfaCompiler(app.schemas, sin.state_type)
         slots, states = compiler.compile(sin.state)
-        engine = NfaEngine(slots, states, sin.state_type, sin.within_ms)
+        if parallel_supported(slots, states):
+            # the TPU-shaped round-parallel engine (larger pending table —
+            # its grids are cheap; the scan engine stays small)
+            engine = ParallelNfaEngine(slots, states, sin.state_type,
+                                       sin.within_ms, capacity=4096,
+                                       out_capacity=16384)
+        else:
+            engine = NfaEngine(slots, states, sin.state_type,
+                               sin.within_ms)
         scope = MatchScope(slots, engine.col_index)
 
         sel_ops: list[Operator] = []

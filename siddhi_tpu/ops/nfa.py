@@ -73,6 +73,15 @@ class NfaStateSpec:
     armed_once: bool = False    # explicit initial pending at t=0
     min_count: int = 1
     max_count: int = 1          # -1 == unbounded
+    # logical and/or groups (LogicalPreStateProcessor.java:33): both sides
+    # share an anchor (the left side's idx) where rows wait; `partner`
+    # links the sides. Absent states (AbsentStreamPreStateProcessor
+    # .java:35) kill on a matching event and complete on deadline.
+    partner: int = -1
+    logical_op: Optional[str] = None   # 'and' | 'or'
+    anchor: int = -1                   # group anchor (== idx when plain)
+    is_absent: bool = False
+    waiting_ms: int = 0
     cond: Optional[CompiledExpr] = None
 
     @property
@@ -96,14 +105,21 @@ class NfaCompiler:
         entry, exits = self._element(root)
         for e in exits:
             self.states[e].next_idx = -1
+        for st in self.states:
+            if st.anchor < 0:
+                st.anchor = st.idx
         start = self.states[entry]
         start.is_start = True
+        plain_start = start.partner < 0 and not start.is_absent
         if self.state_type == "sequence":
+            if not plain_start:
+                raise CompileError(
+                    "logical/absent states cannot start a sequence")
             start.always_armed = True
-        elif start.every_arm == start.idx or (
+        elif plain_start and (start.every_arm == start.idx or (
                 start.idx in [self.states[e].every_arm
                               for e in range(len(self.states))]
-                and self._single_state_scope(start)):
+                and self._single_state_scope(start))):
             start.always_armed = True
         else:
             start.armed_once = True
@@ -124,8 +140,14 @@ class NfaCompiler:
     def _element(self, el: A.StateElement):
         """Returns (entry_state_idx, [exit_state_idxs])."""
         if isinstance(el, A.AbsentStreamStateElement):
-            raise CompileError("absent patterns (not ... for) not yet "
-                               "supported")
+            if el.waiting_time_ms <= 0:
+                raise CompileError(
+                    "standalone absent patterns need 'for <time>' "
+                    "(reference grammar: not X for t, or not X and Y)")
+            idx, _ = self._stream(el, cap=1, min_c=1, max_c=1)
+            self.states[idx].is_absent = True
+            self.states[idx].waiting_ms = int(el.waiting_time_ms)
+            return idx, [idx]
         if isinstance(el, A.StreamStateElement):
             return self._stream(el, cap=1, min_c=1, max_c=1)
         if isinstance(el, A.CountStateElement):
@@ -147,9 +169,37 @@ class NfaCompiler:
                 self.states[x].clear_from = scope_first_slot
             return entry, exits
         if isinstance(el, A.LogicalStateElement):
-            raise CompileError("logical (and/or) pattern states not yet "
-                               "supported")
+            return self._logical(el)
         raise CompileError(f"unsupported state element {type(el).__name__}")
+
+    def _logical(self, el: A.LogicalStateElement):
+        """A and B / A or B / not A and B — two plain sides sharing an
+        anchor (reference LogicalPreStateProcessor pairs)."""
+        def side(s):
+            if isinstance(s, A.AbsentStreamStateElement):
+                idx, _ = self._stream(s, cap=1, min_c=1, max_c=1)
+                self.states[idx].is_absent = True
+                self.states[idx].waiting_ms = int(s.waiting_time_ms)
+                return idx
+            if isinstance(s, A.StreamStateElement):
+                idx, _ = self._stream(s, cap=1, min_c=1, max_c=1)
+                return idx
+            raise CompileError(
+                "logical (and/or) sides must be plain stream states")
+
+        li = side(el.left)
+        ri = side(el.right)
+        ls, rs = self.states[li], self.states[ri]
+        if ls.is_absent and rs.is_absent:
+            raise CompileError("both sides of and/or cannot be absent")
+        if el.op not in ("and", "or"):
+            raise CompileError(f"unknown logical op '{el.op}'")
+        if el.op == "or" and (ls.is_absent or rs.is_absent):
+            raise CompileError("'or' with an absent side not supported")
+        ls.partner, rs.partner = ri, li
+        ls.logical_op = rs.logical_op = el.op
+        ls.anchor = rs.anchor = li
+        return li, [li]
 
     def _stream(self, el: A.StreamStateElement, cap, min_c, max_c):
         sin = el.stream
@@ -291,6 +341,14 @@ class NfaEngine:
                     st.cond_ast, PatternScope(slots, own_slot=st.slot))
                 if st.cond.type is not AttrType.BOOL:
                     raise CompileError("pattern filter must be BOOL")
+        self.has_absent = any(st.is_absent for st in states)
+        # waiting time keyed by the ANCHOR state rows wait at (standalone
+        # absent states anchor themselves; logical groups anchor left)
+        wait_of = [0] * (len(states) + 1)
+        for st in states:
+            if st.is_absent and st.waiting_ms > 0:
+                wait_of[st.anchor] = st.waiting_ms
+        self._wait_of = wait_of
 
         # flattened match-batch schema: slot j attr a copy c
         attrs = []
@@ -331,6 +389,7 @@ class NfaEngine:
             "has_ts0": jnp.zeros((M,), dtype=jnp.bool_),
             "born": jnp.full((M,), -1, dtype=jnp.int64),
             "min_at": jnp.full((M,), -1, dtype=jnp.int64),
+            "deadline": jnp.full((M,), POS_INF, dtype=jnp.int64),
             "seq": jnp.arange(M, dtype=jnp.int64),
             "slots": tuple(slots_buf),
             "next_seq": jnp.int64(M),
@@ -377,6 +436,9 @@ class NfaEngine:
     def make_stream_step(self, stream_id: str):
         """(table, EventBatch, now) -> (table', match_batch)."""
         consuming = [st for st in self.states if st.stream_id == stream_id]
+        # always-armed starts spawn only from THEIR OWN stream's events
+        arm_starts = [st for st in self.states
+                      if st.always_armed and st.stream_id == stream_id]
         # counting states whose forwarded persona answers state st
         persona_sources = {
             st.idx: [cs for cs in self.states
@@ -387,6 +449,13 @@ class NfaEngine:
             table, out = carry
             (ev_ts, ev_kind, ev_valid, ev_cols, ev_nulls) = ev
             M = self.M
+
+            # absent deadlines that passed strictly before this event
+            # complete their states first (the reference's scheduler fires
+            # between events; AbsentStreamPreStateProcessor.java:35)
+            table, out = self._advance_time(table, out, ev_ts, ev_valid,
+                                            strict=True)
+
             counter = table["counter"]
             live = table["valid"]
             mature = live & (table["born"] < counter)
@@ -423,7 +492,8 @@ class NfaEngine:
                 else:
                     cond_ok = jnp.ones((M,), jnp.bool_)
 
-                normal = mature & (pre_state == st.idx)
+                # rows of a logical group wait at the group ANCHOR
+                normal = mature & (pre_state == st.anchor)
                 persona = jnp.zeros((M,), jnp.bool_)
                 for cs in persona_sources[st.idx]:
                     pn = table["slots"][cs.slot]["n"]
@@ -433,6 +503,17 @@ class NfaEngine:
                         (table["min_at"] < counter))
                 at_state = (normal | persona) & is_current
                 hit = at_state & cond_ok
+
+                if st.is_absent:
+                    # a matching event violates the absence — kill the
+                    # pending (after the deadline the absence is already
+                    # satisfied, the event no longer matters)
+                    if st.waiting_ms > 0:
+                        kill = hit & (ev_ts <= table["deadline"])
+                    else:
+                        kill = hit
+                    new_valid = jnp.where(kill, False, new_valid)
+                    continue
 
                 # fill own slot at position n (persona rows have n=0 there)
                 buf = slots_upd[own]
@@ -480,17 +561,40 @@ class NfaEngine:
                             maxed, jnp.int32(st.next_idx), new_state)
                     fwd = just_min
                 else:
-                    if st.next_idx == -1:
-                        out_rows = out_rows | hit
-                        new_valid = jnp.where(hit, False, new_valid)
+                    anchor = self.states[st.anchor]
+                    if st.partner >= 0:
+                        p = self.states[st.partner]
+                        if p.is_absent and p.waiting_ms > 0:
+                            # 'X and not Y for t': completes only once the
+                            # deadline passed (pre-pass handles the fill-
+                            # first order; this handles deadline-first)
+                            complete = hit & (table["deadline"] < ev_ts)
+                        elif p.is_absent:
+                            complete = hit   # 'X and not Y': Y would have
+                            # killed the row already
+                        elif st.logical_op == "or":
+                            complete = hit
+                        else:  # and, both present: partner slot filled?
+                            pf = slots_upd[p.slot]["n"] > 0
+                            complete = hit & pf
+                    else:
+                        complete = hit
+                    if anchor.next_idx == -1:
+                        out_rows = out_rows | complete
+                        new_valid = jnp.where(complete, False, new_valid)
                     else:
                         new_state = jnp.where(
-                            hit, jnp.int32(st.next_idx), new_state)
-                    fwd = hit
-                if st.every_arm >= 0:
-                    rearm_target = jnp.where(fwd, jnp.int32(st.every_arm),
+                            complete, jnp.int32(anchor.next_idx),
+                            new_state)
+                    fwd = complete
+                arm = st.every_arm if st.every_arm >= 0 \
+                    else self.states[st.anchor].every_arm
+                if arm >= 0:
+                    clear = st.clear_from if st.every_arm >= 0 \
+                        else self.states[st.anchor].clear_from
+                    rearm_target = jnp.where(fwd, jnp.int32(arm),
                                              rearm_target)
-                    rearm_clear = jnp.where(fwd, jnp.int32(st.clear_from),
+                    rearm_clear = jnp.where(fwd, jnp.int32(clear),
                                             rearm_clear)
                 if self.state_type == "sequence" and not st.is_counting:
                     seq_kill = seq_kill | (normal & is_current & ~cond_ok)
@@ -513,13 +617,24 @@ class NfaEngine:
                 counter)
 
             # completed matches -> output buffer (seq order within event)
-            out = self._emit(out, table, slots_upd, out_rows, ev_ts,
-                             table["seq"])
+            out = self._emit(out, table, slots_upd, out_rows,
+                             jnp.broadcast_to(ev_ts, (M,)), table["seq"])
 
             # implicit always-armed start states (virtual empty pending)
             table2, out = self._virtual_start(table2, out, ev_ts, ev_kind,
                                               ev_valid, ev_cols, ev_nulls,
-                                              counter)
+                                              counter, arm_starts)
+
+            if self.has_absent:
+                # rows newly waiting at an absent anchor start their clock
+                # at this event's time (arrival into the state, or first
+                # observed time for the initial pending)
+                w = jnp.asarray(self._wait_of, jnp.int64)[
+                    jnp.clip(table2["state"], 0, len(self.states))]
+                needs = table2["valid"] & (w > 0) & ev_valid & \
+                    (table2["deadline"] >= POS_INF)
+                table2 = {**table2, "deadline": jnp.where(
+                    needs, ev_ts + w, table2["deadline"])}
 
             table2 = {**table2, "counter": counter + 1}
             return (table2, out), None
@@ -548,6 +663,73 @@ class NfaEngine:
             return table, match_batch
 
         return step
+
+    # -- absent machinery ------------------------------------------------
+    def _advance_time(self, table, out, now_ts, active, strict: bool):
+        """Complete absent states whose deadline has passed. Emission (and
+        capture) timestamps are the deadlines themselves, matching the
+        reference's scheduler-fired output times."""
+        if not self.has_absent:
+            return table, out
+        M = self.M
+        live = table["valid"]
+        passed = (table["deadline"] < now_ts) if strict \
+            else (table["deadline"] <= now_ts)
+        crossed = live & passed & active
+        new_state = table["state"]
+        new_valid = table["valid"]
+        deadline = table["deadline"]
+        out_rows = jnp.zeros((M,), jnp.bool_)
+        for st in self.states:
+            if not (st.is_absent and st.waiting_ms > 0):
+                continue
+            anchor = self.states[st.anchor]
+            rows = crossed & (table["state"] == st.anchor)
+            if st.partner >= 0:
+                # logical absent side: the present partner must have filled
+                pn = table["slots"][self.states[st.partner].slot]["n"]
+                rows = rows & (pn > 0)
+            if anchor.next_idx == -1:
+                out_rows = out_rows | rows
+                new_valid = jnp.where(rows, False, new_valid)
+            else:
+                new_state = jnp.where(rows, jnp.int32(anchor.next_idx),
+                                      new_state)
+            deadline = jnp.where(rows, POS_INF, deadline)
+        out = self._emit(out, table, table["slots"], out_rows,
+                         table["deadline"], table["seq"])
+        return ({**table, "state": new_state, "valid": new_valid,
+                 "deadline": deadline}, out)
+
+    def make_timer_step(self):
+        """(table, now) -> (table', match_batch): deadline-only advance,
+        fired by the scheduler when no events arrive in time."""
+        def step(table, now):
+            out = {
+                "cols": tuple(jnp.zeros((self.OUT,), dtype=np_dtype(t))
+                              for t in self.match_schema.types),
+                "nulls": tuple(jnp.ones((self.OUT,), dtype=jnp.bool_)
+                               for _ in self.match_schema.types),
+                "ts": jnp.zeros((self.OUT,), dtype=jnp.int64),
+                "n": jnp.int64(0),
+                "lost": jnp.int64(0),
+            }
+            table, out = self._advance_time(table, out,
+                                            jnp.asarray(now, jnp.int64),
+                                            jnp.bool_(True), strict=False)
+            match = EventBatch(
+                ts=out["ts"], cols=out["cols"], nulls=out["nulls"],
+                kind=jnp.zeros((self.OUT,), jnp.int32),
+                valid=jnp.arange(self.OUT) < out["n"])
+            table = {**table, "overflow": table["overflow"] + out["lost"]}
+            return table, match
+
+        return step
+
+    def next_due(self, table):
+        """Earliest live absent deadline (POS_INF when none)."""
+        return jnp.min(jnp.where(table["valid"], table["deadline"],
+                                 POS_INF))
 
     # -- helpers ---------------------------------------------------------
     def _append_rows(self, table, appends, counter):
@@ -588,7 +770,8 @@ class NfaEngine:
         valid = table["valid"].at[d].set(True, mode="drop")
         born = table["born"].at[d].set(counter, mode="drop")
         min_at = table["min_at"].at[d].set(jnp.int64(-1), mode="drop")
-        table = {**table, "min_at": min_at}
+        deadline = table["deadline"].at[d].set(POS_INF, mode="drop")
+        table = {**table, "min_at": min_at, "deadline": deadline}
         seq = table["seq"].at[d].set(
             table["next_seq"] + jnp.cumsum(ok.astype(jnp.int64)) - 1,
             mode="drop")
@@ -627,8 +810,9 @@ class NfaEngine:
                 "seq": seq, "next_seq": next_seq,
                 "slots": tuple(new_slots), "ts0": ts0, "has_ts0": has_ts0}
 
-    def _emit(self, out, table_before, slots_upd, out_rows, ev_ts, seq):
-        """Scatter completed matches into the output buffer in seq order."""
+    def _emit(self, out, table_before, slots_upd, out_rows, ts_vec, seq):
+        """Scatter completed matches into the output buffer in seq order.
+        ts_vec: per-row emission timestamps [M]."""
         M = self.M
         OUT = self.OUT
         order = jnp.argsort(jnp.where(out_rows, seq, POS_INF))
@@ -649,16 +833,15 @@ class NfaEngine:
                     src_n = buf["nulls"][a][take, c]
                     cols[ci] = cols[ci].at[d].set(src_v, mode="drop")
                     nulls[ci] = nulls[ci].at[d].set(src_n, mode="drop")
-        ts = out["ts"].at[d].set(ev_ts, mode="drop")
+        ts = out["ts"].at[d].set(ts_vec[take], mode="drop")
         return {"cols": tuple(cols), "nulls": tuple(nulls), "ts": ts,
                 "n": out["n"] + jnp.minimum(n_emit, OUT - out["n"]),
                 "lost": out["lost"] + lost}
 
     def _virtual_start(self, table, out, ev_ts, ev_kind, ev_valid, ev_cols,
-                       ev_nulls, counter):
-        """Implicit always-armed start states: test the event directly
-        against an empty pending (one virtual row)."""
-        starts = [st for st in self.states if st.always_armed]
+                       ev_nulls, counter, starts):
+        """Implicit always-armed start states (of THIS stream): test the
+        event directly against an empty pending (one virtual row)."""
         if not starts:
             return table, out
         for st in starts:
@@ -767,10 +950,11 @@ class NfaEngine:
         has_ts0 = table["has_ts0"].at[d].set(True, mode="drop")
         min_at = table["min_at"].at[d].set(
             counter if min_reached else jnp.int64(-1), mode="drop")
+        deadline = table["deadline"].at[d].set(POS_INF, mode="drop")
         return {**table, "state": state, "valid": valid, "born": born,
                 "seq": seq, "next_seq": next_seq, "overflow": overflow,
                 "slots": tuple(slots), "ts0": ts0, "has_ts0": has_ts0,
-                "min_at": min_at}
+                "min_at": min_at, "deadline": deadline}
 
     def _emit_virtual(self, out, st, ev_cols, ev_nulls, ev_ts, hit):
         OUT = self.OUT

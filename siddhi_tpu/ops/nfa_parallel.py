@@ -1,0 +1,731 @@
+"""Batch-parallel NFA engine — the TPU-shaped fast path for pattern and
+sequence queries.
+
+The scan engine (ops/nfa.py) replays the reference's per-event semantics
+with a lax.scan over events: correct, but sequential — thousands of tiny
+iterations per batch, each microseconds of real work. This engine computes
+the SAME state evolution with a fixed number of vectorized rounds:
+
+  - each pending row's trajectory through a batch is independent of every
+    other row's (the reference's StateEvents never interact either:
+    StreamPreStateProcessor.java:364-403 iterates them independently), so
+    rows advance in parallel over a [rows, events] grid;
+  - per round, a row at state s finds the FIRST eligible event satisfying
+    s's condition (argmax over the grid row) and advances; R rounds cover
+    any chain of R states consuming the same stream;
+  - counting states (A<m:n>, A+) absorb ALL their eligible matching events
+    in one round with a per-row cumulative-sum placement;
+  - in-batch spawns from an always-armed start state form a second
+    population (one candidate row per event) that advances through the
+    same rounds and is folded into the pending table at the end.
+
+Round count = number of states consuming the stream — typically 2-6 — so a
+65k-event batch costs a few [rows, 4096] grid passes instead of 65k
+sequential steps.
+
+Supported shapes (the planner falls back to the scan engine otherwise —
+`parallel_supported` below): linear chains of stream/count states, pattern
+and sequence, 'every' only where it collapses to an always-armed start
+(every around the leading state / the whole chain when single-scoped),
+`within`, cross-state predicates. NOT supported: live mid-chain 'every'
+re-arms, counting states whose condition references their own earlier
+captures (self-referential Kleene), counting states followed by a state on
+the SAME stream (absorb/advance races), logical and/or, absent.
+
+Semantics parity with the scan engine (and the reference) is bit-exact
+except under overflow pressure: the scan engine frees completed rows
+mid-batch event-by-event, this engine allocates spawned survivors at batch
+end, so a saturated table drops (and counts) more re-arms here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.event import CURRENT, EventBatch
+from ..core.types import np_dtype
+from ..lang import ast as A
+from .expr import Col
+from .nfa import NfaEngine, NfaStateSpec, POS_INF, SlotSpec
+
+BIG = jnp.int32(2 ** 30)
+
+
+def _cond_refs_own_indexed(st: NfaStateSpec, slots: list[SlotSpec]) -> bool:
+    """Does the state's condition reference its OWN slot with an explicit
+    event index (self-referential Kleene, e.g. A[v > e1[last].v]+)?"""
+    own = slots[st.slot]
+    names = {own.ref, own.stream_id} - {None}
+    found = []
+
+    def walk(e):
+        if isinstance(e, A.Variable):
+            if e.stream_ref in names and e.index is not None:
+                found.append(e)
+        for f in getattr(e, "__dataclass_fields__", {}):
+            v = getattr(e, f)
+            if isinstance(v, A.Expression):
+                walk(v)
+            elif isinstance(v, list):
+                for x in v:
+                    if isinstance(x, A.Expression):
+                        walk(x)
+
+    if st.cond_ast is not None:
+        walk(st.cond_ast)
+    return bool(found)
+
+
+def parallel_supported(slots: list[SlotSpec],
+                       states: list[NfaStateSpec]) -> bool:
+    """Can the batch-parallel engine run this compiled chain?"""
+    # logical groups and absent states run on the scan engine
+    if any(st.partner >= 0 or st.is_absent for st in states):
+        return False
+    # rows-at-state reachability (which states ever hold table rows)
+    reach = set()
+    for st in states:
+        if st.armed_once:
+            reach.add(st.idx)
+        if st.always_armed:
+            if st.is_counting:
+                reach.add(st.idx)
+            elif st.next_idx >= 0:
+                reach.add(st.next_idx)
+    changed = True
+    while changed:
+        changed = False
+        for st in states:
+            if st.idx in reach and st.next_idx >= 0 \
+                    and st.next_idx not in reach:
+                reach.add(st.next_idx)
+                changed = True
+    for st in states:
+        if st.every_arm >= 0:
+            # live re-arm edge? dead iff no rows ever reach this state, or
+            # it is a min==1 counting state entered only with n>=1 rows
+            if st.idx in reach and not (
+                    st.is_counting and st.min_count == 1
+                    and not st.armed_once):
+                return False
+        if st.is_counting:
+            if _cond_refs_own_indexed(st, slots):
+                return False
+            if st.next_idx >= 0 and \
+                    states[st.next_idx].stream_id == st.stream_id:
+                return False
+    return True
+
+
+def _first_true(mask):
+    """[P, B] bool -> ([P] first-true index (0 if none), [P] any)."""
+    j = jnp.argmax(mask, axis=1).astype(jnp.int32)
+    return j, jnp.any(mask, axis=1)
+
+
+class ParallelNfaEngine(NfaEngine):
+    """Same table pytree, match schema, and outputs as NfaEngine; only the
+    per-stream step is rebuilt round-parallel. Sub-batches of at most PB
+    events bound the [rows, events] grid size."""
+
+    PB = 4096
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+    # -- env builders ----------------------------------------------------
+    def _env_grid(self, pop, ev_cols, ev_nulls, own_slot: int, B: int):
+        """Env of [P, B]-broadcastable Cols: row captures [P,1] against
+        event values [1,B]; own slot's current view = incoming event.
+        Unused entries are dead-code-eliminated by XLA."""
+        env = {}
+        for j, spec in enumerate(self.slots):
+            buf = pop["slots"][j]
+            n = buf["n"]
+            for a in range(len(spec.schema.types)):
+                for c in range(spec.cap):
+                    vals = buf["cols"][a][:, c][:, None]
+                    nulls = buf["nulls"][a][:, c][:, None]
+                    if j == own_slot:
+                        at_n = (n == c)[:, None]
+                        vals = jnp.where(at_n, ev_cols[a][None, :], vals)
+                        nulls = jnp.where(at_n, ev_nulls[a][None, :], nulls)
+                    env[("slot", j, a, c)] = Col(vals, nulls)
+            n_eff = n + (1 if j == own_slot else 0)
+            for a in range(len(spec.schema.types)):
+                for k in range(min(spec.cap, 4)):
+                    pos = jnp.clip(n_eff - 1 - k, 0, spec.cap - 1)
+                    vals = jnp.take_along_axis(
+                        buf["cols"][a], pos[:, None], axis=1)
+                    nulls = jnp.take_along_axis(
+                        buf["nulls"][a], pos[:, None], axis=1)
+                    if j == own_slot and k == 0:
+                        vals = jnp.broadcast_to(
+                            ev_cols[a][None, :], (n.shape[0], B))
+                        nulls = jnp.broadcast_to(
+                            ev_nulls[a][None, :], (n.shape[0], B))
+                    env[("slot_last", j, a, k)] = Col(vals, nulls)
+        return env
+
+    def _virtual_env_b(self, st, ev_cols, ev_nulls):
+        """[B] env for start-state spawn conditions (own slot = event,
+        everything else null)."""
+        env = {}
+        for j, spec in enumerate(self.slots):
+            for a, t in enumerate(spec.schema.types):
+                for c in range(spec.cap):
+                    if j == st.slot and c == 0:
+                        env[("slot", j, a, c)] = Col(ev_cols[a],
+                                                     ev_nulls[a])
+                    else:
+                        env[("slot", j, a, c)] = Col(
+                            jnp.zeros((), dtype=np_dtype(t)),
+                            jnp.ones((), dtype=jnp.bool_))
+                for k in range(min(spec.cap, 4)):
+                    key = ("slot_last", j, a, k)
+                    if j == st.slot and k == 0:
+                        env[key] = Col(ev_cols[a], ev_nulls[a])
+                    else:
+                        env[key] = Col(jnp.zeros((), dtype=np_dtype(t)),
+                                       jnp.ones((), dtype=jnp.bool_))
+        return env
+
+    # -- population helpers ----------------------------------------------
+    def _empty_pop(self, P: int):
+        slots = []
+        for s in self.slots:
+            slots.append({
+                "cols": tuple(jnp.zeros((P, s.cap), dtype=np_dtype(t))
+                              for t in s.schema.types),
+                "nulls": tuple(jnp.ones((P, s.cap), dtype=jnp.bool_)
+                               for _ in s.schema.types),
+                "ts": jnp.zeros((P, s.cap), dtype=jnp.int64),
+                "n": jnp.zeros((P,), dtype=jnp.int32),
+            })
+        return {
+            "state": jnp.full((P,), len(self.states), jnp.int32),
+            "valid": jnp.zeros((P,), jnp.bool_),
+            "last": jnp.full((P,), -1, jnp.int32),
+            "ts0": jnp.zeros((P,), jnp.int64),
+            "has_ts0": jnp.zeros((P,), jnp.bool_),
+            "min_prev": jnp.zeros((P,), jnp.bool_),
+            "minrel": jnp.full((P,), BIG, jnp.int32),
+            "seq": jnp.zeros((P,), jnp.int64),
+            "emit_at": jnp.full((P,), -1, jnp.int32),
+            "emit_n": jnp.zeros((P,), jnp.int32),
+            "slots": tuple(slots),
+        }
+
+    def _capture_at(self, pop, slot_j: int, pos, ev_cols, ev_nulls, ev_ts,
+                    j, mask):
+        """Capture event j (per-row index) into slot_j at per-row pos."""
+        spec = self.slots[slot_j]
+        buf = pop["slots"][slot_j]
+        P = mask.shape[0]
+        pos = jnp.clip(pos, 0, spec.cap - 1)
+        onehot = (jnp.arange(spec.cap)[None, :] == pos[:, None]) & \
+            mask[:, None]
+        cols = tuple(jnp.where(onehot, c[j][:, None], col)
+                     for c, col in zip(ev_cols, buf["cols"]))
+        nulls = tuple(jnp.where(onehot, nl[j][:, None], nu)
+                      for nl, nu in zip(ev_nulls, buf["nulls"]))
+        ts = jnp.where(onehot, ev_ts[j][:, None], buf["ts"])
+        new_buf = {"cols": cols, "nulls": nulls, "ts": ts, "n": buf["n"]}
+        return {**pop, "slots": tuple(
+            new_buf if k == slot_j else b
+            for k, b in enumerate(pop["slots"]))}
+
+    # -- the round engine ------------------------------------------------
+    def _advance_rounds(self, pop, ev, consuming, B: int):
+        """One pass over the consuming states IN CHAIN ORDER advances every
+        row as far as it can go in this batch: linear chains compile to
+        increasing state indices, so a row that advances at state k is
+        picked up again by the state-(k+1) round with eligibility starting
+        after its captured event. ev = (ts, kind, valid, cols, nulls)."""
+        ev_ts, ev_kind, ev_valid, ev_cols, ev_nulls = ev
+        idx_b = jnp.arange(B, dtype=jnp.int32)
+        is_cur = ev_valid & (ev_kind == CURRENT)
+        seqmode = self.state_type == "sequence"
+
+        persona_sources = {
+            st.idx: [cs for cs in self.states
+                     if cs.is_counting and cs.next_idx == st.idx]
+            for st in consuming}
+
+        for st in consuming:
+            pop = self._state_round(
+                pop, st, persona_sources[st.idx], ev_ts, ev_cols,
+                ev_nulls, is_cur, idx_b, B, seqmode)
+        return pop
+
+    def _eligible(self, pop, is_cur, idx_b, ev_ts, B):
+        elig = is_cur[None, :] & (idx_b[None, :] > pop["last"][:, None])
+        if self.within_ms is not None:
+            ok = jnp.abs(ev_ts[None, :] - pop["ts0"][:, None]) \
+                <= self.within_ms
+            elig = elig & (~pop["has_ts0"][:, None] | ok)
+        return elig
+
+    def _state_round(self, pop, st, personas, ev_ts, ev_cols, ev_nulls,
+                     is_cur, idx_b, B, seqmode):
+        P = pop["state"].shape[0]
+        normal = pop["valid"] & (pop["state"] == st.idx)
+        persona = jnp.zeros((P,), jnp.bool_)
+        for cs in personas:
+            persona = persona | (
+                pop["valid"] & (pop["state"] == cs.idx) &
+                (pop["slots"][cs.slot]["n"] >= cs.min_count) &
+                pop["min_prev"])
+        at_rows = normal | persona
+        # cheap short-circuit is not possible under jit; grids are DCE'd
+        env = self._env_grid(pop, ev_cols, ev_nulls, st.slot, B)
+        if st.cond is not None:
+            c = st.cond.fn(env)
+            cond_ok = jnp.broadcast_to(c.values & ~c.nulls, (P, B))
+        else:
+            cond_ok = jnp.ones((P, B), jnp.bool_)
+        elig = self._eligible(pop, is_cur, idx_b, ev_ts, B)
+
+        if st.is_counting:
+            return self._counting_round(
+                pop, st, at_rows, persona, elig & cond_ok, ev_ts, ev_cols,
+                ev_nulls, B)
+
+        if seqmode:
+            # sequence: a NORMAL row's fate is decided by its first
+            # eligible event (advance on match, die on mismatch); PERSONA
+            # rows test every event and are never sequence-killed
+            # (the scan engine's seq_kill applies to `normal` only)
+            j0, has0 = _first_true(elig)
+            cond_at = jnp.take_along_axis(
+                cond_ok, j0[:, None].astype(jnp.int64), axis=1)[:, 0]
+            jm, hasm = _first_true(elig & cond_ok)
+            adv = (normal & has0 & cond_at) | (persona & hasm)
+            kill = normal & has0 & ~cond_at
+            j = jnp.where(persona, jm, j0)
+        else:
+            j, has = _first_true(elig & cond_ok)
+            adv = at_rows & has
+            kill = jnp.zeros((P,), jnp.bool_)
+
+        pop = self._capture_at(pop, st.slot, jnp.zeros((P,), jnp.int32),
+                               ev_cols, ev_nulls, ev_ts, j, adv)
+        buf = pop["slots"][st.slot]
+        new_n = jnp.where(adv, jnp.int32(1), buf["n"])
+        pop = {**pop, "slots": tuple(
+            {**b, "n": new_n} if k == st.slot else b
+            for k, b in enumerate(pop["slots"]))}
+        got_first = adv & ~pop["has_ts0"]
+        pop = {**pop,
+               "ts0": jnp.where(got_first, ev_ts[j], pop["ts0"]),
+               "has_ts0": pop["has_ts0"] | got_first,
+               "last": jnp.where(adv, j, pop["last"])}
+        if st.next_idx == -1:
+            pop = {**pop,
+                   "emit_at": jnp.where(adv, j, pop["emit_at"]),
+                   "emit_n": jnp.where(adv, jnp.int32(1), pop["emit_n"]),
+                   "valid": pop["valid"] & ~adv & ~kill}
+        else:
+            pop = {**pop,
+                   "state": jnp.where(adv, jnp.int32(st.next_idx),
+                                      pop["state"]),
+                   "valid": pop["valid"] & ~kill}
+        return pop
+
+    def _counting_round(self, pop, st, at_rows, persona, cand, ev_ts,
+                        ev_cols, ev_nulls, B):
+        """Absorb ALL eligible matching events into the counting slot in
+        one pass (cumulative-sum placement)."""
+        P = at_rows.shape[0]
+        spec = self.slots[st.slot]
+        buf = pop["slots"][st.slot]
+        n = jnp.where(persona, jnp.int32(0), buf["n"])  # personas restart
+        cap_limit = spec.cap if st.max_count == -1 \
+            else min(st.max_count, spec.cap)
+        room = jnp.maximum(cap_limit - n, 0)
+        cand = cand & at_rows[:, None]
+        csum = jnp.cumsum(cand.astype(jnp.int32), axis=1)
+        take = cand & (csum <= room[:, None])
+        k = jnp.where(at_rows, jnp.sum(take.astype(jnp.int32), axis=1), 0)
+        absorbed = at_rows & (k > 0)
+
+        # place the r-th taken event at slot position n + r - 1
+        cols = list(buf["cols"])
+        nulls = list(buf["nulls"])
+        ts = buf["ts"]
+        for c in range(spec.cap):
+            want = (c + 1) - n  # the rank that lands at position c
+            sel = take & (csum == want[:, None])
+            j_c, has_c = _first_true(sel)
+            onehot = (jnp.arange(spec.cap)[None, :] == c) & \
+                (has_c & at_rows)[:, None]
+            for a in range(len(spec.schema.types)):
+                cols[a] = jnp.where(onehot, ev_cols[a][j_c][:, None],
+                                    cols[a])
+                nulls[a] = jnp.where(onehot, ev_nulls[a][j_c][:, None],
+                                     nulls[a])
+            ts = jnp.where(onehot, ev_ts[j_c][:, None], ts)
+        new_n = n + k
+        new_buf = {"cols": tuple(cols), "nulls": tuple(nulls), "ts": ts,
+                   "n": jnp.where(at_rows, new_n, buf["n"])}
+        pop = {**pop, "slots": tuple(
+            new_buf if m == st.slot else b
+            for m, b in enumerate(pop["slots"]))}
+
+        # first absorbed event (ts0 / last bookkeeping)
+        j_first, _ = _first_true(take)
+        j_last_rank = jnp.maximum(k, 1)
+        sel_last = take & (csum == j_last_rank[:, None])
+        j_last, _ = _first_true(sel_last)
+        got_first = absorbed & ~pop["has_ts0"]
+        pop = {**pop,
+               "ts0": jnp.where(got_first, ev_ts[j_first], pop["ts0"]),
+               "has_ts0": pop["has_ts0"] | got_first,
+               "last": jnp.where(absorbed, j_last, pop["last"]),
+               "state": jnp.where(absorbed, jnp.int32(st.idx),
+                                  pop["state"])}
+
+        # min crossing: rank (min_count - n) among taken events
+        crossed = absorbed & (n < st.min_count) & (new_n >= st.min_count)
+        min_rank = st.min_count - n
+        sel_min = take & (csum == min_rank[:, None])
+        j_min, _ = _first_true(sel_min)
+        pop = {**pop,
+               "minrel": jnp.where(crossed, j_min, pop["minrel"])}
+
+        maxed = absorbed & (st.max_count != -1) & (new_n >= st.max_count)
+        if st.next_idx == -1:
+            pop = {**pop,
+                   "emit_at": jnp.where(crossed, j_min, pop["emit_at"]),
+                   "emit_n": jnp.where(crossed, jnp.int32(st.min_count),
+                                       pop["emit_n"]),
+                   "valid": pop["valid"] & ~maxed}
+        else:
+            pop = {**pop,
+                   "state": jnp.where(maxed, jnp.int32(st.next_idx),
+                                      pop["state"])}
+        return pop
+
+    # -- spawns ----------------------------------------------------------
+    def _spawn_pop(self, start, ev, B, next_seq):
+        """One candidate row per event for the always-armed start state.
+        Returns (pop, n_spawned, emit_only_mask)."""
+        ev_ts, ev_kind, ev_valid, ev_cols, ev_nulls = ev
+        env = self._virtual_env_b(start, ev_cols, ev_nulls)
+        if start.cond is not None:
+            c = start.cond.fn(env)
+            ok = jnp.broadcast_to(c.values & ~c.nulls, (B,))
+        else:
+            ok = jnp.ones((B,), jnp.bool_)
+        hit = ok & ev_valid & (ev_kind == CURRENT)
+
+        pop = self._empty_pop(B)
+        idx = jnp.arange(B, dtype=jnp.int32)
+        rank = jnp.cumsum(hit.astype(jnp.int64)) - 1
+
+        if start.is_counting:
+            min_now = start.min_count <= 1
+            maxed_now = start.max_count != -1 and 1 >= start.max_count
+            spawns = hit          # all hits become rows (seq consumed)
+            if start.next_idx == -1:
+                as_state = start.idx
+                emit_at = jnp.where(hit, idx, -1) if min_now \
+                    else jnp.full((B,), -1, jnp.int32)
+                alive = jnp.zeros((B,), jnp.bool_) if maxed_now else hit
+            else:
+                as_state = start.next_idx if maxed_now else start.idx
+                emit_at = jnp.full((B,), -1, jnp.int32)
+                alive = hit
+            minrel = jnp.where(hit, idx, BIG) if min_now \
+                else jnp.full((B,), BIG, jnp.int32)
+            n0 = jnp.where(hit, jnp.int32(1), 0)
+        else:
+            if start.next_idx == -1:
+                # single-state pattern: every hit emits, no row persists
+                spawns = jnp.zeros((B,), jnp.bool_)
+                as_state = start.idx
+                minrel = jnp.full((B,), BIG, jnp.int32)
+                emit_at = jnp.where(hit, idx, -1)
+                alive = jnp.zeros((B,), jnp.bool_)
+            else:
+                spawns = hit
+                as_state = start.next_idx
+                minrel = jnp.full((B,), BIG, jnp.int32)
+                emit_at = jnp.full((B,), -1, jnp.int32)
+                alive = hit
+            n0 = jnp.where(hit, jnp.int32(1), 0)
+
+        # own slot captures its event (identity gather)
+        slot_bufs = []
+        for j, spec in enumerate(self.slots):
+            buf = pop["slots"][j]
+            if j == start.slot:
+                cols = tuple(
+                    col.at[:, 0].set(jnp.where(hit, ev_cols[a],
+                                               col[:, 0]))
+                    for a, col in enumerate(buf["cols"]))
+                nulls = tuple(
+                    nl.at[:, 0].set(jnp.where(hit, ev_nulls[a],
+                                              nl[:, 0]))
+                    for a, nl in enumerate(buf["nulls"]))
+                ts = buf["ts"].at[:, 0].set(jnp.where(hit, ev_ts,
+                                                      buf["ts"][:, 0]))
+                slot_bufs.append({"cols": cols, "nulls": nulls, "ts": ts,
+                                  "n": n0})
+            else:
+                slot_bufs.append(buf)
+
+        n_spawned = jnp.sum(spawns.astype(jnp.int64))
+        # emit-only rows get post-spawn seqs (they sort after real spawns
+        # at the same event, matching the scan engine's emit order)
+        seq = jnp.where(spawns, next_seq + rank,
+                        next_seq + n_spawned + idx.astype(jnp.int64))
+        pop.update({
+            "state": jnp.where(hit, jnp.int32(as_state), pop["state"]),
+            "valid": alive,
+            "last": jnp.where(hit, idx, pop["last"]),
+            "born_rel": jnp.where(hit, idx, 0),
+            "ts0": jnp.where(hit, ev_ts, pop["ts0"]),
+            "has_ts0": hit,
+            "minrel": minrel,
+            "seq": seq,
+            "emit_at": emit_at,
+            "emit_n": jnp.where(emit_at >= 0, jnp.int32(1), 0),
+            "slots": tuple(slot_bufs),
+        })
+        return pop, n_spawned
+
+
+    # -- emission / table merge ------------------------------------------
+    def _collect_emissions(self, out, pops):
+        """Scatter (emit_at, seq)-ordered emissions from the populations
+        into the output buffer."""
+        OUT = self.OUT
+        keys = []
+        seqs = []
+        fields = []  # (pop, local_index) gathered per emission candidate
+        for pop in pops:
+            P = pop["state"].shape[0]
+            emitting = pop["emit_at"] >= 0
+            keys.append(jnp.where(emitting,
+                                  pop["emit_at"].astype(jnp.int64),
+                                  jnp.int64(2 ** 62)))
+            seqs.append(pop["seq"])
+            fields.append((pop, P))
+        allkey = jnp.concatenate(keys)
+        order = jnp.lexsort((jnp.concatenate(seqs), allkey))
+        T = allkey.shape[0]
+        n_emit = jnp.sum((allkey < 2 ** 62).astype(jnp.int64))
+        dest = out["n"] + jnp.arange(T, dtype=jnp.int64)
+        ok = (jnp.arange(T) < n_emit) & (dest < OUT)
+        d = jnp.where(ok, dest, OUT)
+        lost = jnp.maximum(n_emit - jnp.sum(ok.astype(jnp.int64)), 0)
+
+        # concatenated per-column sources
+        cols = list(out["cols"])
+        nulls = list(out["nulls"])
+        for j, spec in enumerate(self.slots):
+            for a in range(len(spec.schema.types)):
+                for c in range(spec.cap):
+                    ci = self.col_index[(j, a, c)]
+                    vs, ns = [], []
+                    for pop, P in fields:
+                        buf = pop["slots"][j]
+                        v = buf["cols"][a][:, c]
+                        nl = buf["nulls"][a][:, c]
+                        # final counting slot: null copies >= emit_n
+                        if any(st.next_idx == -1 and st.slot == j
+                               and st.is_counting for st in self.states):
+                            beyond = c >= pop["emit_n"]
+                            nl = nl | beyond
+                        vs.append(v)
+                        ns.append(nl)
+                    src_v = jnp.concatenate(vs)[order]
+                    src_n = jnp.concatenate(ns)[order]
+                    cols[ci] = cols[ci].at[d].set(src_v, mode="drop")
+                    nulls[ci] = nulls[ci].at[d].set(src_n, mode="drop")
+        ts_src = jnp.concatenate(
+            [p["emit_ts"] for p, _ in fields])[order]
+        ts = out["ts"].at[d].set(ts_src, mode="drop")
+        return {"cols": tuple(cols), "nulls": tuple(nulls), "ts": ts,
+                "n": out["n"] + jnp.minimum(n_emit, OUT - out["n"]),
+                "lost": out["lost"] + lost}
+
+    def _fold_spawns(self, table, pop2, counter, sub_off: int):
+        """Append surviving spawned rows into free table slots (in seq
+        order); overflow counted."""
+        M = self.M
+        B = pop2["state"].shape[0]
+        free = ~table["valid"]
+        free_pos = jnp.argsort(~free)
+        n_free = jnp.sum(free.astype(jnp.int32))
+        want = pop2["valid"]
+        rank = jnp.cumsum(want.astype(jnp.int32)) - 1
+        ok = want & (rank < n_free)
+        lost = jnp.sum((want & ~ok).astype(jnp.int64))
+        dest = free_pos[jnp.clip(rank, 0, M - 1)]
+        d = jnp.where(ok, dest, M)
+
+        state = table["state"].at[d].set(pop2["state"], mode="drop")
+        valid = table["valid"].at[d].set(True, mode="drop")
+        born = table["born"].at[d].set(
+            counter + (sub_off + pop2["born_rel"]).astype(jnp.int64),
+            mode="drop")
+        seq = table["seq"].at[d].set(pop2["seq"], mode="drop")
+        ts0 = table["ts0"].at[d].set(pop2["ts0"], mode="drop")
+        has_ts0 = table["has_ts0"].at[d].set(pop2["has_ts0"], mode="drop")
+        min_at = table["min_at"].at[d].set(
+            jnp.where(pop2["minrel"] < BIG,
+                      counter + (sub_off + pop2["minrel"]).astype(
+                          jnp.int64),
+                      jnp.int64(-1)), mode="drop")
+        deadline = table["deadline"].at[d].set(POS_INF, mode="drop")
+        slots = []
+        for j in range(len(self.slots)):
+            tb = table["slots"][j]
+            pb = pop2["slots"][j]
+            slots.append({
+                "cols": tuple(tc.at[d].set(pc, mode="drop")
+                              for tc, pc in zip(tb["cols"], pb["cols"])),
+                "nulls": tuple(tn.at[d].set(pn, mode="drop")
+                               for tn, pn in zip(tb["nulls"],
+                                                 pb["nulls"])),
+                "ts": tb["ts"].at[d].set(pb["ts"], mode="drop"),
+                "n": tb["n"].at[d].set(pb["n"], mode="drop"),
+            })
+        return {**table, "state": state, "valid": valid, "born": born,
+                "seq": seq, "ts0": ts0, "has_ts0": has_ts0,
+                "min_at": min_at, "deadline": deadline,
+                "slots": tuple(slots),
+                "overflow": table["overflow"] + lost}
+
+    # -- the step --------------------------------------------------------
+    def make_stream_step(self, stream_id: str):
+        consuming = [st for st in self.states
+                     if st.stream_id == stream_id]
+        starts = [st for st in self.states
+                  if st.always_armed and st.stream_id == stream_id]
+        start = starts[0] if starts else None
+
+        def sub_step(table, out, ev, sub_off):
+            (ev_ts, ev_kind, ev_valid, ev_cols, ev_nulls) = ev
+            B = ev_ts.shape[0]
+            counter = table["counter"]
+            M = self.M
+
+            # P1: the persistent table as a population
+            pop1 = {
+                "state": table["state"],
+                "valid": table["valid"],
+                "last": jnp.full((M,), -1, jnp.int32),
+                "ts0": table["ts0"],
+                "has_ts0": table["has_ts0"],
+                "min_prev": table["min_at"] >= 0,
+                "minrel": jnp.full((M,), BIG, jnp.int32),
+                "seq": table["seq"],
+                "emit_at": jnp.full((M,), -1, jnp.int32),
+                "emit_n": jnp.zeros((M,), jnp.int32),
+                "slots": table["slots"],
+            }
+            pop1 = self._advance_rounds(
+                pop1, ev, consuming, B)
+
+            pops = [pop1]
+            n_spawned = jnp.int64(0)
+            if start is not None:
+                pop2, n_spawned = self._spawn_pop(
+                    start, ev, B, table["next_seq"])
+                pop2 = {**pop2, "min_prev": jnp.zeros((B,), jnp.bool_)}
+                if len(consuming) > 1 or start.is_counting:
+                    pop2 = self._advance_rounds(
+                        pop2, ev, consuming, B)
+                pops.append(pop2)
+
+            # emission timestamps (per-row gather of emit event ts)
+            for pop in pops:
+                j = jnp.clip(pop["emit_at"], 0, B - 1)
+                pop["emit_ts"] = ev_ts[j]
+            out = self._collect_emissions(out, pops)
+
+            # within pruning at batch end (monotonic time: a row that
+            # exceeded `within` during this batch can never match again)
+            def prune(pop):
+                if self.within_ms is None:
+                    return pop
+                any_valid = jnp.any(ev_valid)
+                tsmax = jnp.max(jnp.where(ev_valid, ev_ts, -POS_INF))
+                tsmin = jnp.min(jnp.where(ev_valid, ev_ts, POS_INF))
+                dist = jnp.maximum(jnp.abs(tsmax - pop["ts0"]),
+                                   jnp.abs(tsmin - pop["ts0"]))
+                dead = pop["has_ts0"] & any_valid & \
+                    (dist > self.within_ms)
+                return {**pop, "valid": pop["valid"] & ~dead}
+
+            pop1 = prune(pop1)
+
+            # write P1 back into the table
+            table = {
+                **table,
+                "state": pop1["state"],
+                "valid": pop1["valid"],
+                "ts0": pop1["ts0"],
+                "has_ts0": pop1["has_ts0"],
+                "min_at": jnp.where(
+                    pop1["minrel"] < BIG,
+                    counter + (sub_off + pop1["minrel"]).astype(jnp.int64),
+                    table["min_at"]),
+                "slots": pop1["slots"],
+            }
+            if start is not None:
+                pop2 = prune(pop2)
+                table = self._fold_spawns(table, pop2, counter,
+                                          sub_off)
+                table = {**table,
+                         "next_seq": table["next_seq"] + n_spawned}
+            table = {**table, "counter": counter + B}
+            return table, out
+
+        def step(table, batch: EventBatch, now):
+            B = batch.capacity
+            out = {
+                "cols": tuple(jnp.zeros((self.OUT,), dtype=np_dtype(t))
+                              for t in self.match_schema.types),
+                "nulls": tuple(jnp.ones((self.OUT,), dtype=jnp.bool_)
+                               for _ in self.match_schema.types),
+                "ts": jnp.zeros((self.OUT,), dtype=jnp.int64),
+                "n": jnp.int64(0),
+                "lost": jnp.int64(0),
+            }
+            PB = min(self.PB, B)
+            n_sub = (B + PB - 1) // PB
+
+            if n_sub == 1:
+                ev = (batch.ts, batch.kind, batch.valid,
+                      tuple(batch.cols), tuple(batch.nulls))
+                table, out = sub_step(table, out, ev, 0)
+            else:
+                def body(k, carry):
+                    table, out = carry
+                    o = k * PB
+                    sl = lambda x: jax.lax.dynamic_slice_in_dim(x, o, PB)
+                    ev = (sl(batch.ts), sl(batch.kind), sl(batch.valid),
+                          tuple(sl(c) for c in batch.cols),
+                          tuple(sl(nl) for nl in batch.nulls))
+                    return sub_step(table, out, ev, o)
+
+                # B is a multiple of PB (bucket capacities are powers of
+                # two >= PB here)
+                table, out = jax.lax.fori_loop(
+                    0, n_sub, lambda k, c: body(k, c), (table, out))
+
+            match_batch = EventBatch(
+                ts=out["ts"],
+                cols=out["cols"],
+                nulls=out["nulls"],
+                kind=jnp.zeros((self.OUT,), jnp.int32),
+                valid=jnp.arange(self.OUT) < out["n"],
+            )
+            table = {**table, "overflow": table["overflow"] + out["lost"]}
+            return table, match_batch
+
+        return step

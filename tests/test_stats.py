@@ -48,9 +48,10 @@ def test_nfa_overflow_counted():
     """)
     q = rt.queries["q"]
     h = rt.get_input_handler("A")
-    # every A event re-arms a pending row; table capacity M=128
-    ts = 1_000_000 + np.arange(200, dtype=np.int64)
-    h.send_arrays(ts, [np.arange(200, dtype=np.int32)])
+    # every A event spawns a pending row; parallel-engine table M=4096
+    n = 8192
+    ts = 1_000_000 + np.arange(n, dtype=np.int64)
+    h.send_arrays(ts, [np.arange(n, dtype=np.int32)])
     assert q.overflow_total() > 0
     rt.shutdown()
 
