@@ -12,6 +12,11 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 from .core.manager import SiddhiManager  # noqa: E402
+from .core.persistence import (  # noqa: E402
+    FileSystemPersistenceStore,
+    InMemoryPersistenceStore,
+    PersistenceStore,
+)
 from .core.stream import Event, QueryCallback, StreamCallback  # noqa: E402
 from .core.types import AttrType  # noqa: E402
 from .lang import parser as compiler  # noqa: E402
@@ -25,6 +30,9 @@ from .lang.parser import (  # noqa: E402
 __all__ = [
     "AttrType",
     "Event",
+    "FileSystemPersistenceStore",
+    "InMemoryPersistenceStore",
+    "PersistenceStore",
     "QueryCallback",
     "SiddhiManager",
     "StreamCallback",

@@ -18,14 +18,19 @@ class SiddhiManager:
         self.extensions: dict[str, object] = {}
         self.persistence_store = None
 
-    def create_siddhi_app_runtime(self, source) -> SiddhiAppRuntime:
+    def create_siddhi_app_runtime(self, source,
+                                  partition_mesh=None) -> SiddhiAppRuntime:
+        """partition_mesh: optional jax.sharding.Mesh — partition blocks
+        then shard their key-slot axis over its first axis (multi-chip
+        key-partitioned execution, parallel/partition.py)."""
         if isinstance(source, str):
             app_ast = parse(source)
         elif isinstance(source, A.SiddhiApp):
             app_ast = source
         else:
             raise TypeError("expected SiddhiQL text or SiddhiApp")
-        rt = SiddhiAppRuntime(app_ast, manager=self)
+        rt = SiddhiAppRuntime(app_ast, manager=self,
+                              partition_mesh=partition_mesh)
         self.app_runtimes[rt.name] = rt
         return rt
 

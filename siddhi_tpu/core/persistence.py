@@ -1,0 +1,166 @@
+"""Checkpoint / restore: snapshot every piece of device state to host
+bytes, store by revision, restore bit-exact.
+
+Reference mapping:
+- SnapshotService.fullSnapshot (util/snapshot/SnapshotService.java:90-183)
+  — quiesce, walk partitionId -> query -> element -> State.snapshot(),
+  Java-serialize                          -> SiddhiAppRuntime.snapshot()
+- PersistenceStore SPI (util/persistence/InMemoryPersistenceStore.java:33,
+  FileSystemPersistenceStore.java:37)     -> the two store classes here
+- persist()/restoreRevision()/restoreLastRevision()/clearAllRevisions()
+  (core/SiddhiAppRuntimeImpl.java:677-755) -> same-named runtime methods
+
+TPU-native simplification: every piece of runtime state is ALREADY a pytree
+of device arrays (operator states, NFA pending tables, join side states,
+table contents, partition slot tables). A full snapshot is one
+jax.device_get of those pytrees + pickle; restore is the inverse. No
+per-element StateHolder walk, no ThreadBarrier: the runtime locks each
+query once (the step lock) while reading its state.
+
+The snapshot also carries the GLOBAL string dictionary (codes embedded in
+device columns must decode identically after a process restart) and the
+playback clock.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Optional
+
+SNAPSHOT_FORMAT = 1
+
+
+class PersistenceStore:
+    """SPI: save/load/clear revisions for an app
+    (util/persistence/PersistenceStore.java)."""
+
+    def save(self, app_name: str, revision: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def load(self, app_name: str, revision: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def get_last_revision(self, app_name: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def clear_all_revisions(self, app_name: str) -> None:
+        raise NotImplementedError
+
+
+class InMemoryPersistenceStore(PersistenceStore):
+    """(InMemoryPersistenceStore.java:33)"""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._revisions: dict[str, dict[str, bytes]] = {}
+
+    def save(self, app_name, revision, data):
+        with self._lock:
+            self._revisions.setdefault(app_name, {})[revision] = data
+
+    def load(self, app_name, revision):
+        return self._revisions.get(app_name, {}).get(revision)
+
+    def get_last_revision(self, app_name):
+        revs = self._revisions.get(app_name)
+        if not revs:
+            return None
+        return sorted(revs)[-1]
+
+    def clear_all_revisions(self, app_name):
+        with self._lock:
+            self._revisions.pop(app_name, None)
+
+
+class FileSystemPersistenceStore(PersistenceStore):
+    """One file per revision under base_dir/app_name/
+    (FileSystemPersistenceStore.java:37)."""
+
+    def __init__(self, base_dir: str):
+        self.base_dir = base_dir
+
+    def _dir(self, app_name: str) -> str:
+        return os.path.join(self.base_dir, app_name)
+
+    def save(self, app_name, revision, data):
+        d = self._dir(app_name)
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".{revision}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, os.path.join(d, f"{revision}.snapshot"))
+
+    def load(self, app_name, revision):
+        path = os.path.join(self._dir(app_name), f"{revision}.snapshot")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def get_last_revision(self, app_name):
+        d = self._dir(app_name)
+        if not os.path.isdir(d):
+            return None
+        revs = sorted(f[:-len(".snapshot")] for f in os.listdir(d)
+                      if f.endswith(".snapshot"))
+        return revs[-1] if revs else None
+
+    def clear_all_revisions(self, app_name):
+        d = self._dir(app_name)
+        if not os.path.isdir(d):
+            return
+        for f in os.listdir(d):
+            if f.endswith(".snapshot"):
+                os.remove(os.path.join(d, f))
+
+
+def new_revision(app_name: str) -> str:
+    """Monotonic, sortable revision id (reference: restoreRevision ids are
+    '<millis>_<appName>')."""
+    return f"{int(time.time() * 1000):015d}_{app_name}"
+
+
+def dump_strings() -> list:
+    """Snapshot the global string dictionary (codes -> strings)."""
+    from .types import GLOBAL_STRINGS
+    return list(GLOBAL_STRINGS._to_str)
+
+
+def load_strings(entries: list) -> None:
+    """Merge a snapshot's string dictionary back, code-stable.
+
+    After a process restart the table is (nearly) empty and the snapshot's
+    codes re-occupy their slots. If this process already interned a
+    DIFFERENT string at a conflicting code, the snapshot cannot be mapped
+    — that is an operator error (restoring into a live, unrelated process)
+    and raises.
+    """
+    from .types import GLOBAL_STRINGS as g
+    with g._lock:
+        for code, s in enumerate(entries):
+            if code < len(g._to_str):
+                cur = g._to_str[code]
+                if cur != s:
+                    raise ValueError(
+                        f"string-table conflict at code {code}: snapshot "
+                        f"has {s!r}, process has {cur!r} — restore into a "
+                        "fresh process")
+            else:
+                g._to_str.append(s)
+                if s is not None:
+                    g._to_code[s] = code
+
+
+def serialize(payload: dict) -> bytes:
+    return pickle.dumps({"format": SNAPSHOT_FORMAT, **payload},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def deserialize(data: bytes) -> dict:
+    payload = pickle.loads(data)
+    if payload.get("format") != SNAPSHOT_FORMAT:
+        raise ValueError(f"unsupported snapshot format "
+                         f"{payload.get('format')!r}")
+    return payload

@@ -47,6 +47,12 @@ from .types import AttrType
 
 BATCH_BUCKETS = (16, 128, 1024, 8192, 65536, 262144, 1048576)
 
+# step capacity cap for queries containing sort-heavy operators (windows,
+# aggregations, order-by): XLA TPU sort compile time grows superlinearly
+# with row count (i64 lexsort: ~5s at 8192 rows, ~66s at 65536), so those
+# steps run over split chunks of this size instead of one huge batch
+SORT_HEAVY_CAP = 8192
+
 WINDOW_CLASSES = {
     "time": TimeWindowOp,
     "length": LengthWindowOp,
@@ -144,6 +150,8 @@ class QueryRuntime(Receiver):
         self.states = tuple(op.init_state() for op in operators)
         self.table_deps = sorted({t for op in operators
                                   for t in op.table_ids()})
+        self.max_step_capacity = SORT_HEAVY_CAP if any(
+            getattr(op, "sort_heavy", False) for op in operators) else None
         self._step: Optional[Callable] = None
         self._packed_steps: dict = {}  # (enc, capacity) -> jitted step
         # device-resident emitted-row counter: accumulated inside the
@@ -245,6 +253,30 @@ class QueryRuntime(Receiver):
         return {"emitted": int(jax.device_get(self._emitted_dev)),
                 "overflow": self.overflow_total()}
 
+    # -- snapshot (SnapshotService state walk -> one device_get) ----------
+    def snapshot_state(self) -> dict:
+        with self._lock:
+            return jax.device_get({"states": self.states,
+                                   "emitted": self._emitted_dev})
+
+    def restore_state(self, snap: dict) -> None:
+        with self._lock:
+            self.states = snap["states"]
+            self._emitted_dev = jnp.asarray(snap["emitted"])
+            self._sched_due = None
+
+    def reschedule(self) -> None:
+        """After restore: re-arm timers from the restored window states
+        (the reference re-registers Schedulers on restore)."""
+        if not self._has_timers:
+            return
+        dues = [op.next_due(st) for op, st in zip(self.operators,
+                                                  self.states)
+                if isinstance(op, WindowOp)]
+        dues = [d for d in dues if d is not None]
+        if dues:
+            self._schedule(int(min(int(jax.device_get(d)) for d in dues)))
+
     def overflow_total(self) -> int:
         """Sum of overflow counters across operator states (windows etc.;
         the 'counted, never silent' contract)."""
@@ -256,9 +288,10 @@ class QueryRuntime(Receiver):
 
     # -- runtime ---------------------------------------------------------
     @staticmethod
-    def encode_chunks(schema: StreamSchema, events: list[Event]):
+    def encode_chunks(schema: StreamSchema, events: list[Event],
+                      max_cap: Optional[int] = None):
         """Yield (EventBatch, last_timestamp) bucketed device batches."""
-        max_cap = BATCH_BUCKETS[-1]
+        max_cap = max_cap or BATCH_BUCKETS[-1]
         for start in range(0, len(events), max_cap):
             chunk = events[start:start + max_cap]
             rows = [e.data for e in chunk]
@@ -269,11 +302,26 @@ class QueryRuntime(Receiver):
                    chunk[-1].timestamp)
 
     def receive(self, events: list[Event]) -> None:
-        for batch, last_ts in self.encode_chunks(self.in_schema, events):
+        for batch, last_ts in self.encode_chunks(self.in_schema, events,
+                                                 self.max_step_capacity):
             self.process_batch(batch, last_ts)
+
+    @staticmethod
+    def split_batch(batch: EventBatch, cap: int):
+        """Slice an oversized device batch into <=cap sub-batches (eager
+        device slicing — used when device-to-device chaining hands a large
+        batch to a capacity-capped query)."""
+        B = batch.capacity
+        for off in range(0, B, cap):
+            yield jax.tree_util.tree_map(lambda x: x[off:off + cap], batch)
 
     def process_batch(self, batch: EventBatch, timestamp: int,
                       now: Optional[int] = None) -> None:
+        cap = self.max_step_capacity
+        if cap is not None and batch.capacity > cap:
+            for sub in self.split_batch(batch, cap):
+                self.process_batch(sub, timestamp, now=now)
+            return
         if now is None:
             now = self.app.current_time()
         now_dev = jnp.asarray(now, dtype=jnp.int64)
@@ -356,6 +404,10 @@ class PatternStreamReceiver(Receiver):
         self.runtime = runtime
         self.stream_id = stream_id
 
+    @property
+    def max_step_capacity(self):
+        return self.runtime.max_step_capacity
+
     def receive(self, events):
         self.runtime.process_stream_events(self.stream_id, events)
 
@@ -393,6 +445,22 @@ class PatternQueryRuntime(QueryRuntime):
         """Include the NFA pending-table overflow counter."""
         total = super().overflow_total()
         return total + int(jax.device_get(self.nfa_state["overflow"]))
+
+    def snapshot_state(self) -> dict:
+        with self._lock:
+            return jax.device_get({"states": self.states,
+                                   "emitted": self._emitted_dev,
+                                   "nfa": self.nfa_state})
+
+    def restore_state(self, snap: dict) -> None:
+        with self._lock:
+            self.states = snap["states"]
+            self._emitted_dev = jnp.asarray(snap["emitted"])
+            self.nfa_state = snap["nfa"]
+            self._sched_due = None
+
+    def reschedule(self) -> None:
+        self._schedule_absent()
 
     # -- absent-pattern timers -------------------------------------------
     def _schedule_absent(self) -> None:
@@ -489,11 +557,17 @@ class PatternQueryRuntime(QueryRuntime):
 
     def process_stream_events(self, stream_id: str, events) -> None:
         schema = self.app.schemas[stream_id]
-        for batch, last_ts in self.encode_chunks(schema, events):
+        for batch, last_ts in self.encode_chunks(schema, events,
+                                                 self.max_step_capacity):
             self.process_pattern_batch(stream_id, batch, last_ts)
 
     def process_pattern_batch(self, stream_id: str, batch: EventBatch,
                               timestamp: int) -> None:
+        cap = self.max_step_capacity
+        if cap is not None and batch.capacity > cap:
+            for sub in self.split_batch(batch, cap):
+                self.process_pattern_batch(stream_id, sub, timestamp)
+            return
         now = jnp.asarray(self.app.current_time(), dtype=jnp.int64)
         with self._lock:
             step = self._step_for_stream(stream_id)
@@ -514,6 +588,10 @@ class JoinStreamReceiver(Receiver):
     def __init__(self, runtime: "JoinQueryRuntime", side: str):
         self.runtime = runtime
         self.side = side
+
+    @property
+    def max_step_capacity(self):
+        return self.runtime.max_step_capacity
 
     def receive(self, events):
         self.runtime.process_side_events(self.side, events)
@@ -553,6 +631,9 @@ class JoinQueryRuntime(QueryRuntime):
             op.next_due(op.init_state()) is not None
             for ops in self.side_ops.values() for op in ops)
         self._overflow_dev = jnp.int64(0)
+        if any(getattr(op, "sort_heavy", False)
+               for ops in self.side_ops.values() for op in ops):
+            self.max_step_capacity = SORT_HEAVY_CAP
 
     def receive(self, events):
         raise RuntimeError("join runtimes consume via JoinStreamReceivers")
@@ -570,6 +651,34 @@ class JoinQueryRuntime(QueryRuntime):
                 if isinstance(st, dict) and "overflow" in st:
                     total += int(st["overflow"])
         return total + self.overflow
+
+    def snapshot_state(self) -> dict:
+        with self._lock:
+            return jax.device_get({"states": self.states,
+                                   "emitted": self._emitted_dev,
+                                   "sides": self.side_states,
+                                   "join_overflow": self._overflow_dev})
+
+    def restore_state(self, snap: dict) -> None:
+        with self._lock:
+            self.states = snap["states"]
+            self._emitted_dev = jnp.asarray(snap["emitted"])
+            self.side_states = snap["sides"]
+            self._overflow_dev = jnp.asarray(snap["join_overflow"])
+            self._sched_due = None
+
+    def reschedule(self) -> None:
+        if not self._has_timers:
+            return
+        dues = []
+        for side, ops in self.side_ops.items():
+            for op, st in zip(ops, self.side_states[side]):
+                if isinstance(op, WindowOp):
+                    d = op.next_due(st)
+                    if d is not None:
+                        dues.append(int(jax.device_get(d)))
+        if dues:
+            self._schedule(min(dues))
 
     def _step_for_side(self, side: str, packed_key=None) -> Callable:
         fn = self._side_steps.get((side, packed_key))
@@ -671,11 +780,17 @@ class JoinQueryRuntime(QueryRuntime):
 
     def process_side_events(self, side: str, events) -> None:
         for batch, last_ts in self.encode_chunks(self.in_schemas[side],
-                                                 events):
+                                                 events,
+                                                 self.max_step_capacity):
             self.process_side_batch(side, batch, last_ts)
 
     def process_side_batch(self, side: str, batch: EventBatch,
                            timestamp: int, now: Optional[int] = None) -> None:
+        cap = self.max_step_capacity
+        if cap is not None and batch.capacity > cap:
+            for sub in self.split_batch(batch, cap):
+                self.process_side_batch(side, sub, timestamp, now=now)
+            return
         if now is None:
             now = self.app.current_time()
         now_dev = jnp.asarray(now, dtype=jnp.int64)
@@ -728,7 +843,8 @@ class SiddhiAppRuntime:
     (reference SiddhiAppRuntimeImpl: start/shutdown :440-655,
     persist/restore :677-755)."""
 
-    def __init__(self, app_ast: A.SiddhiApp, manager=None):
+    def __init__(self, app_ast: A.SiddhiApp, manager=None,
+                 partition_mesh=None):
         self.ast = app_ast
         self.manager = manager
         self.name = app_ast.name or f"app_{id(self):x}"
@@ -737,10 +853,18 @@ class SiddhiAppRuntime:
         self.input_handlers: dict[str, InputHandler] = {}
         self.queries: dict[str, QueryRuntime] = {}
         self.tables: dict[str, TableRuntime] = {}
+        self.partitions: dict = {}  # name -> PartitionBlockRuntime
+        # jax.sharding.Mesh: when set, partition blocks shard their key-slot
+        # axis over the mesh's first axis (see parallel/partition.py)
+        self.partition_mesh = partition_mesh
         self.running = False
         self._playback = False
         self._playback_time: Optional[int] = None
-        self.scheduler = Scheduler(playback=False)
+        self._local_store = None  # fallback store when manager is None
+        # app-wide quiesce barrier (= ThreadBarrier): ingest and wall-clock
+        # timer dispatch hold it; snapshot/restore take it exclusively
+        self.barrier = threading.RLock()
+        self.scheduler = Scheduler(playback=False, barrier=self.barrier)
         Planner(self).plan()
         self.scheduler.playback = self._playback
 
@@ -803,11 +927,112 @@ class SiddhiAppRuntime:
         self.running = True
         self.scheduler.start()
 
+    # -- checkpoint / restore (SiddhiAppRuntimeImpl.java:677-755) ---------
+    def _persistence_store(self):
+        from .persistence import InMemoryPersistenceStore
+        if self.manager is not None:
+            if self.manager.persistence_store is None:
+                self.manager.persistence_store = InMemoryPersistenceStore()
+            return self.manager.persistence_store
+        if self._local_store is None:
+            self._local_store = InMemoryPersistenceStore()
+        return self._local_store
+
+    def snapshot(self) -> bytes:
+        """Full state snapshot as bytes (SnapshotService.fullSnapshot).
+        Every query/table/partition state is a pytree of device arrays —
+        one device_get each, then pickle (see core/persistence.py).
+        The app barrier quiesces ingest + timers for the whole walk so
+        chained queries are captured consistently (the reference's
+        ThreadBarrier in SnapshotService.java:99-100)."""
+        from .persistence import dump_strings, serialize
+        with self.barrier:
+            return self._snapshot_locked(dump_strings, serialize)
+
+    def _snapshot_locked(self, dump_strings, serialize) -> bytes:
+        payload = {
+            "app": self.name,
+            "playback_time": self._playback_time,
+            "queries": {n: q.snapshot_state()
+                        for n, q in self.queries.items()
+                        if hasattr(q, "snapshot_state")},
+            "tables": {tid: jax.device_get(t.state)
+                       for tid, t in self.tables.items()},
+            "partitions": {n: b.snapshot_state()
+                           for n, b in self.partitions.items()},
+            "strings": dump_strings(),
+        }
+        return serialize(payload)
+
+    def restore(self, data: bytes) -> None:
+        """Restore a snapshot() payload bit-exact and re-arm timers."""
+        from .persistence import deserialize, load_strings
+        with self.barrier:
+            self._restore_locked(deserialize(data), load_strings)
+
+    def _restore_locked(self, payload, load_strings) -> None:
+        load_strings(payload["strings"])
+        self._playback_time = payload["playback_time"]
+        for n, snap in payload["queries"].items():
+            q = self.queries.get(n)
+            if q is None or not hasattr(q, "restore_state"):
+                continue
+            q.restore_state(snap)
+        for tid, tstate in payload["tables"].items():
+            if tid in self.tables:
+                self.tables[tid].state = tstate
+        for n, snap in payload["partitions"].items():
+            if n in self.partitions:
+                self.partitions[n].restore_state(snap)
+        for q in self.queries.values():
+            if hasattr(q, "reschedule"):
+                q.reschedule()
+        for b in self.partitions.values():
+            b.reschedule()
+
+    def persist(self) -> str:
+        """Snapshot to the manager's persistence store; returns the
+        revision id."""
+        from .persistence import new_revision
+        store = self._persistence_store()
+        rev = new_revision(self.name)
+        store.save(self.name, rev, self.snapshot())
+        return rev
+
+    def restore_revision(self, revision: str) -> None:
+        store = self._persistence_store()
+        data = store.load(self.name, revision)
+        if data is None:
+            raise KeyError(f"no revision '{revision}' for app "
+                           f"'{self.name}'")
+        self.restore(data)
+
+    def restore_last_revision(self) -> Optional[str]:
+        store = self._persistence_store()
+        rev = store.get_last_revision(self.name)
+        if rev is None:
+            return None
+        self.restore_revision(rev)
+        return rev
+
+    def clear_all_revisions(self) -> None:
+        self._persistence_store().clear_all_revisions(self.name)
+
+    # camelCase aliases mirroring the reference API surface
+    restoreRevision = restore_revision
+    restoreLastRevision = restore_last_revision
+    clearAllRevisions = clear_all_revisions
+
     def shutdown(self) -> None:
         self.running = False
         self.scheduler.shutdown()
         for q in self.queries.values():
-            q._sched_due = None
+            if hasattr(q, "_sched_due") and isinstance(
+                    getattr(q, "_sched_due"), (int, type(None))):
+                q._sched_due = None
+        for blk in self.partitions.values():
+            for qn in blk._sched_due:
+                blk._sched_due[qn] = None
 
 
 class Planner:
@@ -848,12 +1073,152 @@ class Planner:
             app._playback = True
         # 2. queries in order; inferred output streams defined as we go
         qcount = 0
+        pcount = 0
         for el in ast.execution_elements:
             if isinstance(el, A.Query):
                 qcount += 1
                 self.plan_query(el, default_name=f"query_{qcount}")
             elif isinstance(el, A.Partition):
-                raise CompileError("partitions are planned in a later stage")
+                pcount += 1
+                qcount = self.plan_partition(el, qcount, pcount)
+
+    # -- partitions ------------------------------------------------------
+    DEFAULT_PARTITION_SLOTS = 32
+
+    def plan_partition(self, part: A.Partition, qcount: int,
+                       pcount: int) -> int:
+        """`partition with (...) begin ... end` -> PartitionBlockRuntime
+        (reference: PartitionParser.java:46 + PartitionRuntimeImpl.java:75).
+        See siddhi_tpu/parallel/partition.py for the slot-vmap design."""
+        from ..parallel.partition import (BlockQueryPlan, BlockStreamReceiver,
+                                          PartitionBlockRuntime)
+        app = self.app
+        # 1. key specs per partitioned stream (shared instance space)
+        key_specs: dict = {}
+        label_slots: dict[str, int] = {}
+        has_value = False
+        for pt in part.partition_types:
+            schema = app.schemas.get(pt.stream_id)
+            if schema is None:
+                raise CompileError(
+                    f"partition: undefined stream '{pt.stream_id}'")
+            scope = SingleStreamScope(schema)
+            if isinstance(pt, A.ValuePartitionType):
+                has_value = True
+                key_specs[pt.stream_id] = (
+                    "value", compile_expression(pt.expression, scope))
+            elif isinstance(pt, A.RangePartitionType):
+                conds = []
+                for expr, label in pt.ranges:
+                    ce = compile_expression(expr, scope)
+                    if ce.type is not AttrType.BOOL:
+                        raise CompileError(
+                            "partition range condition must be BOOL")
+                    if label not in label_slots:
+                        label_slots[label] = len(label_slots)
+                    conds.append((ce, label_slots[label]))
+                key_specs[pt.stream_id] = ("range", conds)
+            else:
+                raise CompileError(
+                    f"unknown partition type {type(pt).__name__}")
+        # slot capacity: ranges are exactly the label count; value keys get
+        # a bounded first-seen table (@partition slots='N' overrides)
+        n_slots = len(label_slots) if (label_slots and not has_value) \
+            else max(self.DEFAULT_PARTITION_SLOTS, len(label_slots))
+        sa = A.find_annotation(part.annotations, "slots")
+        if sa is not None:
+            n_slots = int(sa.element())
+        if len(label_slots) > n_slots:
+            raise CompileError(
+                f"partition has {len(label_slots)} range labels but only "
+                f"{n_slots} slots; @slots must be >= the label count")
+        mesh = getattr(app, "partition_mesh", None)
+        if mesh is not None:
+            n = mesh.shape[mesh.axis_names[0]]
+            n_slots = ((n_slots + n - 1) // n) * n
+
+        # 2. queries, in order; inner-stream (#S) schemas register as their
+        # producers are planned
+        inner_schemas: dict[str, StreamSchema] = {}
+        plans: list[BlockQueryPlan] = []
+        block_names: set[str] = set()
+        for q in part.queries:
+            qcount += 1
+            name = q.name or f"query_{qcount}"
+            if name in app.queries or name in block_names:
+                raise CompileError(f"duplicate query name '{name}'")
+            block_names.add(name)
+            if not isinstance(q.input, A.SingleInputStream):
+                raise CompileError(
+                    f"query '{name}': only single-stream queries are "
+                    "supported inside partitions (joins/patterns in "
+                    "partitions are a later stage)")
+            sin = q.input
+            if sin.is_inner:
+                input_id = "#" + sin.stream_id
+                schema = inner_schemas.get(input_id)
+                if schema is None:
+                    raise CompileError(
+                        f"query '{name}': inner stream '{input_id}' has no "
+                        "producer earlier in this partition")
+            else:
+                input_id = sin.stream_id
+                schema = app.schemas.get(sin.stream_id)
+                if schema is None:
+                    raise CompileError(f"query '{name}': undefined stream "
+                                       f"'{sin.stream_id}'")
+                if sin.stream_id not in key_specs:
+                    raise CompileError(
+                        f"query '{name}': stream '{sin.stream_id}' is not "
+                        "partitioned (no 'partition with' clause names it)")
+            out = q.output
+            if not isinstance(out, (A.InsertIntoStream, A.ReturnStream)):
+                raise CompileError(
+                    f"query '{name}': table output inside partitions not "
+                    "yet supported")
+            out_type = out.output_event_type
+            inner_target = bool(getattr(out, "is_inner", False))
+            raw_target = getattr(out, "target", None) or name
+            target = ("#" + raw_target) if inner_target else raw_target
+            scope = SingleStreamScope(schema, aliases=(sin.alias,))
+            operators = self.build_single_chain(
+                q, name, schema, sin, scope, target,
+                current_on=out_type in ("current", "all"),
+                expired_on=out_type in ("expired", "all"),
+                allow_tables=False)
+            plan = BlockQueryPlan(name, input_id, schema, operators,
+                                  target, inner_target, out_type)
+            if inner_target:
+                prev = inner_schemas.get(target)
+                if prev is not None and prev.types != plan.out_schema.types:
+                    raise CompileError(
+                        f"inner stream '{target}' schema mismatch between "
+                        "producers")
+                inner_schemas[target] = plan.out_schema
+            plans.append(plan)
+
+        block = PartitionBlockRuntime(
+            app, f"partition_{pcount}", n_slots, key_specs, plans,
+            mesh=mesh)
+        app.partitions[block.name] = block
+
+        # 3. wiring: subscribe consumed outer streams; wire outer outputs
+        consumed = sorted({p.input_id for p in plans
+                           if not p.input_id.startswith("#")})
+        for sid in consumed:
+            app.junctions[sid].subscribe(BlockStreamReceiver(block, sid))
+        for q, plan in zip(part.queries, plans):
+            port = block.ports[plan.name]
+            app.queries[plan.name] = port
+            if not plan.inner_target and isinstance(
+                    q.output, A.InsertIntoStream):
+                tj = app.junction_for(plan.target, plan.out_schema)
+                if plan.target not in app.input_handlers:
+                    app.input_handlers[plan.target] = InputHandler(
+                        plan.target, tj, app)
+                port.output_handlers.append(
+                    InsertIntoStreamHandler(tj, plan.out_type))
+        return qcount
 
     # -- windows ---------------------------------------------------------
     def window_class(self, h: A.WindowHandler):
@@ -930,8 +1295,28 @@ class Planner:
         target = getattr(out, "target", None) or name
         current_on = out_type in ("current", "all")
         expired_on = out_type in ("expired", "all")
-        needs_agg = selector_needs_aggregation(q.selector)
+        operators = self.build_single_chain(
+            q, name, schema, sin, scope, target, current_on, expired_on,
+            allow_tables=True)
+        self.append_table_output(operators, out, name)
 
+        if name in app.queries:
+            raise CompileError(f"duplicate query name '{name}'")
+        qr = QueryRuntime(name, operators, schema, app)
+        app.junctions[sin.stream_id].subscribe(qr)
+        app.queries[name] = qr
+        self.wire_stream_output(qr, out, out_type)
+
+    def build_single_chain(self, q: A.Query, name: str,
+                           schema: StreamSchema, sin: A.SingleInputStream,
+                           scope, target: str, current_on: bool,
+                           expired_on: bool,
+                           allow_tables: bool = True) -> list:
+        """Handler chain + selector for a single-stream query — shared by
+        plan_query and partitioned block planning
+        (= SingleInputStreamParser.parseInputStream + SelectorParser)."""
+        app = self.app
+        needs_agg = selector_needs_aggregation(q.selector)
         operators: list[Operator] = []
         window_op: Optional[WindowOp] = None
         for h in sin.handlers:
@@ -941,6 +1326,10 @@ class Planner:
                         f"query '{name}': filter after window not yet "
                         "supported")
                 if expr_mentions_table(h.expression):
+                    if not allow_tables:
+                        raise CompileError(
+                            f"query '{name}': table references inside "
+                            "partitions not yet supported")
                     operators.append(TableFilterOp(
                         h.expression, schema, app.tables, scope))
                     continue
@@ -978,14 +1367,7 @@ class Planner:
             operators.append(ProjectOp(
                 q.selector, schema, target, scope,
                 current_on=current_on, expired_on=expired_on))
-        self.append_table_output(operators, out, name)
-
-        if name in app.queries:
-            raise CompileError(f"duplicate query name '{name}'")
-        qr = QueryRuntime(name, operators, schema, app)
-        app.junctions[sin.stream_id].subscribe(qr)
-        app.queries[name] = qr
-        self.wire_stream_output(qr, out, out_type)
+        return operators
 
     def append_table_output(self, operators: list, out, name: str) -> None:
         """Insert/delete/update/update-or-insert into a table becomes a

@@ -26,8 +26,12 @@ from typing import Callable, Optional
 class Scheduler:
     """One per app runtime. Callbacks receive the due timestamp (ms)."""
 
-    def __init__(self, playback: bool = False):
+    def __init__(self, playback: bool = False, barrier=None):
         self.playback = playback
+        # app quiesce barrier: wall-clock callbacks run under it so a
+        # concurrent snapshot sees no half-applied timer step
+        self._barrier = barrier if barrier is not None \
+            else threading.RLock()
         self._heap: list = []  # (due_ms, seq, callback)
         self._seq = itertools.count()
         self._cv = threading.Condition()
@@ -84,7 +88,8 @@ class Scheduler:
                     continue
                 due, _, cb = heapq.heappop(self._heap)
             try:
-                cb(due)
+                with self._barrier:
+                    cb(due)
             except Exception:  # noqa: BLE001 — scheduler thread must survive
                 import traceback
                 traceback.print_exc()

@@ -103,8 +103,9 @@ class InputHandler:
             events = [Event(timestamp=now(), data=tuple(d)) for d in data]
         else:
             events = [Event(timestamp=now(), data=tuple(data))]
-        self.app.on_ingest(self.stream_id, events)
-        self.junction.publish(events)
+        with self.app.barrier:
+            self.app.on_ingest(self.stream_id, events)
+            self.junction.publish(events)
 
     def send_arrays(self, ts, cols) -> None:
         """Columnar ingest: numpy timestamp + data column arrays
@@ -128,23 +129,32 @@ class InputHandler:
         packed_ok = all(getattr(r, "supports_packed", False)
                         for r in self.junction.receivers)
         max_cap = BATCH_BUCKETS[-1]
+        # sort-heavy receivers cap their step capacity (see runtime.py
+        # SORT_HEAVY_CAP): chunk accordingly so every receiver can consume
+        # the chunk without re-splitting
+        for r in self.junction.receivers:
+            rc = getattr(r, "max_step_capacity", None)
+            if rc is not None:
+                max_cap = min(max_cap, rc)
         for start in range(0, n, max_cap):
             t = ts[start:start + max_cap]
             c = [col[start:start + max_cap] for col in cols]
             last_ts = int(t[-1])
-            self.app.on_ingest_ts(last_ts)
-            if packed_ok:
-                if self._encoder is None:
-                    self._encoder = PackedEncoder(self.junction.schema)
-                chunk = PackedChunk.build(
-                    self._encoder, t, c, bucket_capacity(len(t)),
-                    now=self.app.current_time())
-                for r in list(self.junction.receivers):
-                    r.process_packed(chunk)
-            else:
-                batch = batch_from_columns(self.junction.schema, t, c,
-                                           capacity=bucket_capacity(len(t)))
-                self.junction.publish_batch(batch, last_ts)
+            with self.app.barrier:
+                self.app.on_ingest_ts(last_ts)
+                if packed_ok:
+                    if self._encoder is None:
+                        self._encoder = PackedEncoder(self.junction.schema)
+                    chunk = PackedChunk.build(
+                        self._encoder, t, c, bucket_capacity(len(t)),
+                        now=self.app.current_time())
+                    for r in list(self.junction.receivers):
+                        r.process_packed(chunk)
+                else:
+                    batch = batch_from_columns(
+                        self.junction.schema, t, c,
+                        capacity=bucket_capacity(len(t)))
+                    self.junction.publish_batch(batch, last_ts)
 
 
 class StreamCallback(Receiver):

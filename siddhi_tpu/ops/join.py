@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..core.event import (CURRENT, EXPIRED, RESET, Attribute, EventBatch,
@@ -147,21 +148,29 @@ class JoinCross:
             jnp.zeros((B,), jnp.bool_)
         reset = trig.valid & (trig.kind == RESET)
 
-        # flatten pairs + one-sided rows, ordered (trigger row, buffer pos);
-        # one-sided rows sort before any pair of the same trigger row
-        rows = jnp.arange(B, dtype=jnp.int64)
-        pk = (rows[:, None] * (W + 1) + 1 +
-              jnp.arange(W, dtype=jnp.int64)[None, :])
-        pair_keys = jnp.where(pair, pk, POS_INF).reshape(-1)
-        lone_keys = jnp.where(lone | reset, rows * (W + 1), POS_INF)
-        keys = jnp.concatenate([pair_keys, lone_keys])
-        order = jnp.argsort(keys)[:self.cap]
-        valid_out = keys[order] < POS_INF
-
-        # gather: index < B*W -> pair, else one-sided row (index - B*W)
-        is_pair = order < B * W
-        ti = jnp.where(is_pair, order // W, order - B * W)  # trigger row
-        oi = jnp.where(is_pair, order % W, 0)               # opposite row
+        # compact surviving pairs + one-sided rows to JOIN_CAP, ordered
+        # (trigger row, buffer pos) with one-sided rows before any pair of
+        # the same trigger row. SORT-FREE two-level ranking: indicators in
+        # that order ([B, 1+W]: col 0 = lone/reset, cols 1..W = pairs),
+        # a per-row prefix sum + a row-offset prefix sum, then each output
+        # slot finds its (row, col) with two searchsorteds. A [B*W] sort
+        # or flat scan here is 33-84M elements — pathological TPU compile.
+        ind = jnp.concatenate([(lone | reset)[:, None], pair], axis=1)
+        inner = jnp.cumsum(ind.astype(jnp.int32), axis=1)    # [B, W+1]
+        counts = inner[:, -1]
+        offs = jnp.cumsum(counts)                            # [B] inclusive
+        total = offs[B - 1].astype(jnp.int64)
+        j = jnp.arange(self.cap, dtype=jnp.int32)
+        r = jnp.clip(jnp.searchsorted(offs, j, side="right"), 0, B - 1)
+        start = offs[r] - counts[r]
+        k = j - start
+        c = jax.vmap(
+            lambda row, kk: jnp.searchsorted(row, kk, side="right"))(
+                inner[r], k)
+        valid_out = j < total
+        ti = r.astype(jnp.int64)                             # trigger row
+        is_pair = c > 0
+        oi = jnp.clip(c - 1, 0, W - 1).astype(jnp.int64)     # opposite row
 
         n_l = len(lsch.types)
         n_r = len(rsch.types)
@@ -186,5 +195,4 @@ class JoinCross:
             nulls=tuple(nulls),
             kind=trig.kind[ti],
             valid=valid_out,
-        ), jnp.maximum(
-            jnp.sum((keys < POS_INF).astype(jnp.int64)) - self.cap, 0)
+        ), jnp.maximum(total - self.cap, 0)

@@ -40,6 +40,7 @@ from ..core.event import (CURRENT, Attribute, EventBatch, StreamSchema)
 from ..core.types import AttrType, np_dtype
 from ..lang import ast as A
 from .expr import Col, CompileError, CompiledExpr, Scope, compile_expression
+from .keyed import cumsum_fast
 
 NEG1 = jnp.int32(-1)
 POS_INF = jnp.int64(2 ** 62)
@@ -773,7 +774,7 @@ class NfaEngine:
         deadline = table["deadline"].at[d].set(POS_INF, mode="drop")
         table = {**table, "min_at": min_at, "deadline": deadline}
         seq = table["seq"].at[d].set(
-            table["next_seq"] + jnp.cumsum(ok.astype(jnp.int64)) - 1,
+            table["next_seq"] + cumsum_fast(ok.astype(jnp.int64)) - 1,
             mode="drop")
         next_seq = table["next_seq"] + jnp.sum(ok.astype(jnp.int64))
         new_slots = []

@@ -46,6 +46,7 @@ from ..core.event import CURRENT, EventBatch
 from ..core.types import np_dtype
 from ..lang import ast as A
 from .expr import Col
+from .keyed import cumsum_fast
 from .nfa import NfaEngine, NfaStateSpec, POS_INF, SlotSpec
 
 BIG = jnp.int32(2 ** 30)
@@ -421,7 +422,7 @@ class ParallelNfaEngine(NfaEngine):
 
         pop = self._empty_pop(B)
         idx = jnp.arange(B, dtype=jnp.int32)
-        rank = jnp.cumsum(hit.astype(jnp.int64)) - 1
+        rank = cumsum_fast(hit.astype(jnp.int64)) - 1
 
         if start.is_counting:
             min_now = start.min_count <= 1

@@ -21,6 +21,13 @@ class Operator:
     needs_tables = False  # when True, step_tables(state, batch, now,
     # tstates) -> (state', batch', tstates') is called instead of step
 
+    # True for operators whose step contains O(B)-sized device sorts:
+    # XLA TPU sort COMPILE time grows superlinearly with input size
+    # (int64 lexsort at 65536 rows: ~66s; at 8192: ~5s), so queries
+    # containing such operators run at a capped step capacity
+    # (QueryRuntime.max_step_capacity) and big ingest chunks are split.
+    sort_heavy = False
+
     def init_state(self) -> Any:
         return ()
 

@@ -15,6 +15,7 @@ from ..core.event import CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema
 from ..core.types import AttrType
 from ..lang import ast as A
 from .expr import Col, CompileError, CompiledExpr, Scope, compile_expression, env_from_batch
+from .keyed import cumsum_fast
 from .operators import Operator
 
 # aggregator function names recognized in select clauses
@@ -99,11 +100,14 @@ def shape_output(out: EventBatch, order_by, offset: Optional[int],
         perm = jnp.lexsort((rows,) + tuple(sort_keys) + (primary,))
         out = _permute(out, perm)
     elif emit_order is not None:
-        primary = jnp.where(out.valid, emit_order, jnp.int64(2 ** 62))
-        perm = jnp.lexsort((rows, primary))
+        # emit_order values are row indices (< B): one stable int32
+        # argsort (native TPU sort width), ties keep row order
+        primary = jnp.where(out.valid, emit_order.astype(jnp.int32),
+                            jnp.int32(2 ** 31 - 1))
+        perm = jnp.argsort(primary)
         out = _permute(out, perm)
     if offset is not None or limit is not None:
-        rank = jnp.cumsum(out.valid.astype(jnp.int64)) - 1
+        rank = cumsum_fast(out.valid.astype(jnp.int64)) - 1
         keep = out.valid
         if offset is not None:
             keep = keep & (rank >= offset)
@@ -155,6 +159,7 @@ class ProjectOp(Operator):
         self.order_by = compile_order_by(selector, self._schema)
         self.limit = const_int(selector.limit, "limit")
         self.offset = const_int(selector.offset, "offset")
+        self.sort_heavy = bool(self.order_by)
 
     def step(self, state, batch: EventBatch, now):
         gate = batch.valid & (

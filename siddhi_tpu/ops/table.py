@@ -30,7 +30,7 @@ from ..core.event import (CURRENT, EXPIRED, Attribute, EventBatch,
 from ..core.types import AttrType, np_dtype
 from ..lang import ast as A
 from .expr import Col, CompileError, Scope, compile_expression
-from .keyed import hash_columns
+from .keyed import cumsum_fast, hash_columns
 from .operators import Operator
 
 POS_INF = jnp.int64(2 ** 62)
@@ -89,7 +89,7 @@ class TableRuntime:
         free = ~state["valid"]
         free_pos = jnp.argsort(~free)
         n_free = jnp.sum(free.astype(jnp.int64))
-        rank = jnp.cumsum(adding.astype(jnp.int64)) - 1
+        rank = cumsum_fast(adding.astype(jnp.int64)) - 1
         ok = adding & (rank < n_free)
         dest = jnp.where(ok, free_pos[jnp.clip(rank, 0, T - 1)], T)
         state = self._scatter_rows(state, batch, ok, dest, keep_seq=False)
@@ -107,7 +107,7 @@ class TableRuntime:
             seq = state["seq"]
             next_seq = state["next_seq"]
         else:
-            n_ok = jnp.cumsum(ok.astype(jnp.int64)) - 1
+            n_ok = cumsum_fast(ok.astype(jnp.int64)) - 1
             seq = state["seq"].at[d].set(state["next_seq"] + n_ok,
                                          mode="drop")
             next_seq = state["next_seq"] + jnp.sum(ok.astype(jnp.int64))

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from ..core.event import CURRENT, EXPIRED, RESET, EventBatch, StreamSchema
 from ..core.types import np_dtype
 from .expr import CompileError
+from .keyed import cumsum_fast
 from .operators import Operator
 
 NEG_INF = jnp.int64(-(2 ** 62))
@@ -87,12 +88,27 @@ def make_pool(buf: dict, batch: EventBatch, arrival_seq, arrival_valid) -> dict:
     }
 
 
+I32_MAX = jnp.int32(2 ** 31 - 1)
+I32_LO = -(2 ** 31) + 1
+
+
+def _rel32(seq):
+    """Compress monotone int64 seq values to int32 sort keys.
+
+    XLA TPU sorts int32 natively but emulates int64 (compile AND run cost
+    ~2x); within one step all live seqs span far less than 2^31, so
+    ordering by (seq - max_seq) clipped to int32 is exact. NEG_INF
+    sentinels clamp to the int32 floor (still sorting first)."""
+    smax = jnp.max(seq)
+    return jnp.clip(seq - smax, I32_LO, 0).astype(jnp.int32)
+
+
 def keep_newest(pool: dict, keep_mask, cap: int):
     """Retain the newest (by seq) `cap` rows where keep_mask; returns
     (buffer dict of size cap in seq order, overflow_count)."""
     n = pool["seq"].shape[0]
     keep = keep_mask & pool["valid"]
-    key = jnp.where(keep, pool["seq"], NEG_INF)
+    key = _rel32(jnp.where(keep, pool["seq"], NEG_INF))
     idx = jnp.argsort(key)          # dropped/invalid first, then kept by seq
     kept_count = jnp.sum(keep.astype(jnp.int64))
     take = idx[n - cap:]
@@ -107,9 +123,17 @@ def emission_sort(out: dict, emit_row, phase, seq, valid,
 
     emit_row: input row index at which the row is emitted.
     phase: 0 expired, 1 reset, 2 current, 3 post-current (length(0) case).
+
+    ONE stable int32 argsort (native TPU sort width). Contract: rows with
+    EQUAL (emit_row, phase) must already appear in seq order in the input
+    arrays — window steps build `out` by concatenating seq-sorted buffer
+    segments with row-ordered arrivals, so stability replaces the seq
+    tiebreak (`seq` is kept in the signature as documentation of that
+    ordering contract).
     """
-    primary = jnp.where(valid, emit_row * 4 + phase, POS_INF)
-    order = jnp.lexsort((seq, primary))
+    primary = jnp.where(valid, (emit_row * 4 + phase).astype(jnp.int32),
+                        I32_MAX)
+    order = jnp.argsort(primary)
     idx = order[:out_cap]
     return EventBatch(
         ts=out["ts"][idx],
@@ -130,7 +154,7 @@ def running_time(batch: EventBatch):
 def arrival_seqs(batch: EventBatch, next_seq):
     """Assign consecutive seq numbers to CURRENT rows."""
     cur = batch.valid & (batch.kind == CURRENT)
-    offs = jnp.cumsum(cur.astype(jnp.int64)) - 1
+    offs = cumsum_fast(cur.astype(jnp.int64)) - 1
     seq = jnp.where(cur, next_seq + offs, NEG_INF)
     n_cur = jnp.sum(cur.astype(jnp.int64))
     return cur, seq, next_seq + n_cur
@@ -139,8 +163,8 @@ def arrival_seqs(batch: EventBatch, next_seq):
 def current_row_positions(cur, B: int):
     """Row index of the k-th CURRENT row (invalid ks map to garbage rows —
     callers must mask)."""
-    return jnp.argsort(jnp.where(cur, jnp.arange(B, dtype=jnp.int64),
-                                 POS_INF))
+    return jnp.argsort(jnp.where(cur, jnp.arange(B, dtype=jnp.int32),
+                                 I32_MAX))
 
 
 class WindowOp(Operator):
@@ -152,6 +176,7 @@ class WindowOp(Operator):
     """
 
     is_batch = False
+    sort_heavy = True  # emission_sort / keep_newest lexsorts
 
     def __init__(self, schema: StreamSchema, expired_enabled: bool = True):
         self.schema = schema

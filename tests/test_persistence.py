@@ -1,0 +1,207 @@
+"""Checkpoint/restore tests, modeled on the reference
+managment/PersistenceTestCase.java: run, persist, build a FRESH runtime of
+the same app, restoreLastRevision, continue — the post-restore output must
+be bit-equal to an uninterrupted run.
+"""
+import pytest
+
+from siddhi_tpu import (Event, FileSystemPersistenceStore,
+                        InMemoryPersistenceStore, SiddhiManager,
+                        StreamCallback)
+
+PLAYBACK = "@app:playback "
+
+WINDOW_APP = PLAYBACK + """
+    @app:name('papp')
+    define stream S (symbol string, v int);
+    @info(name = 'q')
+    from S#window.length(3) select symbol, sum(v) as total
+    insert into Out;
+"""
+
+SENDS = [("S", 1000 + i, (sym, i + 1)) for i, sym in enumerate(
+    ["A", "B", "A", "C", "B", "A", "C", "A"])]
+
+
+def build(ql, store, out="Out"):
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(store)
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(out, StreamCallback(fn=lambda evs: got.extend(evs)))
+    rt.start()
+    return rt, got
+
+
+def feed(rt, sends):
+    for sid, ts, data in sends:
+        rt.get_input_handler(sid).send(Event(ts, tuple(data)))
+
+
+def as_tuples(events):
+    return [(e.timestamp, e.data, e.is_expired) for e in events]
+
+
+class TestPersistRestore:
+    def test_window_kill_and_resume_bit_equal(self):
+        store = InMemoryPersistenceStore()
+        # uninterrupted run
+        rt, got = build(WINDOW_APP, InMemoryPersistenceStore())
+        feed(rt, SENDS)
+        rt.shutdown()
+        expected_tail = as_tuples(got)[4:]
+
+        # interrupted run: persist after 4 events, restore into a fresh
+        # runtime, continue
+        rt1, got1 = build(WINDOW_APP, store)
+        feed(rt1, SENDS[:4])
+        rev = rt1.persist()
+        assert rev
+        rt1.shutdown()
+
+        rt2, got2 = build(WINDOW_APP, store)
+        assert rt2.restore_last_revision() == rev
+        feed(rt2, SENDS[4:])
+        rt2.shutdown()
+        assert as_tuples(got2) == expected_tail
+
+    def test_pattern_state_survives_restore(self):
+        app = PLAYBACK + """
+            @app:name('pat')
+            define stream A (sym string, v int);
+            define stream B (sym string, v int);
+            @info(name = 'q')
+            from e1=A[v > 10] -> e2=B[v > e1.v]
+            select e1.v as v1, e2.v as v2
+            insert into Out;
+        """
+        store = InMemoryPersistenceStore()
+        rt1, got1 = build(app, store)
+        rt1.get_input_handler("A").send(Event(1000, ("x", 20)))
+        rt1.persist()
+        rt1.shutdown()
+        assert got1 == []
+
+        rt2, got2 = build(app, store)
+        rt2.restore_last_revision()
+        rt2.get_input_handler("B").send(Event(1100, ("y", 25)))
+        rt2.shutdown()
+        # the pending partial match crossed the restart
+        assert [e.data for e in got2] == [(20, 25)]
+
+    def test_table_contents_survive_restore(self):
+        app = PLAYBACK + """
+            @app:name('tbl')
+            define stream S (symbol string, v int);
+            define stream Q (symbol string);
+            define table T (symbol string, v int);
+            @info(name = 'ins')
+            from S select symbol, v insert into T;
+            @info(name = 'rd')
+            from Q[T.symbol == symbol in T] select symbol insert into Out;
+        """
+        store = InMemoryPersistenceStore()
+        rt1, _ = build(app, store)
+        rt1.get_input_handler("S").send(Event(1000, ("IBM", 5)))
+        rt1.persist()
+        rt1.shutdown()
+
+        rt2, got2 = build(app, store)
+        rt2.restore_last_revision()
+        rt2.get_input_handler("Q").send(Event(1100, ("IBM",)))
+        rt2.get_input_handler("Q").send(Event(1200, ("WSO2",)))
+        rt2.shutdown()
+        assert [e.data for e in got2] == [("IBM",)]
+
+    def test_partition_state_survives_restore(self):
+        app = PLAYBACK + """
+            @app:name('part')
+            define stream S (symbol string, v int);
+            partition with (symbol of S)
+            begin
+              @info(name = 'pq')
+              from S select symbol, sum(v) as total insert into Out;
+            end;
+        """
+        store = InMemoryPersistenceStore()
+        rt1, _ = build(app, store)
+        feed(rt1, [("S", 1000, ("A", 1)), ("S", 1001, ("B", 10))])
+        rt1.persist()
+        rt1.shutdown()
+
+        rt2, got2 = build(app, store)
+        rt2.restore_last_revision()
+        feed(rt2, [("S", 1100, ("A", 2)), ("S", 1101, ("B", 20))])
+        rt2.shutdown()
+        assert [e.data for e in got2] == [("A", 3), ("B", 30)]
+
+    def test_filesystem_store_roundtrip(self, tmp_path):
+        store = FileSystemPersistenceStore(str(tmp_path))
+        rt1, _ = build(WINDOW_APP, store)
+        feed(rt1, SENDS[:4])
+        rev = rt1.persist()
+        rt1.shutdown()
+
+        # revision file exists on disk
+        files = list((tmp_path / "papp").iterdir())
+        assert any(f.name == f"{rev}.snapshot" for f in files)
+
+        rt2, got2 = build(WINDOW_APP, store)
+        assert rt2.restore_last_revision() == rev
+        feed(rt2, SENDS[4:])
+        rt2.shutdown()
+        assert len(got2) == len(SENDS) - 4
+
+    def test_restore_revision_by_id_and_clear(self):
+        store = InMemoryPersistenceStore()
+        rt1, _ = build(WINDOW_APP, store)
+        feed(rt1, SENDS[:2])
+        rev1 = rt1.persist()
+        feed(rt1, SENDS[2:4])
+        rev2 = rt1.persist()
+        assert rev1 < rev2
+        rt1.shutdown()
+
+        rt2, got2 = build(WINDOW_APP, store)
+        rt2.restore_revision(rev1)  # the OLDER revision
+        feed(rt2, SENDS[2:4])
+        rt2.shutdown()
+        # replays events 3-4 exactly as the first run saw them
+        assert len(got2) == 2
+
+        rt2.clear_all_revisions()
+        assert store.get_last_revision("papp") is None
+
+    def test_missing_revision_raises(self):
+        store = InMemoryPersistenceStore()
+        rt, _ = build(WINDOW_APP, store)
+        with pytest.raises(KeyError):
+            rt.restore_revision("nope")
+        assert rt.restore_last_revision() is None
+        rt.shutdown()
+
+
+class TestManagerlessRuntime:
+    def test_persist_restore_without_manager(self):
+        # regression: _persistence_store() must cache the fallback store on
+        # the runtime, not create a throwaway per call
+        from siddhi_tpu.lang.parser import parse
+        from siddhi_tpu.core.runtime import SiddhiAppRuntime
+        rt = SiddhiAppRuntime(parse("""
+            @app:playback
+            define stream S (v int);
+            @info(name = 'q')
+            from S select sum(v) as t insert into Out;
+        """))
+        rt.start()
+        rt.get_input_handler("S").send([(5,)])
+        rev = rt.persist()
+        rt.get_input_handler("S").send([(7,)])
+        rt.restore_revision(rev)
+        got = []
+        from siddhi_tpu import StreamCallback
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        rt.get_input_handler("S").send([(1,)])
+        rt.shutdown()
+        assert [e.data for e in got] == [(6,)]
+        assert rt.restore_last_revision() == rev
