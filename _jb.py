@@ -1,0 +1,9 @@
+import time, numpy as np
+log = open('/tmp/jb.log','w')
+t00=time.time()
+def mark(m):
+    log.write(f'{time.time()-t00:7.1f}s {m}\n'); log.flush()
+import bench
+t0=time.time()
+r = bench.bench_join()
+mark(f'join done {r}')

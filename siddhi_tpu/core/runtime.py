@@ -35,6 +35,11 @@ from ..ops.operators import FilterOp, Operator
 from ..ops.selector import ProjectOp, selector_needs_aggregation
 from ..ops.table import (TableFilterOp, TableOutputOp, TableRuntime,
                          expr_mentions_table)
+from ..ops.windows2 import (BatchWindowOp, CronWindowOp, DelayWindowOp,
+                            EmptyWindowOp, ExternalTimeBatchWindowOp,
+                            ExternalTimeWindowOp, FrequentWindowOp,
+                            LossyFrequentWindowOp, SessionWindowOp,
+                            SortWindowOp, TimeLengthWindowOp)
 from ..ops.windows import (POS_INF, LengthBatchWindowOp, LengthWindowOp,
                            TimeBatchWindowOp, TimeWindowOp, WindowOp)
 from .event import (CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema,
@@ -58,6 +63,16 @@ WINDOW_CLASSES = {
     "length": LengthWindowOp,
     "lengthbatch": LengthBatchWindowOp,
     "timebatch": TimeBatchWindowOp,
+    "externaltime": ExternalTimeWindowOp,
+    "timelength": TimeLengthWindowOp,
+    "delay": DelayWindowOp,
+    "batch": BatchWindowOp,
+    "sort": SortWindowOp,
+    "frequent": FrequentWindowOp,
+    "lossyfrequent": LossyFrequentWindowOp,
+    "externaltimebatch": ExternalTimeBatchWindowOp,
+    "session": SessionWindowOp,
+    "cron": CronWindowOp,
 }
 
 
@@ -161,6 +176,10 @@ class QueryRuntime(Receiver):
         self._has_timers = any(
             isinstance(op, WindowOp) and op.next_due(op.init_state())
             is not None for op in operators)
+        # host-computed schedules (cron windows: the next fire time cannot
+        # come from device state)
+        self._host_sched = [op.host_schedule for op in operators
+                            if getattr(op, "host_schedule", None)]
         self._sched_due: Optional[int] = None
 
     # -- compile ---------------------------------------------------------
@@ -378,12 +397,24 @@ class QueryRuntime(Receiver):
         self._sched_due = due
         self.app.scheduler.notify_at(due, self._on_timer)
 
+    def arm_host_timers(self, base_ms: int) -> None:
+        """Schedule host-computed fires (cron windows) after base_ms."""
+        for fn in self._host_sched:
+            self._schedule(int(fn(base_ms)))
+
     def _on_timer(self, due: int) -> None:
         self._sched_due = None
         if not self.app.running:
             return
         now = max(due, self.app.current_time())
-        self.process_batch(_timer_batch(self.in_schema, due), due, now=now)
+        # the TIMER row carries the ADVANCED clock, not the scheduled due:
+        # window expiry compares buffered rows against the timer row's ts,
+        # and the reference's playback clock has already advanced when a
+        # timer fires — one fire drains every pending expiry (per-due rows
+        # would re-arm a timer per expiry instant and cascade)
+        self.process_batch(_timer_batch(self.in_schema, now), due, now=now)
+        if self._host_sched:
+            self.arm_host_timers(due)
 
 
 class StreamCallbackReceiver(Receiver):
@@ -820,7 +851,9 @@ class JoinQueryRuntime(QueryRuntime):
             return
         now = max(due, self.app.current_time())
         for side in ("L", "R"):
-            batch = _timer_batch(self.in_schemas[side], due)
+            # TIMER rows carry the advanced clock (see QueryRuntime
+            # ._on_timer): one fire drains all pending window expiries
+            batch = _timer_batch(self.in_schemas[side], now)
             self.process_side_batch(side, batch, due, now=now)
 
 
@@ -861,6 +894,7 @@ class SiddhiAppRuntime:
         self._playback = False
         self._playback_time: Optional[int] = None
         self._local_store = None  # fallback store when manager is None
+        self._cron_armed = False
         # app-wide quiesce barrier (= ThreadBarrier): ingest and wall-clock
         # timer dispatch hold it; snapshot/restore take it exclusively
         self.barrier = threading.RLock()
@@ -876,14 +910,25 @@ class SiddhiAppRuntime:
 
     def on_ingest(self, stream_id: str, events: list[Event]) -> None:
         if events:
-            self.on_ingest_ts(events[-1].timestamp)
+            self.on_ingest_ts(events[-1].timestamp, events[0].timestamp)
 
-    def on_ingest_ts(self, last_ts: int) -> None:
+    def on_ingest_ts(self, last_ts: int,
+                     first_ts: Optional[int] = None) -> None:
         """Advance the playback clock (and due timers) to an ingested
         timestamp — shared by the row and columnar ingest paths."""
         if self._playback:
+            if not self._cron_armed:
+                # playback cron schedules anchor at the first event time
+                self._cron_armed = True
+                base = (first_ts if first_ts is not None else last_ts) - 1
+                self._arm_cron(base)
             self._playback_time = last_ts
             self.scheduler.advance_to(last_ts)
+
+    def _arm_cron(self, base_ms: int) -> None:
+        for q in self.queries.values():
+            if getattr(q, "_host_sched", None):
+                q.arm_host_timers(base_ms)
 
     # -- wiring ----------------------------------------------------------
     def junction_for(self, stream_id: str,
@@ -926,6 +971,8 @@ class SiddhiAppRuntime:
     def start(self) -> None:
         self.running = True
         self.scheduler.start()
+        if not self._playback:
+            self._arm_cron(self.current_time())
 
     # -- checkpoint / restore (SiddhiAppRuntimeImpl.java:677-755) ---------
     def _persistence_store(self):
@@ -1186,6 +1233,10 @@ class Planner:
                 current_on=out_type in ("current", "all"),
                 expired_on=out_type in ("expired", "all"),
                 allow_tables=False)
+            if any(getattr(op, "host_schedule", None) for op in operators):
+                raise CompileError(
+                    f"query '{name}': cron windows inside partitions are "
+                    "not supported")
             plan = BlockQueryPlan(name, input_id, schema, operators,
                                   target, inner_target, out_type)
             if inner_target:
@@ -1235,10 +1286,30 @@ class Planner:
         for p in h.parameters:
             if isinstance(p, A.Constant):
                 params.append(p.value)
+            elif isinstance(p, A.Variable):
+                params.append(p)  # attribute params (externalTime, sort...)
             else:
                 raise CompileError(
-                    f"window '{name}' parameters must be constants")
+                    f"window '{name}' parameters must be constants or "
+                    "attributes")
         key = name.lower()
+
+        def attr_idx(p, role):
+            if not isinstance(p, A.Variable):
+                raise CompileError(
+                    f"window '{name}' {role} must be a stream attribute")
+            try:
+                return schema.index_of(p.attribute)
+            except (KeyError, ValueError):
+                raise CompileError(
+                    f"window '{name}': '{p.attribute}' is not an "
+                    "attribute of the input stream")
+
+        def const_of(p, role):
+            if isinstance(p, A.Variable):
+                raise CompileError(
+                    f"window '{name}' {role} must be a constant")
+            return p
         if key == "time":
             _expect(params, 1, name)
             return TimeWindowOp(schema, _ms(params[0], name),
@@ -1246,24 +1317,133 @@ class Planner:
                                 expired_enabled=expired_enabled)
         if key == "length":
             _expect(params, 1, name)
-            return LengthWindowOp(schema, int(params[0]),
+            return LengthWindowOp(schema, int(const_of(params[0], 'length')),
                                   expired_enabled=expired_enabled)
         if key == "lengthbatch":
             if len(params) not in (1, 2):
                 raise CompileError(f"{name} takes 1-2 parameters")
-            if len(params) == 2 and bool(params[1]):
+            if len(params) == 2 and bool(const_of(params[1], 'mode')):
                 raise CompileError(
                     "lengthBatch stream.current.event mode not yet supported")
-            return LengthBatchWindowOp(schema, int(params[0]),
+            return LengthBatchWindowOp(schema,
+                                       int(const_of(params[0], 'length')),
                                        expired_enabled=expired_enabled)
         if key == "timebatch":
             if len(params) not in (1, 2):
                 raise CompileError(f"{name} takes 1-2 parameters")
-            start = int(params[1]) if len(params) == 2 else None
+            start = int(const_of(params[1], 'start time')) \
+                if len(params) == 2 else None
             return TimeBatchWindowOp(schema, _ms(params[0], name),
                                      start_time=start,
                                      cap=self.DEFAULT_TIME_CAP,
                                      expired_enabled=expired_enabled)
+        if key == "externaltimebatch":
+            if len(params) not in (2, 3):
+                raise CompileError(f"{name} takes 2-3 parameters")
+            ti = attr_idx(params[0], "timestamp parameter")
+            if schema.attributes[ti].type is not AttrType.LONG:
+                raise CompileError(
+                    f"window '{name}' timestamp attribute must be LONG")
+            start = int(const_of(params[2], 'start time')) \
+                if len(params) == 3 else None
+            return ExternalTimeBatchWindowOp(
+                schema, ti, _ms(params[1], name), start_time=start,
+                cap=self.DEFAULT_TIME_CAP, expired_enabled=expired_enabled)
+        if key == "externaltime":
+            _expect(params, 2, name)
+            ti = attr_idx(params[0], "timestamp parameter")
+            if schema.attributes[ti].type is not AttrType.LONG:
+                raise CompileError(
+                    f"window '{name}' timestamp attribute must be LONG")
+            return ExternalTimeWindowOp(schema, ti, _ms(params[1], name),
+                                        cap=self.DEFAULT_TIME_CAP,
+                                        expired_enabled=expired_enabled)
+        if key == "timelength":
+            _expect(params, 2, name)
+            return TimeLengthWindowOp(schema, _ms(params[0], name),
+                                      int(const_of(params[1], 'length')),
+                                      expired_enabled=expired_enabled)
+        if key == "delay":
+            _expect(params, 1, name)
+            return DelayWindowOp(schema, _ms(params[0], name),
+                                 cap=self.DEFAULT_TIME_CAP,
+                                 expired_enabled=expired_enabled)
+        if key == "batch":
+            if len(params) > 1:
+                raise CompileError(f"{name} takes 0-1 parameters")
+            length = int(const_of(params[0], 'length')) if params else 0
+            return BatchWindowOp(schema, length, cap=self.DEFAULT_TIME_CAP,
+                                 expired_enabled=expired_enabled)
+        if key == "cron":
+            _expect(params, 1, name)
+            if not isinstance(params[0], str):
+                raise CompileError(
+                    f"window '{name}' takes a cron expression string")
+            from ..utils.cron import CronError
+            try:
+                return CronWindowOp(schema, params[0],
+                                    cap=self.DEFAULT_TIME_CAP,
+                                    expired_enabled=expired_enabled)
+            except CronError as e:
+                raise CompileError(f"window '{name}': {e}")
+        if key == "session":
+            if len(params) not in (1, 2):
+                raise CompileError(
+                    f"{name} takes 1-2 parameters (allowedLatency is not "
+                    "supported)")
+            ki = None
+            if len(params) == 2:
+                ki = attr_idx(params[1], "session key")
+                if schema.attributes[ki].type is not AttrType.STRING:
+                    raise CompileError(
+                        f"window '{name}' session key must be STRING")
+            return SessionWindowOp(schema, _ms(params[0], name), ki,
+                                   expired_enabled=expired_enabled)
+        if key == "sort":
+            if not params:
+                raise CompileError(f"{name} needs a length parameter")
+            keys = []
+            i = 1
+            while i < len(params):
+                ki = attr_idx(params[i], "sort attribute")
+                order = 1
+                if i + 1 < len(params) and isinstance(params[i + 1], str):
+                    d = params[i + 1].lower()
+                    if d not in ("asc", "desc"):
+                        raise CompileError(
+                            f"{name}: order must be 'asc' or 'desc'")
+                    order = 1 if d == "asc" else -1
+                    i += 1
+                keys.append((ki, order))
+                i += 1
+            if not keys:
+                raise CompileError(f"{name} needs at least one sort "
+                                   "attribute")
+            return SortWindowOp(schema,
+                                int(const_of(params[0], 'length')), keys,
+                                expired_enabled=expired_enabled)
+        if key == "frequent":
+            if not params:
+                raise CompileError(f"{name} needs a count parameter")
+            idxs = [attr_idx(p, "key attribute") for p in params[1:]]
+            return FrequentWindowOp(schema,
+                                    int(const_of(params[0], 'count')),
+                                    idxs,
+                                    expired_enabled=expired_enabled)
+        if key == "lossyfrequent":
+            if not params:
+                raise CompileError(f"{name} needs a support parameter")
+            error = None
+            rest = params[1:]
+            if rest and not isinstance(rest[0], A.Variable):
+                error = float(const_of(rest[0], 'error'))
+                rest = rest[1:]
+            idxs = [attr_idx(p, "key attribute") for p in rest]
+            return LossyFrequentWindowOp(schema,
+                                         float(const_of(params[0],
+                                                        'support')), error,
+                                         idxs,
+                                         expired_enabled=expired_enabled)
         raise CompileError(f"window '{name}' not yet supported")
 
     def plan_query(self, q: A.Query, default_name: str) -> None:
@@ -1321,10 +1501,10 @@ class Planner:
         window_op: Optional[WindowOp] = None
         for h in sin.handlers:
             if isinstance(h, A.Filter):
-                if window_op is not None:
-                    raise CompileError(
-                        f"query '{name}': filter after window not yet "
-                        "supported")
+                # filters may appear before AND after the window
+                # (SingleInputStreamParser.java:202-243 chains handlers in
+                # declaration order; FilterProcessor evaluates its condition
+                # on every non-TIMER event kind)
                 if expr_mentions_table(h.expression):
                     if not allow_tables:
                         raise CompileError(
@@ -1438,12 +1618,13 @@ class Planner:
             window = None
             for h in sin.handlers:
                 if isinstance(h, A.Filter):
-                    if window is not None:
-                        raise CompileError(
-                            f"query '{name}': filter after window")
                     cond = compile_expression(h.expression, scope)
                     ops.append(FilterOp(cond, schema))
                 elif isinstance(h, A.WindowHandler):
+                    if window is not None:
+                        raise CompileError(
+                            f"query '{name}': multiple windows on one "
+                            "join side")
                     cls = self.window_class(h)
                     expired_enabled = expired_on if cls.is_batch \
                         else True  # joins need expired pairs for aggregates
@@ -1454,10 +1635,9 @@ class Planner:
                         f"query '{name}': stream function in join not "
                         "supported")
             if window is None:
-                raise CompileError(
-                    f"query '{name}': join sides need explicit windows "
-                    "(the reference's default-window insertion is not "
-                    "implemented yet)")
+                # default-window insertion (JoinInputStreamParser.java:416)
+                window = EmptyWindowOp(schema, expired_enabled=True)
+                ops.append(window)
             return schema, ops
 
         l_schema, l_ops = side_chain(jin.left, "L")
@@ -1488,6 +1668,11 @@ class Planner:
             raise CompileError(f"duplicate query name '{name}'")
         qr = JoinQueryRuntime(name, l_ops, r_ops, crosses, sel_ops,
                               {"L": l_schema, "R": r_schema}, jschema, app)
+        # cron windows on join sides are host-scheduled like single-stream
+        # ones; their fires reach both sides as TIMER batches
+        qr._host_sched.extend(
+            op.host_schedule for op in l_ops + r_ops
+            if getattr(op, "host_schedule", None))
         app.junctions[jin.left.stream_id].subscribe(
             JoinStreamReceiver(qr, "L"))
         app.junctions[jin.right.stream_id].subscribe(

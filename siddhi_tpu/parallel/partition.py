@@ -447,8 +447,9 @@ class PartitionBlockRuntime:
             return
         plan = next(p for p in self.plans if p.name == qname)
         from ..core.runtime import _timer_batch
-        batch = _timer_batch(plan.in_schema, due)
         now = max(due, self.app.current_time())
+        # TIMER rows carry the advanced clock (see QueryRuntime._on_timer)
+        batch = _timer_batch(plan.in_schema, now)
         self._run(("timer", qname), batch, due, now=now)
 
     # -- snapshot ---------------------------------------------------------

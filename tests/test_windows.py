@@ -131,9 +131,12 @@ class TestTimeWindow:
         assert [e.data[2] for e in got] == [1, 2, 1, 3, 2, 4]
 
     def test_expired_timestamp_rewritten(self):
-        # in playback the scheduler fires the expiry TIMER at 2000 before the
-        # 2500 event is processed; the expired event's ts is the observation
-        # time (TimeWindowProcessor.java:147 setTimestamp(currentTime))
+        # in playback the scheduler fires the expiry TIMER (due 2000) when
+        # the 2500 event advances the clock, BEFORE that event is
+        # processed; the expired event's ts is the ALREADY-ADVANCED clock
+        # (TimeWindowProcessor.java:147 setTimestamp(currentTime), where
+        # currentTime is the playback TimestampGenerator's current value,
+        # 2500 — not the scheduled due)
         _, q = run_app(
             self.QL, "S",
             [(1000, ("A", 1.0, 1)), (2500, ("B", 1.0, 2))],
@@ -141,9 +144,9 @@ class TestTimeWindow:
         assert len(q) == 3
         ins1, rms1 = q[0]
         assert ([e.data[2] for e in ins1], rms1) == ([1], None)
-        ins2, rms2 = q[1]  # timer-driven expiry at due time 2000
+        ins2, rms2 = q[1]  # timer-driven expiry
         assert ins2 is None
-        assert [(e.data[2], e.timestamp) for e in rms2] == [(1, 2000)]
+        assert [(e.data[2], e.timestamp) for e in rms2] == [(1, 2500)]
         ins3, rms3 = q[2]
         assert ([e.data[2] for e in ins3], rms3) == ([2], None)
 

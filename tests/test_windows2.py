@@ -1,0 +1,436 @@
+"""Wave-2 window tests: externalTime, timeLength, delay, batch
+(reference corpus: query/window/ExternalTimeWindowTestCase.java,
+TimeLengthWindowTestCase.java, DelayWindowTestCase.java,
+ExternalTimeBatchWindowTestCase.java). Playback mode throughout."""
+from siddhi_tpu import Event, QueryCallback, SiddhiManager, StreamCallback
+
+PLAYBACK = "@app:playback "
+
+
+def run_app(ql, sends, callback_target=None, query_cb=False):
+    """sends: list of (stream_id, ts, data)."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    stream_got = []
+    q_got = []
+    if callback_target:
+        if query_cb:
+            rt.add_callback(callback_target, QueryCallback(
+                fn=lambda ts, ins, rms: q_got.append((ins, rms))))
+        else:
+            rt.add_callback(callback_target,
+                            StreamCallback(fn=lambda evs:
+                                           stream_got.extend(evs)))
+    rt.start()
+    for sid, ts, data in sends:
+        rt.get_input_handler(sid).send(Event(timestamp=ts,
+                                             data=tuple(data)))
+    rt.shutdown()
+    return stream_got, q_got
+
+
+class TestExternalTimeWindow:
+    QL = PLAYBACK + """
+        define stream S (ets long, v int);
+        @info(name = 'q')
+        from S#window.externalTime(ets, 1 sec)
+        select ets, v
+        insert all events into Out;
+    """
+
+    def test_expiry_driven_by_attribute(self):
+        # events at external times 0, 500, 1400: the third expires the
+        # first (1400 >= 0 + 1000) before itself; wall timestamps are
+        # irrelevant
+        got, _ = run_app(self.QL, [
+            ("S", 9000, (0, 1)),
+            ("S", 9001, (500, 2)),
+            ("S", 9002, (1400, 3)),
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [1, 2, 1, 3]
+
+    def test_query_callback_remove_events(self):
+        _, q = run_app(self.QL, [
+            ("S", 1, (0, 1)),
+            ("S", 2, (2500, 2)),
+        ], callback_target="q", query_cb=True)
+        ins, rms = q[-1]
+        assert [e.data[1] for e in ins] == [2]
+        assert [e.data[1] for e in rms] == [1]
+
+    def test_no_wall_clock_timers(self):
+        # nothing expires without a later event, no matter the gap
+        got, _ = run_app(self.QL, [("S", 1000, (0, 1))],
+                         callback_target="Out")
+        assert [e.data[1] for e in got] == [1]
+
+
+class TestTimeLengthWindow:
+    QL = PLAYBACK + """
+        define stream S (sym string, v int);
+        @info(name = 'q')
+        from S#window.timeLength(2 sec, 2)
+        select sym, v
+        insert all events into Out;
+    """
+
+    def test_length_eviction(self):
+        # 3 quick events with length 2: third evicts first
+        got, _ = run_app(self.QL, [
+            ("S", 1000, ("a", 1)),
+            ("S", 1001, ("a", 2)),
+            ("S", 1002, ("a", 3)),
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [1, 2, 1, 3]
+
+    def test_time_expiry(self):
+        # second event arrives after the first timed out (timer drains it)
+        got, _ = run_app(self.QL, [
+            ("S", 1000, ("a", 1)),
+            ("S", 4000, ("a", 2)),
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [1, 1, 2]
+
+    def test_aggregation_subtracts(self):
+        ql = PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.timeLength(10 sec, 2)
+            select sum(v) as t
+            insert into Out;
+        """
+        got, _ = run_app(ql, [
+            ("S", 1000, ("a", 1)),
+            ("S", 1001, ("a", 2)),
+            ("S", 1002, ("a", 4)),
+        ], callback_target="Out")
+        assert [e.data[0] for e in got] == [1, 3, 6]
+
+
+class TestDelayWindow:
+    QL = PLAYBACK + """
+        define stream S (sym string, v int);
+        @info(name = 'q')
+        from S#window.delay(1 sec)
+        select sym, v
+        insert into Out;
+    """
+
+    def test_events_released_after_delay(self):
+        # event at 1000 is held; event at 2500 advances playback time, the
+        # timer at 2000 releases it first
+        got, _ = run_app(self.QL, [
+            ("S", 1000, ("a", 1)),
+            ("S", 2500, ("a", 2)),
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [1]
+
+    def test_release_order_preserved(self):
+        got, _ = run_app(self.QL, [
+            ("S", 1000, ("a", 1)),
+            ("S", 1100, ("a", 2)),
+            ("S", 5000, ("a", 3)),
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [1, 2]
+
+
+class TestBatchWindow:
+    def test_chunk_tumbling(self):
+        # batch(): each send chunk flushes the previous chunk as expired
+        ql = PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.batch()
+            select sym, v
+            insert all events into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send([Event(1000, ("a", 1)), Event(1001, ("a", 2))])
+        h.send([Event(2000, ("a", 3))])
+        rt.shutdown()
+        # chunk 1: currents 1,2; chunk 2: expired 1,2 then current 3
+        assert [e.data[1] for e in got] == [1, 2, 1, 2, 3]
+
+    def test_batch_length_groups(self):
+        # batch(2): groups of 2 inside one chunk, partial tail flushes too
+        ql = PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.batch(2)
+            select sym, v
+            insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send([Event(1000 + i, ("a", i)) for i in range(5)])
+        rt.shutdown()
+        assert [e.data[1] for e in got] == [0, 1, 2, 3, 4]
+
+    def test_batch_aggregation_per_chunk(self):
+        ql = PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.batch()
+            select sum(v) as t
+            insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send([Event(1000, ("a", 1)), Event(1001, ("a", 2))])
+        h.send([Event(2000, ("a", 5))])
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [3, 5]
+
+
+class TestFilterAfterWindow:
+    def test_filter_applies_to_expired_too(self):
+        # post-window filter sees both current and expired events
+        ql = PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.length(2)[v > 1]
+            select sym, v
+            insert all events into Out;
+        """
+        got, _ = run_app(ql, [
+            ("S", 1000, ("a", 1)),
+            ("S", 1001, ("a", 2)),
+            ("S", 1002, ("a", 3)),   # evicts 1 (filtered out: v==1)
+            ("S", 1003, ("a", 4)),   # evicts 2 (passes)
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [2, 3, 2, 4]
+
+
+class TestSortWindow:
+    QL = PLAYBACK + """
+        define stream S (sym string, v int);
+        @info(name = 'q')
+        from S#window.sort(2, v)
+        select sym, v
+        insert all events into Out;
+    """
+
+    def test_keeps_smallest(self):
+        # sort(2, v): keeps the 2 smallest v; the max is expelled AFTER the
+        # current event that overflowed the window
+        got, _ = run_app(self.QL, [
+            ("S", 1000, ("a", 5)),
+            ("S", 1001, ("a", 3)),
+            ("S", 1002, ("a", 9)),   # 9 is max -> expelled immediately
+            ("S", 1003, ("a", 1)),   # 5 expelled
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [5, 3, 9, 9, 1, 5]
+
+    def test_desc_order(self):
+        ql = self.QL.replace("sort(2, v)", "sort(2, v, 'desc')")
+        # desc: keeps the 2 LARGEST; comparator-max is the smallest
+        got, _ = run_app(ql, [
+            ("S", 1000, ("a", 5)),
+            ("S", 1001, ("a", 3)),
+            ("S", 1002, ("a", 9)),   # 3 expelled (smallest)
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [5, 3, 9, 3]
+
+
+class TestFrequentWindow:
+    QL = PLAYBACK + """
+        define stream S (sym string, v int);
+        @info(name = 'q')
+        from S#window.frequent(1, sym)
+        select sym, v
+        insert all events into Out;
+    """
+
+    def test_single_slot_misra_gries(self):
+        got, _ = run_app(self.QL, [
+            ("S", 1000, ("a", 1)),   # admitted, count 1
+            ("S", 1001, ("b", 2)),   # full: decrement a->0, evict a, admit b
+            ("S", 1002, ("b", 3)),   # hit, passes
+        ], callback_target="Out")
+        assert [(e.data[0], e.data[1]) for e in got] == [
+            ("a", 1), ("a", 1), ("b", 2), ("b", 3)]
+
+    def test_dropped_when_no_room(self):
+        ql = self.QL.replace("frequent(1, sym)", "frequent(1, sym)")
+        got, _ = run_app(ql, [
+            ("S", 1000, ("a", 1)),
+            ("S", 1001, ("a", 2)),   # count 2
+            ("S", 1002, ("b", 3)),   # decrement a->1, no room: b dropped
+        ], callback_target="Out")
+        assert [(e.data[0], e.data[1]) for e in got] == [
+            ("a", 1), ("a", 2)]
+
+
+class TestLossyFrequentWindow:
+    def test_passes_frequent_keys(self):
+        ql = PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.lossyFrequent(0.5, 0.1, sym)
+            select sym, v
+            insert into Out;
+        """
+        # all same key: every event passes (freq 100% >= 40%)
+        got, _ = run_app(ql, [
+            ("S", 1000 + i, ("a", i)) for i in range(5)
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [0, 1, 2, 3, 4]
+
+
+class TestExternalTimeBatchWindow:
+    QL = PLAYBACK + """
+        define stream S (ets long, v int);
+        @info(name = 'q')
+        from S#window.externalTimeBatch(ets, 1 sec)
+        select ets, v
+        insert all events into Out;
+    """
+
+    def test_tumbling_on_external_clock(self):
+        # window [0,1000): events 1,2 buffered; event at 1100 flushes them
+        got, _ = run_app(self.QL, [
+            ("S", 1, (0, 1)),
+            ("S", 2, (500, 2)),
+            ("S", 3, (1100, 3)),   # flush batch 1 -> currents 1,2
+            ("S", 4, (2100, 4)),   # flush batch 2 -> expired 1,2; current 3
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [1, 2, 1, 2, 3]
+
+    def test_batch_aggregation(self):
+        ql = PLAYBACK + """
+            define stream S (ets long, v int);
+            @info(name = 'q')
+            from S#window.externalTimeBatch(ets, 1 sec)
+            select sum(v) as t
+            insert into Out;
+        """
+        got, _ = run_app(ql, [
+            ("S", 1, (0, 2)),
+            ("S", 2, (500, 3)),
+            ("S", 3, (1100, 10)),
+            ("S", 4, (2100, 1)),
+        ], callback_target="Out")
+        assert [e.data[0] for e in got] == [5, 10]
+
+    def test_multi_window_skip_in_one_chunk(self):
+        # events spanning several windows inside ONE send
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(self.QL)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        rt.start()
+        rt.get_input_handler("S").send([
+            Event(1, (0, 1)), Event(2, (100, 2)),
+            Event(3, (1500, 3)),       # flush [0,1000)
+            Event(4, (5200, 4)),       # flush [1000,2000)'s batch {3}
+        ])
+        rt.shutdown()
+        assert [e.data[1] for e in got] == [1, 2, 1, 2, 3]
+
+
+class TestSessionWindow:
+    QL = PLAYBACK + """
+        define stream S (user string, v int);
+        @info(name = 'q')
+        from S#window.session(1 sec, user)
+        select user, v
+        insert all events into Out;
+    """
+
+    def test_session_close_by_gap(self):
+        # two events in one session; a later event (other key) advances the
+        # clock past the gap and the session flushes as expired
+        got, _ = run_app(self.QL, [
+            ("S", 1000, ("u1", 1)),
+            ("S", 1500, ("u1", 2)),
+            ("S", 4000, ("u2", 3)),   # clock 4000 > 1500+1000 -> u1 closes
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [1, 2, 1, 2, 3]
+
+    def test_per_key_isolation(self):
+        # interleaved keys keep separate sessions
+        got, _ = run_app(self.QL, [
+            ("S", 1000, ("u1", 1)),
+            ("S", 1100, ("u2", 2)),
+            ("S", 1200, ("u1", 3)),
+            ("S", 5000, ("u3", 4)),   # both u1 and u2 sessions close
+        ], callback_target="Out")
+        assert [e.data[1] for e in got][:3] == [1, 2, 3]
+        # closes: u1 {1,3} and u2 {2} both flush before current 4
+        closed = [e.data[1] for e in got][3:]
+        assert closed[-1] == 4
+        assert sorted(closed[:-1]) == [1, 2, 3]
+
+    def test_timer_closes_session(self):
+        # no later event needed: playback timer fires on next clock advance
+        ql = PLAYBACK + """
+            define stream S (user string, v int);
+            @info(name = 'q')
+            from S#window.session(1 sec, user)
+            select user, sum(v) as t
+            insert expired events into Out;
+        """
+        got, _ = run_app(ql, [
+            ("S", 1000, ("u1", 5)),
+            ("S", 1200, ("u1", 7)),
+            ("S", 9000, ("u2", 1)),
+        ], callback_target="Out")
+        # expired session members subtract from the running sum one by one
+        # (QuerySelector removal semantics): 12-5=7, then empty -> null
+        assert [(e.data[0], e.data[1]) for e in got] == [
+            ("u1", 7), ("u1", None)]
+
+    def test_new_session_same_key(self):
+        got, _ = run_app(self.QL, [
+            ("S", 1000, ("u1", 1)),
+            ("S", 3000, ("u1", 2)),   # gap elapsed: session{1} closed first
+            ("S", 9000, ("u2", 3)),   # session{2} closes too
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [1, 1, 2, 2, 3]
+
+
+class TestCronWindow:
+    def test_cron_flush_in_playback(self):
+        # fire every second: events buffered until the cron tick
+        ql = PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.cron('0/1 * * * * ?')
+            select sym, v
+            insert into Out;
+        """
+        got, _ = run_app(ql, [
+            ("S", 1000, ("a", 1)),
+            ("S", 1200, ("a", 2)),
+            ("S", 2500, ("a", 3)),   # tick at 2000 flushed {1,2}
+            ("S", 3500, ("a", 4)),   # tick at 3000 flushed {3}
+        ], callback_target="Out")
+        assert [e.data[1] for e in got] == [1, 2, 3]
+
+    def test_cron_parser(self):
+        from siddhi_tpu.utils.cron import CronSchedule
+        import datetime as dt
+        s = CronSchedule("0 30 9 * * ?")
+        t0 = int(dt.datetime(2026, 7, 1, 8, 0,
+                             tzinfo=dt.timezone.utc).timestamp() * 1000)
+        nf = s.next_fire(t0)
+        d = dt.datetime.fromtimestamp(nf / 1000, tz=dt.timezone.utc)
+        assert (d.hour, d.minute, d.second) == (9, 30, 0)
+        assert (d.year, d.month, d.day) == (2026, 7, 1)
+        # next fire strictly after: the following day
+        d2 = dt.datetime.fromtimestamp(s.next_fire(nf) / 1000,
+                                       tz=dt.timezone.utc)
+        assert (d2.day, d2.hour, d2.minute) == (2, 9, 30)
