@@ -1,0 +1,288 @@
+"""I/O connectors: Source/Sink SPI, mappers, the in-memory transport,
+and connection retry.
+
+Reference mapping:
+- stream/input/source/Source.java:155 (connectWithRetry + backoff)
+- stream/output/sink/Sink.java:174-243 (publish with retry / @OnError)
+- util/transport/InMemoryBroker.java:29 + InMemorySource/InMemorySink
+- stream/input/source/SourceMapper / stream/output/sink/SinkMapper SPIs
+- util/transport/BackoffRetryCounter.java
+
+Host-side by design: connectors bridge external systems to the
+InputHandler / StreamCallback boundary; the device pipeline starts after
+ingestion. Custom transports register through the extension SPI as
+`source:<type>` / `sink:<type>` classes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from .stream import Event, StreamCallback
+
+
+class ConnectionUnavailableException(Exception):
+    """Transport temporarily unreachable; triggers retry with backoff."""
+
+
+class BackoffRetryCounter:
+    """Exponential backoff: 5ms, 10ms, ..., capped at 1s (the reference
+    steps seconds; scaled down so tests run fast)."""
+
+    def __init__(self, base_ms: int = 5, cap_ms: int = 1000):
+        self.base_ms = base_ms
+        self.cap_ms = cap_ms
+        self._n = 0
+
+    def next_wait_s(self) -> float:
+        w = min(self.base_ms * (2 ** self._n), self.cap_ms) / 1000.0
+        self._n += 1
+        return w
+
+    def reset(self) -> None:
+        self._n = 0
+
+
+class InMemoryBroker:
+    """Process-wide topic pub/sub (util/transport/InMemoryBroker.java:29)."""
+
+    _topics: dict = {}
+    _lock = threading.Lock()
+
+    @classmethod
+    def subscribe(cls, topic: str, fn: Callable[[Any], None]) -> Callable:
+        with cls._lock:
+            cls._topics.setdefault(topic, []).append(fn)
+        return fn
+
+    @classmethod
+    def unsubscribe(cls, topic: str, fn: Callable) -> None:
+        with cls._lock:
+            subs = cls._topics.get(topic, [])
+            if fn in subs:
+                subs.remove(fn)
+
+    @classmethod
+    def publish(cls, topic: str, message: Any) -> None:
+        with cls._lock:
+            subs = list(cls._topics.get(topic, []))
+        for fn in subs:
+            fn(message)
+
+
+# ---------------------------------------------------------------------------
+# mappers
+# ---------------------------------------------------------------------------
+
+
+class SourceMapper:
+    """Transport payload -> event data tuple(s)."""
+
+    def __init__(self, schema):
+        self.schema = schema
+
+    def map(self, payload) -> list[tuple]:
+        raise NotImplementedError
+
+
+class PassThroughSourceMapper(SourceMapper):
+    def map(self, payload):
+        if isinstance(payload, Event):
+            return [tuple(payload.data)]
+        if isinstance(payload, (list, tuple)) and payload and \
+                isinstance(payload[0], (list, tuple)):
+            return [tuple(p) for p in payload]
+        return [tuple(payload)]
+
+
+class JsonSourceMapper(SourceMapper):
+    """JSON object (or list of objects) keyed by attribute name
+    (the out-of-tree siddhi-map-json default mapping)."""
+
+    def map(self, payload):
+        import json
+        obj = json.loads(payload) if isinstance(payload, (str, bytes)) \
+            else payload
+        objs = obj if isinstance(obj, list) else [obj]
+        names = [a.name for a in self.schema.attributes]
+        return [tuple(o.get(n) for n in names) for o in objs]
+
+
+class SinkMapper:
+    def __init__(self, schema):
+        self.schema = schema
+
+    def map(self, event: Event):
+        raise NotImplementedError
+
+
+class PassThroughSinkMapper(SinkMapper):
+    def map(self, event: Event):
+        return event
+
+
+class JsonSinkMapper(SinkMapper):
+    def map(self, event: Event):
+        import json
+        return json.dumps({a.name: v for a, v in
+                           zip(self.schema.attributes, event.data)})
+
+
+SOURCE_MAPPERS = {"passthrough": PassThroughSourceMapper,
+                  "json": JsonSourceMapper}
+SINK_MAPPERS = {"passthrough": PassThroughSinkMapper,
+                "json": JsonSinkMapper}
+
+
+# ---------------------------------------------------------------------------
+# sources / sinks
+# ---------------------------------------------------------------------------
+
+
+class Source:
+    """Receives external payloads and feeds an InputHandler
+    (stream/input/source/Source.java SPI). Subclasses implement
+    connect/disconnect; payloads go through self.on_payload."""
+
+    def __init__(self, options: dict, mapper: SourceMapper, handler):
+        self.options = options
+        self.mapper = mapper
+        self.handler = handler
+        self.connected = False
+        self._paused = threading.Event()
+        self._paused.set()  # not paused
+
+    # -- lifecycle --------------------------------------------------------
+    def connect(self) -> None:
+        raise NotImplementedError
+
+    def disconnect(self) -> None:
+        pass
+
+    def connect_with_retry(self, max_tries: int = 12) -> None:
+        """Source.connectWithRetry (Source.java:155): exponential backoff
+        until the transport accepts the connection."""
+        backoff = BackoffRetryCounter()
+        for _ in range(max_tries):
+            try:
+                self.connect()
+                self.connected = True
+                return
+            except ConnectionUnavailableException:
+                time.sleep(backoff.next_wait_s())
+        raise ConnectionUnavailableException(
+            f"source {type(self).__name__} failed to connect after "
+            f"{max_tries} attempts")
+
+    def pause(self) -> None:
+        self._paused.clear()
+
+    def resume(self) -> None:
+        self._paused.set()
+
+    def on_payload(self, payload) -> None:
+        self._paused.wait()
+        rows = self.mapper.map(payload)
+        if rows:
+            self.handler.send(rows if len(rows) > 1 else rows[0])
+
+
+class InMemorySource(Source):
+    """@source(type='inMemory', topic='x')
+    (stream/input/source/InMemorySource.java)."""
+
+    def connect(self) -> None:
+        topic = self.options.get("topic")
+        if topic is None:
+            raise ValueError("inMemory source needs a topic option")
+        self._sub = InMemoryBroker.subscribe(topic, self.on_payload)
+
+    def disconnect(self) -> None:
+        topic = self.options.get("topic")
+        if topic is not None and getattr(self, "_sub", None) is not None:
+            InMemoryBroker.unsubscribe(topic, self._sub)
+
+
+class Sink(StreamCallback):
+    """Publishes stream events to an external system
+    (stream/output/sink/Sink.java SPI); publish failures retry with
+    backoff, then follow the on-error action."""
+
+    def __init__(self, options: dict, mapper: SinkMapper):
+        super().__init__()
+        self.options = options
+        self.mapper = mapper
+
+    def connect(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+    def publish(self, payload) -> None:
+        raise NotImplementedError
+
+    def receive(self, events: list[Event]) -> None:
+        for e in events:
+            payload = self.mapper.map(e)
+            backoff = BackoffRetryCounter()
+            for attempt in range(4):
+                try:
+                    self.publish(payload)
+                    break
+                except ConnectionUnavailableException:
+                    if attempt == 3:
+                        raise
+                    time.sleep(backoff.next_wait_s())
+
+
+class InMemorySink(Sink):
+    """@sink(type='inMemory', topic='x')
+    (stream/output/sink/InMemorySink.java)."""
+
+    def publish(self, payload) -> None:
+        topic = self.options.get("topic")
+        if topic is None:
+            raise ValueError("inMemory sink needs a topic option")
+        InMemoryBroker.publish(topic, payload)
+
+
+SOURCE_TYPES = {"inmemory": InMemorySource}
+SINK_TYPES = {"inmemory": InMemorySink}
+
+
+def build_io(app, exts: dict) -> None:
+    """Planner pass: wire @source/@sink annotations on stream definitions
+    (reference: SiddhiAppRuntimeBuilder source/sink attachment).
+    exts: the planner's lowercased extension registry."""
+    from ..ops.expr import CompileError
+    for sid, sd in app.ast.stream_definitions.items():
+        for ann in sd.annotations:
+            kind = ann.name.lower()
+            if kind not in ("source", "sink"):
+                continue
+            opts = {k.lower(): v for k, v in ann.elements.items()}
+            typ = (opts.pop("type", "") or "").lower()
+            mname = (opts.pop("map", "passthrough") or "").lower()
+            schema = app.schemas[sid]
+            if kind == "source":
+                cls = SOURCE_TYPES.get(typ) or exts.get(f"source:{typ}")
+                if cls is None:
+                    raise CompileError(f"unknown source type '{typ}'")
+                mcls = SOURCE_MAPPERS.get(mname)
+                if mcls is None:
+                    raise CompileError(f"unknown source map '{mname}'")
+                src = cls(opts, mcls(schema), app.input_handlers[sid])
+                app.sources.append(src)
+            else:
+                cls = SINK_TYPES.get(typ) or exts.get(f"sink:{typ}")
+                if cls is None:
+                    raise CompileError(f"unknown sink type '{typ}'")
+                mcls = SINK_MAPPERS.get(mname)
+                if mcls is None:
+                    raise CompileError(f"unknown sink map '{mname}'")
+                from .runtime import StreamCallbackReceiver
+                snk = cls(opts, mcls(schema))
+                app.junctions[sid].subscribe(StreamCallbackReceiver(snk))
+                app.sinks.append(snk)

@@ -1,0 +1,310 @@
+"""On-demand (store) queries: `runtime.query("from T select ... ")`.
+
+Reference mapping:
+- util/parser/OnDemandQueryParser.java:87 — parse + dispatch per kind
+- query/{Find,Select,Delete,Update,UpdateOrInsert,Insert}OnDemandQueryRuntime
+
+Execution model: the device does the data-parallel part (condition mask +
+projection expressions over the table's columnar state in one jitted-free
+XLA call per expression); the host does the control-plane part (group-by,
+aggregation over the few matching rows, order/limit/offset). On-demand
+queries are interactive, low-rate operations — the reference also runs
+them synchronously on the caller thread.
+
+Supported: SELECT (projection, group by, sum/avg/count/min/max/
+distinctCount aggregates, order by, limit/offset), DELETE, UPDATE,
+UPDATE OR INSERT, INSERT (constant selection) — against in-memory tables
+and named windows (their retained buffer). `within`/`per` (incremental
+aggregations) are handled by aggregation runtimes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..lang import ast as A
+from ..ops.expr import (CompileError, SingleStreamScope, compile_expression,
+                        env_from_batch)
+from ..ops.selector import output_attribute_name
+from .event import CURRENT, EventBatch, StreamSchema
+from .types import AttrType, GLOBAL_STRINGS
+
+_AGGS = {"sum", "avg", "count", "min", "max", "distinctcount"}
+
+
+def _find_agg(expr):
+    """Return (name, arg_expr) of the outermost aggregator call, or None."""
+    if isinstance(expr, A.AttributeFunction) and \
+            expr.namespace is None and expr.name.lower() in _AGGS:
+        arg = expr.parameters[0] if expr.parameters else None
+        return expr.name.lower(), arg
+    return None
+
+
+def _has_agg(expr) -> bool:
+    if _find_agg(expr):
+        return True
+    for f in getattr(expr, "__dataclass_fields__", {}):
+        v = getattr(expr, f)
+        if isinstance(v, A.Expression) and _has_agg(v):
+            return True
+        if isinstance(v, list) and any(
+                isinstance(x, A.Expression) and _has_agg(x) for x in v):
+            return True
+    return False
+
+
+def _batch_of_buffer(buf: dict) -> EventBatch:
+    cap = buf["valid"].shape[0]
+    return EventBatch(
+        ts=buf.get("ts", jnp.zeros((cap,), jnp.int64)),
+        cols=tuple(buf["cols"]),
+        nulls=tuple(buf["nulls"]),
+        kind=jnp.zeros((cap,), jnp.int32),
+        valid=buf["valid"],
+    )
+
+
+def _decode(values, nulls, typ):
+    out = []
+    for v, nl in zip(values, nulls):
+        if nl:
+            out.append(None)
+        elif typ is AttrType.STRING:
+            out.append(GLOBAL_STRINGS.decode(int(v)))
+        elif typ is AttrType.BOOL:
+            out.append(bool(v))
+        elif typ in (AttrType.FLOAT, AttrType.DOUBLE):
+            out.append(float(v))
+        else:
+            out.append(int(v))
+    return out
+
+
+class OnDemandExecutor:
+    """Per-app executor for store queries."""
+
+    def __init__(self, app):
+        self.app = app
+
+    def _source(self, q: A.OnDemandQuery):
+        app = self.app
+        tid = q.input_id
+        if tid is None and q.output is not None:
+            tid = getattr(q.output, "target", None)
+        t = app.tables.get(tid)
+        if t is not None:
+            return t, t.schema, t.buffer(t.state)
+        w = app.named_windows.get(tid)
+        if w is not None:
+            op = w.operators[0]
+            return None, w.in_schema, op.findable_buffer(w.states[0])
+        raise CompileError(
+            f"on-demand query: '{tid}' is not a defined table or window")
+
+    def execute(self, q: A.OnDemandQuery):
+        if isinstance(q, str):
+            from ..lang.parser import parse_on_demand_query
+            q = parse_on_demand_query(q)
+        table, schema, buf = self._source(q)
+        scope = SingleStreamScope(schema, aliases=(q.alias,))
+        batch = _batch_of_buffer(buf)
+        env = env_from_batch(batch)
+        env["__now__"] = jnp.int64(self.app.current_time())
+        out = q.output
+        # write outputs carry their own ON clause (`delete T on ...`)
+        cond_ast = getattr(out, "on", None) if out is not None else None
+        if cond_ast is None:
+            cond_ast = q.on
+        mask = batch.valid
+        if cond_ast is not None:
+            cond = compile_expression(cond_ast, scope)
+            if cond.type is not AttrType.BOOL:
+                raise CompileError("on-demand ON condition must be BOOL")
+            c = cond.fn(env)
+            mask = mask & c.values & ~c.nulls
+        if out is None or isinstance(out, A.ReturnStream):
+            return self._select(q, schema, scope, env, mask)
+        if table is None:
+            raise CompileError(
+                "on-demand writes target tables, not windows")
+        if isinstance(out, A.DeleteStream):
+            return self._delete(table, mask)
+        if isinstance(out, (A.UpdateStream, A.UpdateOrInsertStream)):
+            upsert = isinstance(out, A.UpdateOrInsertStream)
+            return self._update(q, table, schema, scope, env, mask, upsert)
+        if isinstance(out, A.InsertIntoStream):
+            return self._insert(q, table, schema, scope)
+        raise CompileError(
+            f"unsupported on-demand output {type(out).__name__}")
+
+    # -- SELECT ----------------------------------------------------------
+    def _select(self, q, schema, scope, env, mask):
+        sel = q.selector
+        mask_h = np.asarray(jax.device_get(mask))
+        idx = np.nonzero(mask_h)[0]
+
+        def eval_rows(expr):
+            ce = compile_expression(expr, scope)
+            c = ce.fn(env)
+            vals = np.asarray(jax.device_get(c.values))[idx]
+            nulls = np.asarray(jax.device_get(c.nulls))[idx]
+            return _decode(vals, nulls, ce.type)
+
+        if sel.select_all or not sel.attributes:
+            names = [a.name for a in schema.attributes]
+            cols = [eval_rows(A.Variable(attribute=n)) for n in names]
+            rows = [tuple(col[i] for col in cols)
+                    for i in range(len(idx))]
+            return self._order_limit(q, rows, names)
+
+        has_agg = bool(sel.group_by) or any(
+            _has_agg(oa.expression) for oa in sel.attributes)
+        names = [output_attribute_name(oa, i)
+                 for i, oa in enumerate(sel.attributes)]
+        if not has_agg:
+            cols = [eval_rows(oa.expression) for oa in sel.attributes]
+            rows = [tuple(col[i] for col in cols)
+                    for i in range(len(idx))]
+            return self._order_limit(q, rows, names)
+
+        # group-by + aggregation (host side over matching rows)
+        gb_cols = [eval_rows(g) for g in sel.group_by]
+        n = len(idx)
+        groups: dict = {}
+        for i in range(n):
+            k = tuple(col[i] for col in gb_cols) if gb_cols else ()
+            groups.setdefault(k, []).append(i)
+        attr_plans = []
+        for oa in sel.attributes:
+            agg = _find_agg(oa.expression)
+            if agg is not None:
+                name, arg = agg
+                vals = eval_rows(arg) if arg is not None else [1] * n
+                attr_plans.append(("agg", name, vals))
+            else:
+                attr_plans.append(("plain", None,
+                                   eval_rows(oa.expression)))
+        rows = []
+        for k, members in groups.items():
+            row = []
+            for kind, aname, vals in attr_plans:
+                if kind == "plain":
+                    row.append(vals[members[0]])
+                    continue
+                vs = [vals[i] for i in members if vals[i] is not None]
+                if aname == "count":
+                    row.append(len(members))
+                elif not vs:
+                    row.append(None)
+                elif aname == "sum":
+                    row.append(sum(vs))
+                elif aname == "avg":
+                    row.append(sum(vs) / len(vs))
+                elif aname == "min":
+                    row.append(min(vs))
+                elif aname == "max":
+                    row.append(max(vs))
+                elif aname == "distinctcount":
+                    row.append(len(set(vs)))
+            rows.append(tuple(row))
+        return self._order_limit(q, rows, names)
+
+    def _order_limit(self, q, rows, names):
+        sel = q.selector
+        for ob in reversed(sel.order_by):
+            try:
+                i = names.index(ob.variable.attribute)
+            except ValueError:
+                raise CompileError(
+                    f"order by '{ob.variable.attribute}' is not in the "
+                    "selection")
+            rows.sort(key=lambda r: (r[i] is None, r[i]),
+                      reverse=(ob.order == "desc"))
+        off = int(q.selector.offset.value) if sel.offset is not None else 0
+        lim = int(q.selector.limit.value) if sel.limit is not None \
+            else None
+        rows = rows[off:off + lim] if lim is not None else rows[off:]
+        return rows
+
+    # -- writes ----------------------------------------------------------
+    def _delete(self, table, mask):
+        with table.lock:
+            n = int(jax.device_get(jnp.sum(mask.astype(jnp.int32))))
+            table.state = dict(table.state)
+            table.state["valid"] = table.state["valid"] & ~self._unorder(
+                table, mask)
+        return n
+
+    def _unorder(self, table, mask):
+        """buffer() returns rows in seq order; map the mask back to the
+        table's physical slot order."""
+        order = jnp.argsort(jnp.where(table.state["valid"],
+                                      table.state["seq"],
+                                      jnp.int64(2 ** 62)))
+        inv = jnp.argsort(order)
+        return mask[inv]
+
+    def _update(self, q, table, schema, scope, env, mask, upsert):
+        sets = q.output.set_clause
+        if not sets:
+            raise CompileError("on-demand update needs a SET clause")
+        phys_mask = self._unorder(table, mask)
+        any_match = bool(jax.device_get(jnp.any(mask)))
+        with table.lock:
+            st = dict(table.state)
+            order = jnp.argsort(jnp.where(st["valid"], st["seq"],
+                                          jnp.int64(2 ** 62)))
+            inv = jnp.argsort(order)
+            if any_match or not upsert:
+                cols = list(st["cols"])
+                nulls = list(st["nulls"])
+                for var, expr in sets:
+                    ci = schema.index_of(var.attribute)
+                    ce = compile_expression(expr, scope)
+                    v = ce.fn(env)
+                    vals = jnp.broadcast_to(v.values, phys_mask.shape)
+                    nls = jnp.broadcast_to(v.nulls, phys_mask.shape)
+                    cols[ci] = jnp.where(phys_mask,
+                                         vals[inv].astype(cols[ci].dtype),
+                                         cols[ci])
+                    nulls[ci] = jnp.where(phys_mask, nls[inv], nulls[ci])
+                st["cols"] = tuple(cols)
+                st["nulls"] = tuple(nulls)
+                table.state = st
+                return int(jax.device_get(
+                    jnp.sum(mask.astype(jnp.int32))))
+        # upsert with no match: insert a row built from the SET constants
+        row = [None] * len(schema.attributes)
+        for var, expr in sets:
+            if not isinstance(expr, A.Constant):
+                raise CompileError(
+                    "update-or-insert insert path needs constant SET "
+                    "values")
+            row[schema.index_of(var.attribute)] = expr.value
+        self._insert_row(table, schema, row)
+        return 1
+
+    def _insert(self, q, table, schema, scope):
+        sel = q.selector
+        if sel.select_all or not sel.attributes:
+            raise CompileError("on-demand insert needs a value selection")
+        row = []
+        for oa in sel.attributes:
+            if not isinstance(oa.expression, A.Constant):
+                raise CompileError(
+                    "on-demand insert selection must be constants")
+            row.append(oa.expression.value)
+        self._insert_row(table, schema, row)
+        return 1
+
+    def _insert_row(self, table, schema, row):
+        from .event import batch_from_rows
+        batch = batch_from_rows(schema, [tuple(row)],
+                                [self.app.current_time()], 8)
+        with table.lock:
+            table.state = table.insert(table.state, batch,
+                                       batch.valid)

@@ -16,6 +16,7 @@ feeds micro-batches in; batch capacity is bucketed so jit caches stay warm.
 from __future__ import annotations
 
 import bisect
+import dataclasses
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -125,6 +126,90 @@ class InsertIntoStreamHandler(OutputHandler):
         self.junction.publish(events)
 
 
+class InsertIntoWindowHandler(OutputHandler):
+    """`insert into <named window>`: feed the shared window instance
+    (query/output/callback/InsertIntoWindowCallback.java) — inserted
+    events enter the window as fresh CURRENT arrivals."""
+
+    def __init__(self, wq: "QueryRuntime"):
+        self.wq = wq
+
+    def handle_device_batch(self, out, timestamp):
+        out = EventBatch(
+            ts=out.ts, cols=out.cols, nulls=out.nulls,
+            kind=jnp.where(out.valid, jnp.int32(CURRENT), out.kind),
+            valid=out.valid)
+        self.wq.process_batch(out, timestamp)
+        return True
+
+    def handle(self, timestamp, rows):
+        self.wq.receive([Event(ts, vals) for ts, kind, vals in rows])
+
+
+class WindowPublishHandler(OutputHandler):
+    """Publish a named window's processed output — kinds preserved, so
+    consuming queries see CURRENT/EXPIRED exactly as after an inline
+    window (window/Window.java:65); the definition's output event type
+    filters what subscribers observe."""
+
+    def __init__(self, junction: StreamJunction, out_type: str):
+        self.junction = junction
+        self.out_type = out_type
+
+    def _filtered(self, out):
+        if self.out_type == "current":
+            return out.mask(out.kind == CURRENT)
+        if self.out_type == "expired":
+            return out.mask(out.kind == EXPIRED)
+        return out
+
+    def handle_device_batch(self, out, timestamp):
+        self.junction.publish_batch(self._filtered(out), timestamp)
+        return True
+
+    def handle(self, timestamp, rows):
+        events = [Event(ts, vals, is_expired=(kind == EXPIRED))
+                  for ts, kind, vals in rows
+                  if self.out_type == "all" or
+                  (self.out_type == "current" and kind == CURRENT) or
+                  (self.out_type == "expired" and kind == EXPIRED)]
+        self.junction.publish(events)
+
+
+class TriggerRuntime:
+    """`define trigger T at every 5 sec | at 'cron' | at 'start'`:
+    publishes (triggered_time) events into stream T on schedule
+    (trigger/{Periodic,Cron,Start}Trigger.java; PeriodicTrigger.java:73)."""
+
+    def __init__(self, app, td, junction: StreamJunction):
+        self.app = app
+        self.td = td
+        self.junction = junction
+        self.cron = None
+        if td.at_cron not in (None, "start"):
+            from ..utils.cron import CronSchedule
+            self.cron = CronSchedule(td.at_cron)
+
+    def arm(self, base_ms: int) -> None:
+        if self.td.at_cron == "start":
+            self._fire(base_ms)
+            return
+        if self.cron is not None:
+            due = self.cron.next_fire(base_ms)
+        else:
+            due = base_ms + self.td.at_every_ms
+        self.app.scheduler.notify_at(due, self._on_timer)
+
+    def _on_timer(self, due: int) -> None:
+        if not self.app.running:
+            return
+        self._fire(due)
+        self.arm(due)
+
+    def _fire(self, ts: int) -> None:
+        self.junction.publish([Event(ts, (ts,))])
+
+
 class QueryCallbackHandler(OutputHandler):
     def __init__(self):
         self.callbacks: list[QueryCallback] = []
@@ -181,6 +266,7 @@ class QueryRuntime(Receiver):
         self._host_sched = [op.host_schedule for op in operators
                             if getattr(op, "host_schedule", None)]
         self._sched_due: Optional[int] = None
+        self.rate_limiter = None
 
     # -- compile ---------------------------------------------------------
     def _make_step(self):
@@ -275,14 +361,19 @@ class QueryRuntime(Receiver):
     # -- snapshot (SnapshotService state walk -> one device_get) ----------
     def snapshot_state(self) -> dict:
         with self._lock:
-            return jax.device_get({"states": self.states,
+            snap = jax.device_get({"states": self.states,
                                    "emitted": self._emitted_dev})
+            if self.rate_limiter is not None:
+                snap["rate"] = self.rate_limiter.snapshot_state()
+            return snap
 
     def restore_state(self, snap: dict) -> None:
         with self._lock:
             self.states = snap["states"]
             self._emitted_dev = jnp.asarray(snap["emitted"])
             self._sched_due = None
+            if self.rate_limiter is not None and "rate" in snap:
+                self.rate_limiter.restore_state(snap["rate"])
 
     def reschedule(self) -> None:
         """After restore: re-arm timers from the restored window states
@@ -363,12 +454,35 @@ class QueryRuntime(Receiver):
             stack.enter_context(self.app.tables[t].lock)
         return stack
 
+    def set_rate_limiter(self, rl) -> None:
+        """Install an output rate limiter: all row consumers (insert-into
+        handlers + query/stream callbacks) see only what it emits.
+        batch_callbacks stay a pre-limit device tap."""
+        rl.emit = self._emit_limited
+        rl.start(self.app)
+        self.rate_limiter = rl
+
+    def _emit_limited(self, timestamp: int, rows) -> None:
+        for h in self.output_handlers:
+            h.handle(timestamp, rows)
+        self.callback_handler.handle(timestamp, rows)
+
     def _dispatch_output(self, out, timestamp: int, due=None) -> None:
         """Raw-batch observers, device-to-device chaining, timer
         scheduling, and (only when someone still needs rows) host decode +
         handler/callback delivery."""
         for cb in self.batch_callbacks:
             cb(out)
+        if self.rate_limiter is not None:
+            if due is not None:
+                out_host, due_host = jax.device_get((out, due))
+                self._schedule(int(due_host))
+            else:
+                out_host = jax.device_get(out)
+            rows = rows_from_batch(self.out_schema.types, out_host)
+            if rows:
+                self.rate_limiter.process(timestamp, rows)
+            return
         row_handlers = [h for h in self.output_handlers
                         if not h.handle_device_batch(out, timestamp)]
         decode = bool(row_handlers or self.callback_handler.callbacks)
@@ -886,6 +1000,10 @@ class SiddhiAppRuntime:
         self.input_handlers: dict[str, InputHandler] = {}
         self.queries: dict[str, QueryRuntime] = {}
         self.tables: dict[str, TableRuntime] = {}
+        self.named_windows: dict[str, QueryRuntime] = {}
+        self.triggers: dict[str, TriggerRuntime] = {}
+        self.sources: list = []
+        self.sinks: list = []
         self.partitions: dict = {}  # name -> PartitionBlockRuntime
         # jax.sharding.Mesh: when set, partition blocks shard their key-slot
         # axis over the mesh's first axis (see parallel/partition.py)
@@ -929,6 +1047,17 @@ class SiddhiAppRuntime:
         for q in self.queries.values():
             if getattr(q, "_host_sched", None):
                 q.arm_host_timers(base_ms)
+        for t in self.triggers.values():
+            t.arm(base_ms)
+
+    # -- on-demand (store) queries (OnDemandQueryParser.java:87) ----------
+    def query(self, q):
+        """Execute an on-demand query string/AST against tables / named
+        windows; returns result rows (SELECT) or the affected-row count
+        (writes)."""
+        from .ondemand import OnDemandExecutor
+        with self.barrier:
+            return OnDemandExecutor(self).execute(q)
 
     # -- wiring ----------------------------------------------------------
     def junction_for(self, stream_id: str,
@@ -971,8 +1100,26 @@ class SiddhiAppRuntime:
     def start(self) -> None:
         self.running = True
         self.scheduler.start()
+        for s in self.sources:
+            s.connect_with_retry()
+        for s in self.sinks:
+            s.connect()
         if not self._playback:
             self._arm_cron(self.current_time())
+
+    def start_without_sources(self) -> None:
+        """Lifecycle split (SiddhiAppRuntimeImpl.startWithoutSources
+        :495): run queries but keep sources disconnected."""
+        self.running = True
+        self.scheduler.start()
+        if not self._playback:
+            self._arm_cron(self.current_time())
+
+    def start_sources(self) -> None:
+        for s in self.sources:
+            s.connect_with_retry()
+        for s in self.sinks:
+            s.connect()
 
     # -- checkpoint / restore (SiddhiAppRuntimeImpl.java:677-755) ---------
     def _persistence_store(self):
@@ -1003,6 +1150,8 @@ class SiddhiAppRuntime:
             "queries": {n: q.snapshot_state()
                         for n, q in self.queries.items()
                         if hasattr(q, "snapshot_state")},
+            "windows": {n: w.snapshot_state()
+                        for n, w in self.named_windows.items()},
             "tables": {tid: jax.device_get(t.state)
                        for tid, t in self.tables.items()},
             "partitions": {n: b.snapshot_state()
@@ -1025,6 +1174,10 @@ class SiddhiAppRuntime:
             if q is None or not hasattr(q, "restore_state"):
                 continue
             q.restore_state(snap)
+        for n, snap in payload.get("windows", {}).items():
+            w = self.named_windows.get(n)
+            if w is not None:
+                w.restore_state(snap)
         for tid, tstate in payload["tables"].items():
             if tid in self.tables:
                 self.tables[tid].state = tstate
@@ -1034,16 +1187,25 @@ class SiddhiAppRuntime:
         for q in self.queries.values():
             if hasattr(q, "reschedule"):
                 q.reschedule()
+        for w in self.named_windows.values():
+            w.reschedule()
         for b in self.partitions.values():
             b.reschedule()
 
     def persist(self) -> str:
         """Snapshot to the manager's persistence store; returns the
-        revision id."""
+        revision id. Sources pause around the capture
+        (SiddhiAppRuntimeImpl.persist:677-693)."""
         from .persistence import new_revision
         store = self._persistence_store()
         rev = new_revision(self.name)
-        store.save(self.name, rev, self.snapshot())
+        for s in self.sources:
+            s.pause()
+        try:
+            store.save(self.name, rev, self.snapshot())
+        finally:
+            for s in self.sources:
+                s.resume()
         return rev
 
     def restore_revision(self, revision: str) -> None:
@@ -1072,6 +1234,10 @@ class SiddhiAppRuntime:
 
     def shutdown(self) -> None:
         self.running = False
+        for s in self.sources:
+            s.disconnect()
+        for s in self.sinks:
+            s.disconnect()
         self.scheduler.shutdown()
         for q in self.queries.values():
             if hasattr(q, "_sched_due") and isinstance(
@@ -1091,6 +1257,12 @@ class Planner:
     def __init__(self, app: SiddhiAppRuntime):
         self.app = app
         self.ast = app.ast
+        from .extension import build_function_table
+        self.functions = build_function_table(app)
+        mgr = app.manager
+        self.extensions = {k.lower(): v for k, v in
+                           (getattr(mgr, "extensions", {}) or {}).items()} \
+            if mgr is not None else {}
 
     DEFAULT_TABLE_CAP = 8192
 
@@ -1102,6 +1274,15 @@ class Planner:
                 Attribute(a.name, a.type) for a in sd.attributes))
             j = app.junction_for(sid, schema)
             app.input_handlers[sid] = InputHandler(sid, j, app)
+            oe = A.find_annotation(sd.annotations, "OnError")
+            if oe is not None:
+                action = (oe.element("action") or "LOG").upper()
+                j.on_error_action = action
+                if action == "STREAM":
+                    # shadow fault stream !sid: original attrs + _error
+                    fschema = StreamSchema("!" + sid, schema.attributes + (
+                        Attribute("_error", AttrType.STRING),))
+                    j.fault_junction = app.junction_for("!" + sid, fschema)
         # 1b. defined tables (@PrimaryKey -> upsert semantics)
         for tid, td in ast.table_definitions.items():
             schema = StreamSchema(tid, tuple(
@@ -1114,6 +1295,29 @@ class Planner:
             app.tables[tid] = TableRuntime(tid, schema,
                                            capacity=self.DEFAULT_TABLE_CAP,
                                            pk_indices=pk)
+        # 1c. named windows: one shared window instance per definition
+        # (window/Window.java:65); queries consume from its junction,
+        # insert-into feeds the instance
+        for wid, wd in ast.window_definitions.items():
+            schema = StreamSchema(wid, tuple(
+                Attribute(a.name, a.type) for a in wd.attributes))
+            fo = wd.window
+            if fo is None:
+                raise CompileError(f"window '{wid}' needs a window type")
+            h = A.WindowHandler(namespace=fo.namespace, name=fo.name,
+                                parameters=fo.parameters)
+            op = self.make_window(h, schema, expired_enabled=True)
+            wq = QueryRuntime(f"__window__{wid}", [op], schema, app)
+            out_j = app.junction_for(wid, schema)
+            wq.output_handlers.append(
+                WindowPublishHandler(out_j, wd.output_event_type))
+            app.named_windows[wid] = wq
+        # 1d. triggers: scheduled event publishers into stream <tid>
+        for tid, td in ast.trigger_definitions.items():
+            schema = StreamSchema(tid, (
+                Attribute("triggered_time", AttrType.LONG),))
+            tj = app.junction_for(tid, schema)
+            app.triggers[tid] = TriggerRuntime(app, td, tj)
         # playback mode
         pb = A.find_annotation(ast.annotations, "playback")
         if pb is not None:
@@ -1128,6 +1332,9 @@ class Planner:
             elif isinstance(el, A.Partition):
                 pcount += 1
                 qcount = self.plan_partition(el, qcount, pcount)
+        # 3. sources/sinks from @source/@sink annotations
+        from .io import build_io
+        build_io(app, self.extensions)
 
     # -- partitions ------------------------------------------------------
     DEFAULT_PARTITION_SLOTS = 32
@@ -1269,6 +1476,7 @@ class Planner:
                         plan.target, tj, app)
                 port.output_handlers.append(
                     InsertIntoStreamHandler(tj, plan.out_type))
+            self.attach_rate_limiter(port, q, plan.name)
         return qcount
 
     # -- windows ---------------------------------------------------------
@@ -1276,6 +1484,9 @@ class Planner:
         name = h.name if h.namespace is None else f"{h.namespace}:{h.name}"
         cls = WINDOW_CLASSES.get(name.lower())
         if cls is None:
+            ext = self.extensions.get(name.lower())
+            if isinstance(ext, type) and issubclass(ext, WindowOp):
+                return ext
             raise CompileError(f"window '{name}' not yet supported")
         return cls
 
@@ -1444,6 +1655,9 @@ class Planner:
                                                         'support')), error,
                                          idxs,
                                          expired_enabled=expired_enabled)
+        ext = self.extensions.get(key)
+        if isinstance(ext, type) and issubclass(ext, WindowOp):
+            return ext(schema, params, expired_enabled=expired_enabled)
         raise CompileError(f"window '{name}' not yet supported")
 
     def plan_query(self, q: A.Query, default_name: str) -> None:
@@ -1458,6 +1672,9 @@ class Planner:
                 f"query '{name}': only single-stream, join, and pattern "
                 "queries supported in this stage")
         sin = q.input
+        if getattr(sin, "is_fault", False):
+            sin = dataclasses.replace(sin, stream_id="!" + sin.stream_id,
+                                      is_fault=False)
         schema = app.schemas.get(sin.stream_id)
         if schema is None:
             raise CompileError(f"query '{name}': undefined stream "
@@ -1486,6 +1703,46 @@ class Planner:
         app.junctions[sin.stream_id].subscribe(qr)
         app.queries[name] = qr
         self.wire_stream_output(qr, out, out_type)
+        self.attach_rate_limiter(qr, q, name)
+
+    def attach_rate_limiter(self, qr, q: A.Query, name: str) -> None:
+        """`output <all|first|last> every N events / T` and
+        `output snapshot every T` -> a host-side limiter gating the row
+        path (reference: OutputParser rate selection +
+        query/output/ratelimit/)."""
+        rate = q.output_rate
+        if rate is None:
+            return
+        from .ratelimit import build_rate_limiter
+        key_fn = None
+        needs_key = (isinstance(rate, (A.EventOutputRate,
+                                       A.TimeOutputRate))
+                     and rate.type in ("first", "last")) or \
+            isinstance(rate, A.SnapshotOutputRate)
+        gb = q.selector.group_by or []
+        if needs_key and gb:
+            idxs = []
+            for g in gb:
+                col = None
+                for i, oa in enumerate(q.selector.attributes):
+                    e = oa.expression
+                    if isinstance(e, A.Variable) and \
+                            e.attribute == g.attribute:
+                        col = i
+                        break
+                if col is None:
+                    try:
+                        col = qr.out_schema.index_of(g.attribute)
+                    except KeyError:
+                        raise CompileError(
+                            f"query '{name}': group-by rate limiting "
+                            f"needs '{g.attribute}' in the projection")
+                idxs.append(col)
+
+            def key_fn(row, _idxs=tuple(idxs)):
+                return tuple(row[2][i] for i in _idxs)
+
+        qr.set_rate_limiter(build_rate_limiter(rate, key_fn))
 
     def build_single_chain(self, q: A.Query, name: str,
                            schema: StreamSchema, sin: A.SingleInputStream,
@@ -1513,7 +1770,8 @@ class Planner:
                     operators.append(TableFilterOp(
                         h.expression, schema, app.tables, scope))
                     continue
-                cond = compile_expression(h.expression, scope)
+                cond = compile_expression(h.expression, scope,
+                                          self.functions)
                 if cond.type is not AttrType.BOOL:
                     raise CompileError(f"query '{name}': filter must be BOOL")
                 operators.append(FilterOp(cond, schema))
@@ -1531,21 +1789,37 @@ class Planner:
                 window_op = self.make_window(h, schema, expired_enabled)
                 operators.append(window_op)
             else:
-                raise CompileError(
-                    f"query '{name}': stream function "
-                    f"'{h.name}' not yet supported")
+                from ..ops.streamfn import make_stream_function
+                op = make_stream_function(h, schema, scope,
+                                          self.functions,
+                                          self.extensions, name)
+                operators.append(op)
+                if op.out_schema.types != schema.types:
+                    schema = op.out_schema
+                    scope = SingleStreamScope(schema,
+                                              aliases=(sin.alias,))
 
         batch_mode = window_op is not None and window_op.is_batch
-        expired_possible = window_op is not None and window_op.expired_enabled
+        src_window = None if sin.is_inner else \
+            app.named_windows.get(sin.stream_id)
+        expired_possible = (window_op is not None
+                            and window_op.expired_enabled) or \
+            src_window is not None
 
         if needs_agg:
             operators.append(AggregateOp(
                 q.selector, schema, target, scope,
+                functions=self.functions,
                 batch_mode=batch_mode, expired_possible=expired_possible,
-                current_on=current_on, expired_on=expired_on))
+                current_on=current_on, expired_on=expired_on,
+                fifo_expiry=(window_op.fifo_expiry
+                             if window_op is not None else
+                             (src_window.operators[0].fifo_expiry
+                              if src_window is not None else True))))
         else:
             operators.append(ProjectOp(
                 q.selector, schema, target, scope,
+                functions=self.functions,
                 current_on=current_on, expired_on=expired_on))
         return operators
 
@@ -1584,6 +1858,11 @@ class Planner:
 
     def wire_stream_output(self, qr, out, out_type: str) -> None:
         app = self.app
+        if isinstance(out, A.InsertIntoStream) and \
+                out.target in app.named_windows:
+            qr.output_handlers.append(
+                InsertIntoWindowHandler(app.named_windows[out.target]))
+            return
         if isinstance(out, A.InsertIntoStream) and \
                 out.target not in app.tables:
             tj = app.junction_for(out.target, qr.out_schema)
@@ -1658,7 +1937,8 @@ class Planner:
             sel_ops: list[Operator] = [AggregateOp(
                 q.selector, jschema, target, sel_scope,
                 batch_mode=False, expired_possible=True,
-                current_on=current_on, expired_on=expired_on)]
+                current_on=current_on, expired_on=expired_on,
+                fifo_expiry=False)]
         else:
             sel_ops = [ProjectOp(q.selector, jschema, target, sel_scope,
                                  current_on=current_on,
@@ -1684,6 +1964,7 @@ class Planner:
                 app.input_handlers[out.target] = InputHandler(
                     out.target, tj, app)
             qr.output_handlers.append(InsertIntoStreamHandler(tj, out_type))
+        self.attach_rate_limiter(qr, q, name)
 
     # -- pattern / sequence queries --------------------------------------
     def plan_pattern_query(self, q: A.Query, name: str) -> None:
@@ -1735,6 +2016,7 @@ class Planner:
                 app.input_handlers[out.target] = InputHandler(
                     out.target, tj, app)
             qr.output_handlers.append(InsertIntoStreamHandler(tj, out_type))
+        self.attach_rate_limiter(qr, q, name)
 
 
 def _expect(params, n, name):

@@ -53,27 +53,59 @@ class StreamJunction:
     def subscribe(self, receiver: Receiver) -> None:
         self.receivers.append(receiver)
 
+    def _handle_error(self, events: Optional[list[Event]],
+                      exc: Exception) -> None:
+        """@OnError routing (StreamJunction.handleError:368-430): STREAM
+        converts the failing events + exception into fault events on the
+        `!stream` junction; LOG (default) logs and continues."""
+        if self.on_error_action == "STREAM" and \
+                self.fault_junction is not None and events:
+            msg = f"{type(exc).__name__}: {exc}"
+            self.fault_junction.publish([
+                Event(e.timestamp, tuple(e.data) + (msg,),
+                      is_expired=e.is_expired) for e in events])
+            return
+        import traceback
+        print(f"[siddhi_tpu] error processing events on stream "
+              f"'{self.stream_id}' (action=LOG):")
+        traceback.print_exc()
+
     def publish(self, events: list[Event]) -> None:
         if not events:
             return
         for r in list(self.receivers):
-            r.receive(events)
+            try:
+                r.receive(events)
+            except Exception as exc:  # noqa: BLE001 — fault-stream contract
+                self._handle_error(events, exc)
 
     def publish_batch(self, batch, last_ts: int) -> None:
         """Columnar fast path: receivers that implement process_batch get
         the device batch directly; row-oriented receivers get decoded
         events (decoded at most once)."""
         decoded = None
+
+        def decode():
+            from .event import EXPIRED, rows_from_batch
+            rows = rows_from_batch(self.schema.types, batch)
+            return [Event(ts, vals, is_expired=(kind == EXPIRED))
+                    for ts, kind, vals in rows]
+
         for r in list(self.receivers):
-            if hasattr(r, "process_batch"):
-                r.process_batch(batch, last_ts)
-            else:
+            try:
+                if hasattr(r, "process_batch"):
+                    r.process_batch(batch, last_ts)
+                else:
+                    if decoded is None:
+                        decoded = decode()
+                    r.receive(decoded)
+            except Exception as exc:  # noqa: BLE001 — fault-stream contract
                 if decoded is None:
-                    from .event import EXPIRED, rows_from_batch
-                    rows = rows_from_batch(self.schema.types, batch)
-                    decoded = [Event(ts, vals, is_expired=(kind == EXPIRED))
-                               for ts, kind, vals in rows]
-                r.receive(decoded)
+                    try:
+                        decoded = decode()
+                    except Exception:  # noqa: BLE001
+                        decoded = []
+                self._handle_error(decoded, exc)
 
 
 class InputHandler:

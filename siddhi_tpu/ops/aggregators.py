@@ -22,10 +22,12 @@ associative combine (sum / min / max). A batch is processed as:
   4. unsort -> per-row running aggregate values (exactly the per-event
      values the reference's tree-walk produces), project, gate, emit.
 
-min/max over content that can EXPIRE (sliding windows) needs a value
-multiset per key (the reference keeps a Deque); that path is a bounded
-per-slot value buffer updated with a lax.scan — not yet implemented; the
-planner rejects it explicitly.
+Aggregators whose state cannot be a pure accumulator run as STATEFUL
+specs with bounded device tables: distinctCount keeps a (group, value)
+multiplicity table whose 0<->1 transitions feed an ordinary sum lane, and
+sliding min()/max() keeps per-key value rings answered by vectorized
+segment-tree range queries (FIFO window expiry makes a key's live
+multiset a contiguous ring range).
 """
 from __future__ import annotations
 
@@ -272,15 +274,257 @@ class BoolAgg(AggSpec):
 
 
 class DistinctCountAgg(AggSpec):
-    """distinctCount(): needs a per-key value->count map; bounded device
-    multiset not yet implemented — planner rejects."""
+    """distinctCount(): exact distinct-value count per group, with
+    removal support (DistinctCountAttributeAggregatorExecutor keeps a
+    value->count HashMap).
 
-    def __init__(self, *_):
-        raise CompileError("distinctCount() is not supported yet")
+    Device design: one bounded open-addressing table over (group slot,
+    value) pairs holds each pair's multiplicity. Per batch: running
+    per-pair counts via a segmented scan over pair segments give each
+    row's 0<->1 transition (+1 first add, -1 last remove); those deltas
+    then scan over (group, reset) segments with a [K] carry — the same
+    shape as every other lane, just with a stateful pre-pass. Pairs
+    beyond the table capacity are dropped AND counted."""
+
+    stateful = True
+    D = 4096  # (group, value) pair slots
+
+    def __init__(self, arg_type: AttrType):
+        if arg_type is None:
+            raise CompileError("distinctCount() needs an argument")
+        self.name = "distinctCount"
+        self.out_type = AttrType.LONG
+        self.lanes = (Lane("sum", jnp.int64),)
+
+    def init_table(self, K: int):
+        return {"keys": jnp.zeros((self.D,), jnp.int64),
+                "used": jnp.zeros((self.D,), jnp.bool_),
+                "counts": jnp.zeros((self.D,), jnp.int64),
+                "carry": jnp.zeros((K,), jnp.int64),
+                "overflow": jnp.int64(0)}
+
+    def run(self, arg, ctx, tab):
+        B = ctx["B"]
+        K = ctx["K"]
+        D = self.D
+        slots, agg_row = ctx["slots"], ctx["agg_row"]
+        is_add, is_remove = ctx["is_add"], ctx["is_remove"]
+        reset_seg, n_resets = ctx["reset_seg"], ctx["n_resets"]
+
+        ph = hash_columns(
+            [slots.astype(jnp.int64), arg.values],
+            [jnp.zeros((B,), jnp.bool_), arg.nulls])
+        pslots, pkeys, pused, ovf = lookup_or_insert(
+            tab["keys"], tab["used"], ph, agg_row)
+        tracked = agg_row & (pslots >= 0)
+        sgn = jnp.where(tracked & is_add, jnp.int64(1),
+                        jnp.where(tracked & is_remove, jnp.int64(-1),
+                                  jnp.int64(0)))
+        ps_safe = jnp.clip(pslots, 0, D - 1)
+        pair_seg = jnp.where(tracked, ps_safe.astype(jnp.int64),
+                             jnp.int64(D)) * (B + 1) + reset_seg
+        perm2 = jnp.argsort(jnp.clip(pair_seg, 0, 2 ** 31 - 1)
+                            .astype(jnp.int32), stable=True)
+        inv2 = jnp.argsort(perm2.astype(jnp.int32))
+        run_s = segmented_cumsum(sgn[perm2], pair_seg[perm2])
+        carry_pair = jnp.where((reset_seg == 0) & tracked,
+                               tab["counts"][ps_safe], 0)
+        run = run_s[inv2] + carry_pair
+        delta = jnp.where(tracked & is_add & (run == 1), jnp.int64(1),
+                          jnp.where(tracked & is_remove & (run == 0),
+                                    jnp.int64(-1), jnp.int64(0)))
+
+        # new pair counts: each pair's final running count in the LAST
+        # reset segment (pairs untouched after a reset drop to 0)
+        base_counts = jnp.where(n_resets == 0, tab["counts"],
+                                jnp.zeros_like(tab["counts"]))
+        seg_s = pair_seg[perm2]
+        is_pair_last_s = jnp.concatenate([
+            seg_s[:-1] != seg_s[1:], jnp.ones((1,), jnp.bool_)])
+        pair_last = is_pair_last_s[inv2] & tracked & \
+            (reset_seg == n_resets)
+        tgt = jnp.where(pair_last, ps_safe, jnp.int32(D))
+        new_counts = base_counts.at[tgt].set(
+            jnp.where(pair_last, run, 0), mode="drop")
+
+        # distinct running value per row: scan deltas over (group, reset)
+        lane = self.lanes[0]
+        d_sorted = delta[ctx["perm"]]
+        pref = lane.segmented_scan(d_sorted, ctx["seg_sorted"])
+        slot_safe = jnp.clip(ctx["slot_sorted"], 0, K - 1)
+        carry_vec = tab["carry"]
+        cin = jnp.where(ctx["segzero_sorted"], carry_vec[slot_safe],
+                        jnp.int64(0))
+        running = (cin + pref)[ctx["inv_perm"]]
+
+        # new [K] carry: deltas in the last reset segment
+        last_mask = (reset_seg == n_resets) & tracked
+        base = jnp.where(n_resets == 0, carry_vec,
+                         jnp.zeros_like(carry_vec))
+        ktgt = jnp.where(last_mask, slots, jnp.int32(K))
+        new_carry = base.at[ktgt].add(jnp.where(last_mask, delta, 0),
+                                      mode="drop")
+        new_tab = {"keys": pkeys, "used": pused, "counts": new_counts,
+                   "carry": new_carry,
+                   "overflow": tab["overflow"] + ovf}
+        return (running,), new_tab
+
+    def value(self, lane_vals):
+        (d,) = lane_vals
+        return Col(d, jnp.zeros_like(d, dtype=jnp.bool_))
+
+
+def _tree_levels(w: int) -> int:
+    return int(w).bit_length() - 1
+
+
+class SlidingMinMaxAgg(AggSpec):
+    """min()/max() over sliding-window content (removal support).
+
+    The reference walks a Deque per key
+    (MinAttributeAggregatorExecutor.processRemove). Device design:
+    window expiry is FIFO (clones expire in arrival order), so a key's
+    live multiset is a contiguous per-key sequence range [head, tail).
+    Values land in a per-key ring buffer; each row's extreme is a
+    range-min/max query answered by an implicit segment tree built once
+    per step over the rings ([K, 2W] min-reduction, then a vmapped
+    O(log W) query per row). Live content beyond W is dropped from the
+    extreme AND counted."""
+
+    stateful = True
+
+    def __init__(self, arg_type: AttrType, is_max: bool, grouped: bool):
+        if arg_type not in NUMERIC_TYPES:
+            raise CompileError("min()/max() requires numeric input")
+        self.name = "max" if is_max else "min"
+        self.is_max = is_max
+        self.out_type = arg_type
+        self.dtype = np_dtype(arg_type)
+        self.W = 256 if grouped else 4096  # ring capacity per key
+        self.lanes = (Lane("max" if is_max else "min", self.dtype),
+                      Lane("sum", jnp.int64))
+
+    def _ident(self):
+        return self.lanes[0].identity()
+
+    def init_table(self, K: int):
+        return {"ring": jnp.full((K, self.W), self._ident(),
+                                 dtype=self.dtype),
+                "heads": jnp.zeros((K,), jnp.int64),
+                "tails": jnp.zeros((K,), jnp.int64),
+                "overflow": jnp.int64(0)}
+
+    def run(self, arg, ctx, tab):
+        B, K, W = ctx["B"], ctx["K"], self.W
+        slots = jnp.clip(ctx["slots"], 0, K - 1)
+        agg_row = ctx["agg_row"]
+        is_add = ctx["is_add"] & agg_row & ~arg.nulls
+        is_remove = ctx["is_remove"] & agg_row & ~arg.nulls
+        reset_seg, n_resets = ctx["reset_seg"], ctx["n_resets"]
+        # RESET clears all state: model as heads := tails at the reset
+        # point. With in-batch resets we conservatively clear BEFORE the
+        # batch too (resets mid-batch with live sliding content is a
+        # degenerate mix the reference only reaches via batch windows,
+        # where min/max uses the non-sliding path).
+        had_reset = n_resets > 0
+        heads0 = jnp.where(had_reset, tab["tails"], tab["heads"])
+
+        # per-row per-key add/remove ranks (sorted by group slot)
+        perm, inv_perm = ctx["perm"], ctx["inv_perm"]
+        gseg = ctx["slot_sorted"].astype(jnp.int64)
+        adds_s = segmented_cumsum(is_add[perm].astype(jnp.int64), gseg)
+        rems_s = segmented_cumsum(is_remove[perm].astype(jnp.int64), gseg)
+        add_rank = adds_s[inv_perm]   # inclusive count up to this row
+        rem_rank = rems_s[inv_perm]
+        tail_row = tab["tails"][slots] + add_rank   # after this row
+        head_row = heads0[slots] + rem_rank
+        # clamp ring span: live beyond W drops off the extreme; a key
+        # whose batch adds run more than W past a row's head also
+        # overwrites ring slots that row still queries — both are
+        # dropped-accuracy cases, counted as overflow
+        over = jnp.maximum(tail_row - head_row - W, 0)
+        head_eff = head_row + over
+
+        # scatter this batch's added values into the rings
+        pos = jnp.where(is_add, (tail_row - 1) % W, 0).astype(jnp.int32)
+        sslot = jnp.where(is_add, slots, jnp.int32(K))
+        ring = tab["ring"].at[sslot, pos].set(
+            jnp.where(is_add, arg.values.astype(self.dtype),
+                      self._ident()), mode="drop")
+
+        # implicit segment tree over each ring: tree[:, 1:2W), leaves at
+        # [W, 2W) = ring positions
+        lane = self.lanes[0]
+        levels = [ring]
+        cur = ring
+        for _ in range(_tree_levels(W)):
+            cur = lane.combine(cur[:, 0::2], cur[:, 1::2])
+            levels.append(cur)
+        tree = jnp.concatenate([lv for lv in reversed(levels)], axis=1)
+        # tree layout: index 1 = root ... leaves at [W, 2W)
+        pad = jnp.full((K, 1), self._ident(), dtype=self.dtype)
+        tree = jnp.concatenate([pad, tree], axis=1)
+
+        # vmapped iterative RMQ over [head_eff, tail_row): the ring range
+        # may wrap, so split into two non-wrapping leaf ranges and run
+        # the standard bottom-up query on each
+        span = jnp.maximum(tail_row - head_eff, 0)
+        h = (head_eff % W).astype(jnp.int32)
+        end = h + jnp.minimum(span, W).astype(jnp.int32)
+        a1, b1 = h, jnp.minimum(end, W)           # [h, min(end, W))
+        a2 = jnp.zeros_like(h)
+        b2 = jnp.maximum(end - W, 0).astype(jnp.int32)  # wrapped part
+        ltree = tree[slots]  # [B, 2W] per-row gather of the key's tree
+
+        def rmq(a, b):
+            res = jnp.full((B,), self._ident(), dtype=self.dtype)
+            li = (a + W).astype(jnp.int32)
+            ri = (b + W).astype(jnp.int32)
+            for _ in range(_tree_levels(W) + 1):
+                open_ = li < ri
+                take_l = open_ & ((li & 1) == 1)
+                vl = jnp.take_along_axis(
+                    ltree, jnp.where(take_l, li, 1)[:, None],
+                    axis=1)[:, 0]
+                res = jnp.where(take_l, lane.combine(res, vl), res)
+                li = jnp.where(take_l, li + 1, li)
+                open_ = li < ri
+                take_r = open_ & ((ri & 1) == 1)
+                vr = jnp.take_along_axis(
+                    ltree, jnp.where(take_r, ri - 1, 1)[:, None],
+                    axis=1)[:, 0]
+                res = jnp.where(take_r, lane.combine(res, vr), res)
+                ri = jnp.where(take_r, ri - 1, ri)
+                li = li >> 1
+                ri = ri >> 1
+            return res
+
+        res = lane.combine(rmq(a1, b1), rmq(a2, b2))
+        count_row = span
+        # new per-key pointers: totals after the batch
+        n_adds = jax.ops.segment_sum(
+            is_add.astype(jnp.int64), slots.astype(jnp.int32),
+            num_segments=K)
+        end_tail = (tab["tails"] + n_adds)[slots]
+        overflow_rows = jnp.sum(
+            (agg_row & (end_tail - head_eff > W)).astype(jnp.int64))
+        n_rems = jax.ops.segment_sum(
+            is_remove.astype(jnp.int64), slots.astype(jnp.int32),
+            num_segments=K)
+        new_tails = tab["tails"] + n_adds
+        new_heads = jnp.maximum(heads0 + n_rems, new_tails - W)
+        new_tab = {"ring": ring, "heads": new_heads, "tails": new_tails,
+                   "overflow": tab["overflow"] + overflow_rows}
+        return (res, count_row), new_tab
+
+    def value(self, lane_vals):
+        m, cnt = lane_vals
+        return Col(jnp.where(cnt == 0, jnp.zeros_like(m), m), cnt == 0)
 
 
 def make_agg_spec(name: str, arg_type: Optional[AttrType],
-                  expired_possible: bool) -> AggSpec:
+                  expired_possible: bool, grouped: bool = False,
+                  fifo_expiry: bool = True) -> AggSpec:
     key = name.lower()
     if key == "sum":
         return SumAgg(arg_type)
@@ -291,18 +535,20 @@ def make_agg_spec(name: str, arg_type: Optional[AttrType],
     if key == "stddev":
         return StdDevAgg(arg_type)
     if key in ("min", "max"):
-        if expired_possible:
+        if expired_possible and not fifo_expiry:
             raise CompileError(
-                f"{key}() over a sliding window (expiring events) needs the "
-                "multiset aggregator — not supported yet; use minForever/"
-                "maxForever or a batch window")
+                f"{key}() over a window with non-FIFO expiry (sort/"
+                "frequent/lossyFrequent) is not supported — the sliding "
+                "extreme relies on arrival-order expiry")
+        if expired_possible:
+            return SlidingMinMaxAgg(arg_type, key == "max", grouped)
         return MinMaxAgg(arg_type, key == "max")
     if key in ("minforever", "maxforever"):
         return ForeverMinMaxAgg(arg_type, key == "maxforever")
     if key in ("and", "or"):
         return BoolAgg(arg_type, key == "and")
     if key == "distinctcount":
-        return DistinctCountAgg()
+        return DistinctCountAgg(arg_type)
     raise CompileError(f"unknown aggregator '{name}'")
 
 
@@ -379,7 +625,7 @@ class AggregateOp(Operator):
                  out_stream_id: str, scope: Scope, functions=None,
                  batch_mode: bool = False, expired_possible: bool = True,
                  current_on: bool = True, expired_on: bool = False,
-                 key_capacity: int = 1024):
+                 key_capacity: int = 1024, fifo_expiry: bool = True):
         self.in_schema = in_schema
         self.batch_mode = batch_mode
         self.current_on = current_on
@@ -408,14 +654,17 @@ class AggregateOp(Operator):
             if len(params) > 1:
                 raise CompileError(
                     f"{name}() takes at most one argument here")
+            grouped = bool(selector.group_by)
             if params:
                 ce = compile_expression(params[0], scope, functions)
                 self.agg_specs.append(
-                    make_agg_spec(name, ce.type, expired_possible))
+                    make_agg_spec(name, ce.type, expired_possible,
+                                  grouped, fifo_expiry))
                 self.agg_args.append(ce)
             else:
                 self.agg_specs.append(
-                    make_agg_spec(name, None, expired_possible))
+                    make_agg_spec(name, None, expired_possible,
+                                  grouped, fifo_expiry))
                 self.agg_args.append(None)
 
         agg_types = [s.out_type for s in self.agg_specs]
@@ -456,6 +705,10 @@ class AggregateOp(Operator):
             "keys": jnp.zeros((self.K,), jnp.int64),
             "used": jnp.zeros((self.K,), jnp.bool_),
             "carry": tuple(carries),
+            "tables": tuple(
+                spec.init_table(self.K)
+                if getattr(spec, "stateful", False) else ()
+                for spec in self.agg_specs),
             "overflow": jnp.int64(0),
         }
 
@@ -506,11 +759,25 @@ class AggregateOp(Operator):
         segzero_sorted = (reset_seg == 0)[perm]
 
         # --- per-aggregator running values -------------------------------
+        ctx = {"B": B, "K": self.K, "slots": slots, "agg_row": agg_row,
+               "is_add": is_add, "is_remove": is_remove,
+               "reset_seg": reset_seg, "n_resets": n_resets,
+               "perm": perm, "inv_perm": inv_perm,
+               "seg_sorted": seg_sorted, "slot_sorted": slot_sorted,
+               "segzero_sorted": segzero_sorted}
         agg_cols: list[Col] = []
         new_carries = []
-        for spec, arg, carry in zip(self.agg_specs, self.agg_args,
-                                    state["carry"]):
+        new_tables = []
+        for spec, arg, carry, tab in zip(self.agg_specs, self.agg_args,
+                                         state["carry"],
+                                         state["tables"]):
             arg_col = arg.fn(env) if arg is not None else None
+            if getattr(spec, "stateful", False):
+                runnings, ntab = spec.run(arg_col, ctx, tab)
+                agg_cols.append(spec.value(tuple(runnings)))
+                new_carries.append(carry)
+                new_tables.append(ntab)
+                continue
             contribs = spec.contribs(arg_col, is_add, is_remove)
             lane_runnings = []
             lane_carries = []
@@ -539,6 +806,7 @@ class AggregateOp(Operator):
                 lane_carries.append(newc)
             agg_cols.append(spec.value(tuple(lane_runnings)))
             new_carries.append(tuple(lane_carries))
+            new_tables.append(tab)
 
         for i, c in enumerate(agg_cols):
             env[("agg", i)] = c
@@ -614,7 +882,8 @@ class AggregateOp(Operator):
                            emit_order)
 
         new_state = {"keys": new_keys, "used": new_used,
-                     "carry": tuple(new_carries), "overflow": overflow}
+                     "carry": tuple(new_carries),
+                     "tables": tuple(new_tables), "overflow": overflow}
         return new_state, out
 
 

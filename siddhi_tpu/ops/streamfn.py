@@ -1,0 +1,153 @@
+"""Stream processors / stream functions: handlers that transform the
+event stream itself (vs windows, which manage retention).
+
+Reference mapping:
+- AbstractStreamProcessor / StreamFunctionProcessor
+  (query/processor/stream/AbstractStreamProcessor.java:51) — processors
+  may append attributes to the stream schema.
+- LogStreamProcessor (query/processor/stream/LogStreamProcessor.java) —
+  `#log([priority,] message)`: logs every event, passes it through.
+- Pol2CartStreamFunctionProcessor (query/processor/stream/function/
+  Pol2CartStreamFunctionProcessor.java) — appends cartX/cartY[/cartZ].
+
+Custom stream processors register via the extension SPI as objects with
+`make_operator(schema, compiled_params, out_stream_id) -> Operator`.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core.event import Attribute, EventBatch, StreamSchema
+from ..core.types import AttrType, np_dtype
+from .expr import CompileError, CompiledExpr, env_from_batch
+from .operators import Operator
+
+
+class AppendColumnsOp(Operator):
+    """Append computed attributes to every event (StreamFunctionProcessor
+    semantics: input attributes stay, new ones follow)."""
+
+    def __init__(self, in_schema: StreamSchema,
+                 new_cols: list):  # [(name, AttrType, CompiledExpr)]
+        self.in_schema = in_schema
+        self.new_cols = new_cols
+        self._schema = StreamSchema(
+            in_schema.stream_id,
+            in_schema.attributes + tuple(
+                Attribute(n, t) for n, t, _ in new_cols))
+
+    @property
+    def out_schema(self):
+        return self._schema
+
+    def step(self, state, batch: EventBatch, now):
+        env = env_from_batch(batch)
+        env["__now__"] = now
+        cols = list(batch.cols)
+        nulls = list(batch.nulls)
+        for name, t, ce in self.new_cols:
+            c = ce.fn(env)
+            cols.append(jnp.broadcast_to(
+                c.values.astype(np_dtype(t)), batch.valid.shape))
+            nulls.append(jnp.broadcast_to(c.nulls, batch.valid.shape))
+        return state, EventBatch(batch.ts, tuple(cols), tuple(nulls),
+                                 batch.kind, batch.valid)
+
+
+class LogOp(Operator):
+    """#log(['priority',] 'message'): log every valid event from inside
+    the jitted step via jax.debug.callback (async host print), then pass
+    the batch through unchanged."""
+
+    def __init__(self, schema: StreamSchema, priority: str, message: str):
+        self.schema = schema
+        self.priority = priority
+        self.message = message
+
+    @property
+    def out_schema(self):
+        return self.schema
+
+    def step(self, state, batch: EventBatch, now):
+        prefix = f"[{self.priority}] {self.message}"
+        types = self.schema.types
+
+        def emit(ts, valid, *cols):
+            import numpy as np
+            from ..core.types import GLOBAL_STRINGS
+            for i in np.nonzero(np.asarray(valid))[0]:
+                vals = []
+                for t, c in zip(types, cols):
+                    v = np.asarray(c)[i]
+                    vals.append(GLOBAL_STRINGS.decode(int(v))
+                                if t is AttrType.STRING else v)
+                print(f"{prefix}, StreamEvent{{ timestamp={ts[i]}, "
+                      f"data={vals} }}")
+
+        jax.debug.callback(emit, batch.ts, batch.valid, *batch.cols)
+        return state, batch
+
+
+def make_stream_function(h, schema: StreamSchema, scope, functions,
+                         extensions: dict, name: str) -> Operator:
+    """Planner dispatch for a StreamFunction handler (reference:
+    SingleInputStreamParser.java:216-243 extension loading)."""
+    from .expr import compile_expression
+    fname = (f"{h.namespace}:{h.name}" if h.namespace else h.name).lower()
+    params = h.parameters
+
+    if fname == "log":
+        consts = []
+        for p in params:
+            from ..lang import ast as A
+            if not isinstance(p, A.Constant):
+                raise CompileError(
+                    f"query '{name}': log() parameters must be constant "
+                    "strings (dynamic messages are not supported)")
+            consts.append(str(p.value))
+        priority = "INFO"
+        message = ""
+        if len(consts) == 1:
+            message = consts[0]
+        elif len(consts) >= 2:
+            priority, message = consts[0].upper(), consts[1]
+        return LogOp(schema, priority, message)
+
+    if fname == "pol2cart":
+        if len(params) not in (2, 3):
+            raise CompileError("pol2Cart() takes 2-3 parameters "
+                               "(theta, rho [, z])")
+        ces = [compile_expression(p, scope, functions) for p in params]
+        theta, rho = ces[0], ces[1]
+
+        def cart(fn_trig):
+            def run(env):
+                from .expr import Col
+                t = theta.fn(env)
+                r = rho.fn(env)
+                v = (r.values.astype(jnp.float64) *
+                     fn_trig(t.values.astype(jnp.float64)))
+                return Col(v, t.nulls | r.nulls)
+            return CompiledExpr(AttrType.DOUBLE, run)
+
+        new_cols = [("cartX", AttrType.DOUBLE, cart(jnp.cos)),
+                    ("cartY", AttrType.DOUBLE, cart(jnp.sin))]
+        if len(ces) == 3:
+            z = ces[2]
+            new_cols.append((
+                "cartZ", AttrType.DOUBLE,
+                CompiledExpr(AttrType.DOUBLE,
+                             lambda env, z=z: z.fn(env))))
+        return AppendColumnsOp(schema, new_cols)
+
+    ext = extensions.get(fname)
+    if ext is not None and hasattr(ext, "make_operator"):
+        ces = [compile_expression(p, scope, functions) for p in params]
+        return ext.make_operator(schema, ces, name)
+
+    raise CompileError(
+        f"query '{name}': stream function '{fname}' is not a built-in "
+        "and no extension is registered under that name")

@@ -177,6 +177,10 @@ class WindowOp(Operator):
 
     is_batch = False
     sort_heavy = True  # emission_sort / keep_newest lexsorts
+    # expiry order == arrival order (time/length/... windows expire the
+    # oldest content first); sliding min/max relies on this. Windows that
+    # expel by comparator or frequency set it False.
+    fifo_expiry = True
 
     def __init__(self, schema: StreamSchema, expired_enabled: bool = True):
         self.schema = schema

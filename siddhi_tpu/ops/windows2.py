@@ -407,6 +407,7 @@ class SortWindowOp(WindowOp):
     (SortWindowProcessor.java:152-183)."""
 
     kind_name = "sort"
+    fifo_expiry = False
 
     def __init__(self, schema, length: int, keys: list,
                  expired_enabled: bool = True):
@@ -535,6 +536,7 @@ class FrequentWindowOp(WindowOp):
     hash order, we decrement all tracked keys — proper Misra-Gries)."""
 
     kind_name = "frequent"
+    fifo_expiry = False
 
     def __init__(self, schema, n: int, key_idxs: list,
                  expired_enabled: bool = True):
@@ -690,6 +692,7 @@ class LossyFrequentWindowOp(WindowOp):
     silent)."""
 
     kind_name = "lossyFrequent"
+    fifo_expiry = False
     CAP = 32
 
     def __init__(self, schema, support: float, error: Optional[float],

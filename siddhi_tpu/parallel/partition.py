@@ -115,6 +115,17 @@ class PartitionQueryPort:
         self.output_handlers: list = []
         self.callback_handler = QueryCallbackHandler()
         self.batch_callbacks: list[Callable] = []
+        self.rate_limiter = None
+
+    def set_rate_limiter(self, rl) -> None:
+        rl.emit = self._emit_limited
+        rl.start(self.block.app)
+        self.rate_limiter = rl
+
+    def _emit_limited(self, timestamp: int, rows) -> None:
+        for h in self.output_handlers:
+            h.handle(timestamp, rows)
+        self.callback_handler.handle(timestamp, rows)
 
     def stats(self) -> dict:
         return {"emitted": int(jax.device_get(
@@ -418,6 +429,12 @@ class PartitionBlockRuntime:
         port = self.ports[qname]
         for cb in port.batch_callbacks:
             cb(out)
+        if port.rate_limiter is not None:
+            out_host = jax.device_get(out)
+            rows = rows_from_batch(port.out_schema.types, out_host)
+            if rows:
+                port.rate_limiter.process(timestamp, rows)
+            return
         row_handlers = [h for h in port.output_handlers
                         if not h.handle_device_batch(out, timestamp)]
         if not (row_handlers or port.callback_handler.callbacks):
@@ -455,10 +472,14 @@ class PartitionBlockRuntime:
     # -- snapshot ---------------------------------------------------------
     def snapshot_state(self) -> dict:
         with self._lock:
-            return jax.device_get({"slot_tbl": self.slot_tbl,
+            snap = jax.device_get({"slot_tbl": self.slot_tbl,
                                    "qstates": self.qstates,
                                    "emitted": self._emitted,
                                    "lost": self._lost})
+            snap["rate"] = {qn: p.rate_limiter.snapshot_state()
+                            for qn, p in self.ports.items()
+                            if p.rate_limiter is not None}
+            return snap
 
     def restore_state(self, snap: dict) -> None:
         with self._lock:
@@ -470,6 +491,10 @@ class PartitionBlockRuntime:
                           for k, v in snap["lost"].items()}
             for qn in self._sched_due:
                 self._sched_due[qn] = None
+            for qn, rsnap in snap.get("rate", {}).items():
+                port = self.ports.get(qn)
+                if port is not None and port.rate_limiter is not None:
+                    port.rate_limiter.restore_state(rsnap)
             if self.mesh is not None:
                 self._apply_mesh_sharding()
 

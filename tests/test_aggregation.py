@@ -229,12 +229,86 @@ class TestSlidingWindowAggregates:
         assert [e.data for e in got] == [
             ("A", 10), ("B", 5), ("A", 17), ("A", 107)]
 
-    def test_min_over_sliding_window_rejected(self):
+    def test_min_over_non_fifo_window_rejected(self):
+        # sliding min/max works for FIFO-expiry windows (time/length/...)
+        # but not for comparator-expelled content (sort window)
         from siddhi_tpu.ops.expr import CompileError
         mgr = SiddhiManager()
-        with pytest.raises(CompileError, match="min"):
+        with pytest.raises(CompileError, match="FIFO"):
             mgr.create_siddhi_app_runtime(PLAYBACK + """
                 define stream S (a int);
-                from S#window.time(1 sec) select min(a) as m
+                from S#window.sort(3, a) select min(a) as m
                 insert into Out;
             """)
+
+
+class TestDistinctCount:
+    def test_distinct_count_running(self):
+        got, _ = run(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S select distinctCount(sym) as d insert into Out;
+        """, "S", [Event(1000, ("a", 1)), Event(1001, ("b", 2)),
+                   Event(1002, ("a", 3)), Event(1003, ("c", 4))])
+        assert [e.data[0] for e in got] == [1, 2, 2, 3]
+
+    def test_distinct_count_with_expiry(self):
+        # length(2): when both 'a' rows leave, distinct drops
+        got, _ = run(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.length(2)
+            select distinctCount(sym) as d insert into Out;
+        """, "S", [Event(1000, ("a", 1)), Event(1001, ("a", 2)),
+                   Event(1002, ("b", 3)), Event(1003, ("c", 4))])
+        # contents: {a}, {a,a}, {a,b}, {b,c}; expired rows also emit
+        # running values but only currents are inserted
+        assert [e.data[0] for e in got] == [1, 1, 2, 2]
+
+    def test_distinct_count_group_by(self):
+        got, _ = run(PLAYBACK + """
+            define stream S (sym string, u string);
+            @info(name = 'q')
+            from S select sym, distinctCount(u) as d
+            group by sym insert into Out;
+        """, "S", [Event(1000, ("a", "x")), Event(1001, ("a", "y")),
+                   Event(1002, ("b", "x")), Event(1003, ("a", "x"))])
+        assert [(e.data[0], e.data[1]) for e in got] == [
+            ("a", 1), ("a", 2), ("b", 1), ("a", 2)]
+
+
+class TestSlidingMinMax:
+    def test_min_over_length_window(self):
+        got, _ = run(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.length(2)
+            select min(v) as m insert into Out;
+        """, "S", [Event(1000, ("a", 5)), Event(1001, ("a", 3)),
+                   Event(1002, ("a", 9)), Event(1003, ("a", 7))])
+        # windows: {5}, {5,3}, {3,9}, {9,7}
+        assert [e.data[0] for e in got] == [5, 3, 3, 7]
+
+    def test_max_over_time_window(self):
+        got, _ = run(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.time(1 sec)
+            select max(v) as m insert into Out;
+        """, "S", [Event(1000, ("a", 5)), Event(1500, ("a", 9)),
+                   Event(2600, ("a", 2))])
+        # at 2600 both 5 and 9 have expired (timer)
+        assert [e.data[0] for e in got] == [5, 9, 2]
+
+    def test_min_group_by_sliding(self):
+        got, _ = run(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S#window.length(2)
+            select sym, min(v) as m group by sym insert into Out;
+        """, "S", [Event(1000, ("a", 5)), Event(1001, ("b", 1)),
+                   Event(1002, ("a", 3)), Event(1003, ("a", 8))])
+        # global length-2 window; per-key live sets:
+        # a:{5}, b:{1}, a:{3} (5 evicted), a:{3,8} (1 evicted)
+        assert [(e.data[0], e.data[1]) for e in got] == [
+            ("a", 5), ("b", 1), ("a", 3), ("a", 3)]

@@ -1,0 +1,160 @@
+"""Stream functions, script/extension functions, fault streams, and
+Source/Sink transport (reference corpus: query/streamfunction/,
+query/extension/, transport/InMemoryTransportTestCase.java,
+stream/ fault-stream cases)."""
+import pytest
+
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+
+PLAYBACK = "@app:playback "
+
+
+def build(ql, mgr=None, out=None):
+    mgr = mgr or SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    if out:
+        rt.add_callback(out, StreamCallback(fn=lambda e: got.extend(e)))
+    rt.start()
+    return rt, got
+
+
+class TestStreamFunctions:
+    def test_pol2cart(self):
+        rt, got = build(PLAYBACK + """
+            define stream S (theta double, rho double);
+            @info(name = 'q')
+            from S#pol2Cart(theta, rho)
+            select theta, rho, cartX, cartY insert into Out;
+        """, out="Out")
+        rt.get_input_handler("S").send(Event(1000, (0.0, 2.0)))
+        rt.shutdown()
+        (e,) = got
+        assert round(e.data[2], 6) == 2.0 and round(e.data[3], 6) == 0.0
+
+    def test_log_passthrough(self, capsys):
+        rt, got = build(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q')
+            from S#log('checkpoint') select v insert into Out;
+        """, out="Out")
+        rt.get_input_handler("S").send(Event(1000, (7,)))
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [7]
+
+    def test_pol2cart_then_filter(self):
+        # appended attributes usable downstream in the same chain
+        rt, got = build(PLAYBACK + """
+            define stream S (theta double, rho double);
+            @info(name = 'q')
+            from S#pol2Cart(theta, rho)[cartX > 1.0]
+            select cartX insert into Out;
+        """, out="Out")
+        h = rt.get_input_handler("S")
+        h.send(Event(1000, (0.0, 2.0)))    # cartX=2 passes
+        h.send(Event(1001, (0.0, 0.5)))    # cartX=0.5 dropped
+        rt.shutdown()
+        assert len(got) == 1
+
+
+class TestScriptAndExtensionFunctions:
+    def test_define_function_python(self):
+        rt, got = build(PLAYBACK + """
+            define stream S (a int, b int);
+            define function addmul[python] return long { arg0 * arg1 + arg0 };
+            @info(name = 'q')
+            from S select addmul(a, b) as r insert into Out;
+        """, out="Out")
+        rt.get_input_handler("S").send(Event(1000, (3, 4)))
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [15]
+
+    def test_scalar_function_extension(self):
+        import jax.numpy as jnp
+        from siddhi_tpu.core.extension import ScalarFunction
+        from siddhi_tpu.core.types import AttrType
+        mgr = SiddhiManager()
+        mgr.set_extension("custom:plusone", ScalarFunction(
+            return_type=AttrType.INT, fn=lambda v: v + 1,
+            min_args=1, max_args=1))
+        rt, got = build(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q')
+            from S select custom:plusOne(v) as r insert into Out;
+        """, mgr=mgr, out="Out")
+        rt.get_input_handler("S").send(Event(1000, (41,)))
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [42]
+
+
+class TestFaultStreams:
+    def test_on_error_stream_routes_faults(self):
+        ql = PLAYBACK + """
+            @OnError(action='STREAM')
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Mid;
+            @info(name = 'f') from !S select v, _error insert into FOut;
+        """
+        rt, got = build(ql, out="FOut")
+        # a receiver that blows up on delivery
+        class Boom:
+            def receive(self, events):
+                raise RuntimeError("boom")
+        rt.junctions["S"].subscribe(Boom())
+        rt.get_input_handler("S").send(Event(1000, (5,)))
+        rt.shutdown()
+        assert len(got) == 1
+        assert got[0].data[0] == 5 and "boom" in got[0].data[1]
+
+
+class TestInMemoryTransport:
+    def test_source_and_sink_roundtrip(self):
+        from siddhi_tpu.core.io import InMemoryBroker
+        ql = PLAYBACK + """
+            @source(type='inMemory', topic='in.t')
+            define stream S (sym string, v int);
+            @sink(type='inMemory', topic='out.t')
+            define stream Out (sym string, v int);
+            @info(name = 'q') from S[v > 1] select sym, v
+            insert into Out;
+        """
+        got = []
+        InMemoryBroker.subscribe("out.t", got.append)
+        rt, _ = build(ql)
+        InMemoryBroker.publish("in.t", ("a", 5))
+        InMemoryBroker.publish("in.t", ("b", 0))   # filtered
+        rt.shutdown()
+        assert len(got) == 1 and tuple(got[0].data) == ("a", 5)
+
+    def test_failing_source_retries(self):
+        from siddhi_tpu.core import io as sio
+        calls = {"n": 0}
+
+        class Flaky(sio.InMemorySource):
+            def connect(self):
+                calls["n"] += 1
+                if calls["n"] < 3:
+                    raise sio.ConnectionUnavailableException("down")
+                super().connect()
+
+        mgr = SiddhiManager()
+        mgr.set_extension("source:flaky", Flaky)
+        rt, _ = build(PLAYBACK + """
+            @source(type='flaky', topic='f.t')
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """, mgr=mgr)
+        assert calls["n"] == 3 and rt.sources[0].connected
+        rt.shutdown()
+
+    def test_json_mapper(self):
+        from siddhi_tpu.core.io import InMemoryBroker
+        ql = PLAYBACK + """
+            @source(type='inMemory', topic='j.t', map='json')
+            define stream S (sym string, v int);
+            @info(name = 'q') from S select sym, v insert into Out;
+        """
+        rt, got = build(ql, out="Out")
+        InMemoryBroker.publish("j.t", '{"sym": "a", "v": 3}')
+        rt.shutdown()
+        assert [tuple(e.data) for e in got] == [("a", 3)]

@@ -1,0 +1,140 @@
+"""Output rate limiter tests (reference corpus: query/ratelimit/
+EventOutputRateLimitTestCase.java, TimeOutputRateLimitTestCase.java,
+SnapshotOutputRateLimitTestCase.java). Playback mode throughout."""
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+
+PLAYBACK = "@app:playback "
+
+
+def run_app(ql, sends, out="Out"):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(out, StreamCallback(fn=lambda e: got.extend(e)))
+    rt.start()
+    for sid, ts, data in sends:
+        rt.get_input_handler(sid).send(Event(ts, tuple(data)))
+    rt.shutdown()
+    return got
+
+
+SENDS = [("S", 1000 + i * 100, ("a" if i % 2 == 0 else "b", i))
+         for i in range(6)]  # v = 0..5
+
+
+class TestEventRateLimit:
+    def test_first_every_n_events(self):
+        got = run_app(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S select sym, v
+            output first every 3 events
+            insert into Out;
+        """, SENDS)
+        assert [e.data[1] for e in got] == [0, 3]
+
+    def test_last_every_n_events(self):
+        got = run_app(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S select sym, v
+            output last every 3 events
+            insert into Out;
+        """, SENDS)
+        assert [e.data[1] for e in got] == [2, 5]
+
+    def test_all_every_n_events(self):
+        got = run_app(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S select sym, v
+            output all every 3 events
+            insert into Out;
+        """, SENDS)
+        # batched flushes of 3
+        assert [e.data[1] for e in got] == [0, 1, 2, 3, 4, 5]
+
+    def test_first_group_by(self):
+        got = run_app(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S select sym, v
+            group by sym
+            output first every 3 events
+            insert into Out;
+        """, SENDS)
+        # per key: a sees v=0,2,4 -> first of each 3-window = 0
+        #          b sees v=1,3,5 -> 1
+        assert sorted(e.data[1] for e in got) == [0, 1]
+
+
+class TestTimeRateLimit:
+    def test_first_every_time(self):
+        got = run_app(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S select sym, v
+            output first every 1 sec
+            insert into Out;
+        """, [("S", 1000, ("a", 1)),
+              ("S", 1100, ("a", 2)),     # within 1s of first -> dropped
+              ("S", 2500, ("a", 3))])    # new interval
+        assert [e.data[1] for e in got] == [1, 3]
+
+    def test_last_every_time(self):
+        got = run_app(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S select sym, v
+            output last every 1 sec
+            insert into Out;
+        """, [("S", 1000, ("a", 1)),
+              ("S", 1100, ("a", 2)),
+              ("S", 2500, ("a", 3))])    # timer at 2000 emitted last=2
+        assert [e.data[1] for e in got][:1] == [2]
+
+    def test_all_every_time(self):
+        got = run_app(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S select sym, v
+            output all every 1 sec
+            insert into Out;
+        """, [("S", 1000, ("a", 1)),
+              ("S", 1100, ("a", 2)),
+              ("S", 2500, ("a", 3))])
+        assert [e.data[1] for e in got][:2] == [1, 2]
+
+
+class TestSnapshotRateLimit:
+    def test_snapshot_reemits_latest(self):
+        got = run_app(PLAYBACK + """
+            define stream S (sym string, v int);
+            @info(name = 'q')
+            from S select sym, sum(v) as t
+            group by sym
+            output snapshot every 1 sec
+            insert into Out;
+        """, [("S", 1000, ("a", 1)),
+              ("S", 1100, ("a", 2)),
+              ("S", 2500, ("b", 7))])
+        # timer at 2000 emits a's latest sum (3); later ticks include b
+        assert got[0].data == ("a", 3)
+
+
+class TestPartitionRateLimit:
+    def test_last_per_event_inside_partition(self):
+        got = run_app(PLAYBACK + """
+            define stream S (sym string, v int);
+            partition with (sym of S)
+            begin
+              @info(name = 'q')
+              from S select sym, sum(v) as t
+              output last every 2 events
+              insert into Out;
+            end;
+        """, [("S", 1000, ("a", 1)),
+              ("S", 1001, ("a", 2)),   # a: sums 1,3 -> last of 2 = 3
+              ("S", 1002, ("b", 5)),
+              ("S", 1003, ("b", 6))])  # b: sums 5,11 -> 11
+        assert [e.data[1] for e in got] == [3, 11]

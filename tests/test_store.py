@@ -1,0 +1,147 @@
+"""Named windows, triggers, and on-demand (store) queries
+(reference corpus: window/ named-window cases, query/trigger/,
+query/table/store/). Playback mode throughout."""
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+
+PLAYBACK = "@app:playback "
+
+
+def build(ql, out=None):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    if out:
+        rt.add_callback(out, StreamCallback(fn=lambda e: got.extend(e)))
+    rt.start()
+    return rt, got
+
+
+class TestNamedWindows:
+    QL = PLAYBACK + """
+        define stream S (sym string, v int);
+        define window W (sym string, v int) length(2) output all events;
+        @info(name = 'feed') from S select sym, v insert into W;
+        @info(name = 'consume') from W select sym, sum(v) as t
+        insert all events into Out;
+    """
+
+    def test_shared_window_feeds_consumer(self):
+        rt, got = build(self.QL, out="Out")
+        h = rt.get_input_handler("S")
+        for i, v in enumerate([1, 2, 4]):
+            h.send(Event(1000 + i, ("a", v)))
+        rt.shutdown()
+        # length(2): third insert evicts v=1 -> the expired event
+        # subtracts (sum 2, emitted as a remove event) then v=4 adds
+        assert [e.data[1] for e in got] == [1, 3, 2, 6]
+
+    def test_two_feeders_share_instance(self):
+        ql = PLAYBACK + """
+            define stream A (sym string, v int);
+            define stream B (sym string, v int);
+            define window W (sym string, v int) length(2) output all events;
+            @info(name = 'fa') from A select sym, v insert into W;
+            @info(name = 'fb') from B select sym, v insert into W;
+            @info(name = 'c') from W select sym, v
+            insert all events into Out;
+        """
+        rt, got = build(ql, out="Out")
+        rt.get_input_handler("A").send(Event(1000, ("a", 1)))
+        rt.get_input_handler("B").send(Event(1001, ("b", 2)))
+        rt.get_input_handler("A").send(Event(1002, ("a", 3)))  # evicts 1
+        rt.shutdown()
+        assert [e.data[1] for e in got] == [1, 2, 1, 3]
+
+
+class TestTriggers:
+    def test_periodic_trigger_playback(self):
+        ql = PLAYBACK + """
+            define stream S (v int);
+            define trigger T at every 1 sec;
+            @info(name = 'q') from T select triggered_time insert into Out;
+        """
+        rt, got = build(ql, out="Out")
+        h = rt.get_input_handler("S")
+        h.send(Event(1000, (1,)))   # arms at 999 -> fires 1999, 2999...
+        h.send(Event(3500, (2,)))
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [1999, 2999]
+
+    def test_start_trigger(self):
+        ql = PLAYBACK + """
+            define stream S (v int);
+            define trigger T at 'start';
+            @info(name = 'q') from T select triggered_time insert into Out;
+        """
+        rt, got = build(ql, out="Out")
+        rt.get_input_handler("S").send(Event(1000, (1,)))
+        rt.shutdown()
+        assert len(got) == 1 and got[0].data[0] == 999
+
+
+class TestOnDemandQueries:
+    QL = PLAYBACK + """
+        define stream S (sym string, price float, volume long);
+        define table T (sym string, price float, volume long);
+        @info(name = 'load') from S select sym, price, volume
+        insert into T;
+    """
+
+    def _loaded(self):
+        rt, _ = build(self.QL)
+        h = rt.get_input_handler("S")
+        rows = [("IBM", 75.6, 100), ("WSO2", 57.6, 200),
+                ("IBM", 77.0, 300)]
+        for i, r in enumerate(rows):
+            h.send(Event(1000 + i, r))
+        return rt
+
+    def test_select_with_condition(self):
+        rt = self._loaded()
+        rows = rt.query("from T on price > 60.0 select sym, volume")
+        assert sorted(rows) == [("IBM", 100), ("IBM", 300)]
+        rt.shutdown()
+
+    def test_select_aggregation_group_by(self):
+        rt = self._loaded()
+        rows = rt.query(
+            "from T select sym, sum(volume) as tv group by sym")
+        assert sorted(rows) == [("IBM", 400), ("WSO2", 200)]
+        rt.shutdown()
+
+    def test_select_order_limit(self):
+        rt = self._loaded()
+        rows = rt.query(
+            "from T select sym, price order by price desc limit 2")
+        assert [(s, round(p, 3)) for s, p in rows] == [
+            ("IBM", 77.0), ("IBM", 75.6)]
+        rt.shutdown()
+
+    def test_delete(self):
+        rt = self._loaded()
+        n = rt.query("delete T on T.sym == 'IBM'")
+        assert n == 2
+        assert rt.query("from T select sym") == [("WSO2",)]
+        rt.shutdown()
+
+    def test_update(self):
+        rt = self._loaded()
+        n = rt.query("update T set T.volume = 999 on T.sym == 'WSO2'")
+        assert n == 1
+        rows = rt.query("from T on sym == 'WSO2' select volume")
+        assert rows == [(999,)]
+        rt.shutdown()
+
+    def test_select_from_named_window(self):
+        ql = PLAYBACK + """
+            define stream S (sym string, v int);
+            define window W (sym string, v int) length(2);
+            @info(name = 'f') from S select sym, v insert into W;
+        """
+        rt, _ = build(ql)
+        h = rt.get_input_handler("S")
+        for i, v in enumerate([1, 2, 3]):
+            h.send(Event(1000 + i, ("a", v)))
+        rows = rt.query("from W select v")
+        assert sorted(rows) == [(2,), (3,)]
+        rt.shutdown()
