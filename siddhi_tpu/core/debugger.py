@@ -1,0 +1,76 @@
+"""Step debugger: per-query IN/OUT breakpoints with pause / next / play.
+
+Reference mapping:
+- debugger/SiddhiDebugger.java — acquireBreakPoint(query, IN|OUT),
+  next()/play(), semaphore pause, getQueryState; hooked at
+  ProcessStreamReceiver.java:100-103 and the output callbacks
+  (SiddhiAppRuntimeImpl.debug():657).
+
+Here the hooks sit at the host boundary of the jitted step: IN fires
+with the decoded input events before the device step of the named query,
+OUT with the decoded output rows after it. `next()` releases one
+breakpoint hit, `play()` releases the current hit and disables pausing
+until another breakpoint is acquired. The callback runs on the ingest
+thread (sync junctions), so inspection sees a quiesced pipeline —
+the same contract as the reference's semaphore pause."""
+from __future__ import annotations
+
+import threading
+from enum import Enum
+from typing import Callable, Optional
+
+
+class QueryTerminal(Enum):
+    IN = "IN"
+    OUT = "OUT"
+
+
+class SiddhiDebugger:
+    def __init__(self, app):
+        self.app = app
+        self._breakpoints: set = set()      # (query_name, terminal)
+        self._gate = threading.Semaphore(0)
+        self._paused = threading.Event()
+        self._playing = False
+        self.callback: Optional[Callable] = None
+
+    # -- public API (SiddhiDebugger surface) ------------------------------
+    def acquire_break_point(self, query_name: str,
+                            terminal: QueryTerminal) -> None:
+        self._breakpoints.add((query_name, terminal))
+        self._playing = False
+
+    def release_break_point(self, query_name: str,
+                            terminal: QueryTerminal) -> None:
+        self._breakpoints.discard((query_name, terminal))
+
+    def release_all_break_points(self) -> None:
+        self._breakpoints.clear()
+
+    def next(self) -> None:
+        """Release the current pause; the following hit pauses again."""
+        self._gate.release()
+
+    def play(self) -> None:
+        """Release the current pause and stop pausing entirely."""
+        self._playing = True
+        self._gate.release()
+
+    def get_query_state(self, query_name: str) -> dict:
+        q = self.app.queries.get(query_name)
+        if q is None or not hasattr(q, "snapshot_state"):
+            return {}
+        return q.snapshot_state()
+
+    # -- runtime hook -----------------------------------------------------
+    def check_break_point(self, query_name: str, terminal: QueryTerminal,
+                          events) -> None:
+        if (query_name, terminal) not in self._breakpoints:
+            return
+        if self.callback is not None:
+            self.callback(query_name, terminal, events)
+        if self._playing:
+            return
+        self._paused.set()
+        self._gate.acquire()
+        self._paused.clear()

@@ -297,18 +297,20 @@ class PackedChunk:
     """One device-resident packed chunk, shared by every subscriber of a
     junction (transferred once)."""
 
-    __slots__ = ("buf", "enc", "capacity", "n", "last_ts")
+    __slots__ = ("buf", "enc", "capacity", "n", "last_ts", "ts_min")
 
     def __init__(self, buf, enc: tuple, capacity: int, n: int,
-                 last_ts: int):
+                 last_ts: int, ts_min=None):
         self.buf = buf              # ONE device uint8 array
         self.enc = enc              # static encoding tuple (jit cache key)
         self.capacity = capacity
         self.n = n
         self.last_ts = last_ts
+        self.ts_min = ts_min        # host-known earliest ts (timer bounds)
 
     @classmethod
     def build(cls, encoder: PackedEncoder, ts, cols, capacity: int,
               now: int):
         buf, enc, n = encoder.encode(ts, cols, capacity, now)
-        return cls(jax.device_put(buf), enc, capacity, n, int(ts[-1]))
+        return cls(jax.device_put(buf), enc, capacity, n, int(ts[-1]),
+                   ts_min=int(ts.min()) if len(ts) else None)

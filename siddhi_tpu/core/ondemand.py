@@ -102,8 +102,28 @@ class OnDemandExecutor:
         if w is not None:
             op = w.operators[0]
             return None, w.in_schema, op.findable_buffer(w.states[0])
+        a = app.aggregations.get(tid)
+        if a is not None:
+            if q.per is None:
+                raise CompileError(
+                    "querying an aggregation needs `per '<duration>'`")
+            per = q.per.value if isinstance(q.per, A.Constant) else None
+            if per is None:
+                raise CompileError("per must be a constant duration")
+            start = end = None
+            if q.within is not None:
+                s, e = q.within
+                if not isinstance(s, A.Constant) or \
+                        (e is not None and not isinstance(e, A.Constant)):
+                    raise CompileError(
+                        "within bounds must be constant epoch-ms longs")
+                start = int(s.value)
+                end = int(e.value) if e is not None else None
+            schema, buf = a.materialize(str(per), start, end)
+            return None, schema, buf
         raise CompileError(
-            f"on-demand query: '{tid}' is not a defined table or window")
+            f"on-demand query: '{tid}' is not a defined table, window, "
+            "or aggregation")
 
     def execute(self, q: A.OnDemandQuery):
         if isinstance(q, str):

@@ -227,6 +227,19 @@ class QueryCallbackHandler(OutputHandler):
             cb.receive(timestamp, in_events or None, rm_events or None)
 
 
+def _timer_windows(operators) -> list:
+    """Window ops that schedule timers (one init_state probe each)."""
+    return [op for op in operators
+            if isinstance(op, WindowOp) and
+            op.next_due(op.init_state()) is not None]
+
+
+def _all_host_due(timer_ops) -> bool:
+    return bool(timer_ops) and all(
+        getattr(op, "host_due_bound", None) is not None
+        for op in timer_ops)
+
+
 class QueryRuntime(Receiver):
     """One query: an operator chain jitted into a single device step."""
 
@@ -258,15 +271,18 @@ class QueryRuntime(Receiver):
         # packed step (zero host syncs); read once via stats()
         self._emitted_dev = jnp.int64(0)
         self._lock = threading.Lock()
-        self._has_timers = any(
-            isinstance(op, WindowOp) and op.next_due(op.init_state())
-            is not None for op in operators)
+        # when EVERY timer window offers a host due bound, stream steps
+        # schedule timers host-side with zero device readbacks
+        self._timer_ops = _timer_windows(operators)
+        self._has_timers = bool(self._timer_ops)
+        self._host_due_all = _all_host_due(self._timer_ops)
         # host-computed schedules (cron windows: the next fire time cannot
         # come from device state)
         self._host_sched = [op.host_schedule for op in operators
                             if getattr(op, "host_schedule", None)]
         self._sched_due: Optional[int] = None
         self.rate_limiter = None
+        self._qstats = None  # lazily created when statistics enabled
 
     # -- compile ---------------------------------------------------------
     def _make_step(self):
@@ -340,6 +356,7 @@ class QueryRuntime(Receiver):
         return fn
 
     def process_packed(self, chunk: PackedChunk) -> None:
+        lat = self._stats_mark(chunk.n)
         with self._lock:
             step = self._packed_step_for(chunk.enc, chunk.capacity)
             with self._table_locks():
@@ -350,6 +367,14 @@ class QueryRuntime(Receiver):
                              chunk.buf)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
+        if lat is not None:
+            jax.block_until_ready(out.valid)
+            lat.mark_out()
+        if self._host_due_all and chunk.ts_min is not None:
+            self._dispatch_output(out, chunk.last_ts)
+            self._schedule(min(op.host_due_bound(chunk.ts_min)
+                               for op in self._timer_ops))
+            return
         self._dispatch_output(out, chunk.last_ts,
                               due=due if self._has_timers else None)
 
@@ -411,7 +436,39 @@ class QueryRuntime(Receiver):
             yield (batch_from_rows(schema, rows, tss, cap, kinds),
                    chunk[-1].timestamp)
 
+    def _qs(self):
+        from .stats import QueryStats
+        if self._qstats is None:
+            self._qstats = QueryStats()
+        return self._qstats
+
+    def _stats_mark(self, n: int):
+        """Ingest-boundary throughput (real event count) + DETAIL
+        latency handle."""
+        if self.app.stats_level <= 0:
+            return None
+        qs = self._qs()
+        qs.throughput.mark(n)
+        if self.app.stats_level >= 2:
+            qs.latency.mark_in()
+            return qs.latency
+        return None
+
+    def _stats_lat(self):
+        """DETAIL latency only (timer/internal batches: not traffic)."""
+        if self.app.stats_level < 2:
+            return None
+        lat = self._qs().latency
+        lat.mark_in()
+        return lat
+
     def receive(self, events: list[Event]) -> None:
+        dbg = self.app.debugger
+        if dbg is not None:
+            from .debugger import QueryTerminal
+            dbg.check_break_point(self.name, QueryTerminal.IN, events)
+        if self.app.stats_level > 0:
+            self._qs().throughput.mark(len(events))
         for batch, last_ts in self.encode_chunks(self.in_schema, events,
                                                  self.max_step_capacity):
             self.process_batch(batch, last_ts)
@@ -426,14 +483,17 @@ class QueryRuntime(Receiver):
             yield jax.tree_util.tree_map(lambda x: x[off:off + cap], batch)
 
     def process_batch(self, batch: EventBatch, timestamp: int,
-                      now: Optional[int] = None) -> None:
+                      now: Optional[int] = None,
+                      skip_due: bool = False) -> None:
         cap = self.max_step_capacity
         if cap is not None and batch.capacity > cap:
             for sub in self.split_batch(batch, cap):
-                self.process_batch(sub, timestamp, now=now)
+                self.process_batch(sub, timestamp, now=now,
+                                   skip_due=skip_due)
             return
         if now is None:
             now = self.app.current_time()
+        lat = self._stats_lat()
         now_dev = jnp.asarray(now, dtype=jnp.int64)
         with self._lock:
             step = self._step_for(batch.capacity)
@@ -444,8 +504,12 @@ class QueryRuntime(Receiver):
                     self.states, tstates, self._emitted_dev, batch, now_dev)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
-        self._dispatch_output(out, timestamp,
-                              due=due if self._has_timers else None)
+        if lat is not None:
+            jax.block_until_ready(out.valid)
+            lat.mark_out()
+        self._dispatch_output(
+            out, timestamp,
+            due=due if (self._has_timers and not skip_due) else None)
 
     def _table_locks(self):
         import contextlib
@@ -473,6 +537,16 @@ class QueryRuntime(Receiver):
         handler/callback delivery."""
         for cb in self.batch_callbacks:
             cb(out)
+        dbg = self.app.debugger
+        if dbg is not None:
+            from .debugger import QueryTerminal
+            if (self.name, QueryTerminal.OUT) in dbg._breakpoints:
+                rows = rows_from_batch(self.out_schema.types,
+                                       jax.device_get(out))
+                dbg.check_break_point(
+                    self.name, QueryTerminal.OUT,
+                    [Event(ts, vals, is_expired=(k == EXPIRED))
+                     for ts, k, vals in rows])
         if self.rate_limiter is not None:
             if due is not None:
                 out_host, due_host = jax.device_get((out, due))
@@ -493,7 +567,11 @@ class QueryRuntime(Receiver):
             out_host = jax.device_get(out)
         else:
             if due is not None:
-                self._schedule(int(jax.device_get(due)))
+                # NO sync here: device->host readback over the TPU tunnel
+                # costs a full RTT (~70ms measured); start an async copy
+                # and resolve the int right before the next clock advance
+                # (app._resolve_dues) — by then the copy has landed
+                self.app.defer_due(self, due)
             return
         out_rows = rows_from_batch(self.out_schema.types, out_host)
         if not out_rows:
@@ -526,7 +604,16 @@ class QueryRuntime(Receiver):
         # and the reference's playback clock has already advanced when a
         # timer fires — one fire drains every pending expiry (per-due rows
         # would re-arm a timer per expiry instant and cascade)
-        self.process_batch(_timer_batch(self.in_schema, now), due, now=now)
+        if self._host_due_all and self.app._playback:
+            # host-bounded timers: skip the device due readback entirely
+            # and re-arm at now+1 — at most one (cheap, 16-row) timer
+            # step per clock advance, zero tunnel round-trips
+            self.process_batch(_timer_batch(self.in_schema, now), due,
+                               now=now, skip_due=True)
+            self._schedule(now + 1)
+        else:
+            self.process_batch(_timer_batch(self.in_schema, now), due,
+                               now=now)
         if self._host_sched:
             self.arm_host_timers(due)
 
@@ -771,10 +858,10 @@ class JoinQueryRuntime(QueryRuntime):
         self.table_deps = sorted(set(self.table_deps) | {
             t.table_id for t in self.side_tables.values()})
         self._side_steps: dict = {}
-        self._has_timers = any(
-            isinstance(op, WindowOp) and
-            op.next_due(op.init_state()) is not None
-            for ops in self.side_ops.values() for op in ops)
+        self._join_timer_ops = _timer_windows(
+            [op for ops in self.side_ops.values() for op in ops])
+        self._has_timers = bool(self._join_timer_ops)
+        self._join_host_due = _all_host_due(self._join_timer_ops)
         self._overflow_dev = jnp.int64(0)
         if any(getattr(op, "sort_heavy", False)
                for ops in self.side_ops.values() for op in ops):
@@ -920,6 +1007,11 @@ class JoinQueryRuntime(QueryRuntime):
             self.side_states[side] = my
             self.states = sel
             self._overflow_dev = self._overflow_dev + lost
+        if self._join_host_due and chunk.ts_min is not None:
+            self._dispatch_output(out, chunk.last_ts)
+            self._schedule(min(op.host_due_bound(chunk.ts_min)
+                               for op in self._join_timer_ops))
+            return
         self._dispatch_output(out, chunk.last_ts,
                               due=due if self._has_timers else None)
 
@@ -930,11 +1022,13 @@ class JoinQueryRuntime(QueryRuntime):
             self.process_side_batch(side, batch, last_ts)
 
     def process_side_batch(self, side: str, batch: EventBatch,
-                           timestamp: int, now: Optional[int] = None) -> None:
+                           timestamp: int, now: Optional[int] = None,
+                           skip_due: bool = False) -> None:
         cap = self.max_step_capacity
         if cap is not None and batch.capacity > cap:
             for sub in self.split_batch(batch, cap):
-                self.process_side_batch(side, sub, timestamp, now=now)
+                self.process_side_batch(side, sub, timestamp, now=now,
+                                        skip_due=skip_due)
             return
         if now is None:
             now = self.app.current_time()
@@ -956,19 +1050,24 @@ class JoinQueryRuntime(QueryRuntime):
             # join pairs beyond join_cap are dropped by JoinCross.cross —
             # counted here, never silent (join.py design contract)
             self._overflow_dev = self._overflow_dev + lost
-        self._dispatch_output(out, timestamp,
-                              due=due if self._has_timers else None)
+        self._dispatch_output(
+            out, timestamp,
+            due=due if (self._has_timers and not skip_due) else None)
 
     def _on_timer(self, due: int) -> None:
         self._sched_due = None
         if not self.app.running:
             return
         now = max(due, self.app.current_time())
+        skip = self._join_host_due and self.app._playback
         for side in ("L", "R"):
             # TIMER rows carry the advanced clock (see QueryRuntime
             # ._on_timer): one fire drains all pending window expiries
             batch = _timer_batch(self.in_schemas[side], now)
-            self.process_side_batch(side, batch, due, now=now)
+            self.process_side_batch(side, batch, due, now=now,
+                                    skip_due=skip)
+        if skip:
+            self._schedule(now + 1)
 
 
 def _timer_batch(schema: StreamSchema, due: int) -> EventBatch:
@@ -1004,6 +1103,7 @@ class SiddhiAppRuntime:
         self.triggers: dict[str, TriggerRuntime] = {}
         self.sources: list = []
         self.sinks: list = []
+        self.aggregations: dict = {}  # id -> AggregationRuntime
         self.partitions: dict = {}  # name -> PartitionBlockRuntime
         # jax.sharding.Mesh: when set, partition blocks shard their key-slot
         # axis over the mesh's first axis (see parallel/partition.py)
@@ -1013,10 +1113,15 @@ class SiddhiAppRuntime:
         self._playback_time: Optional[int] = None
         self._local_store = None  # fallback store when manager is None
         self._cron_armed = False
+        self._due_pending: list = []
+        self._due_lock = threading.Lock()
+        self.stats_level = 0      # OFF; see core/stats.py
+        self.debugger = None
         # app-wide quiesce barrier (= ThreadBarrier): ingest and wall-clock
         # timer dispatch hold it; snapshot/restore take it exclusively
         self.barrier = threading.RLock()
         self.scheduler = Scheduler(playback=False, barrier=self.barrier)
+        self.scheduler.resolve_hook = self._resolve_dues
         Planner(self).plan()
         self.scheduler.playback = self._playback
 
@@ -1030,10 +1135,29 @@ class SiddhiAppRuntime:
         if events:
             self.on_ingest_ts(events[-1].timestamp, events[0].timestamp)
 
+    def defer_due(self, q, due_arr) -> None:
+        """Queue a device-resident timer due for async host resolution
+        (avoids one tunnel round-trip per step)."""
+        try:
+            due_arr.copy_to_host_async()
+        except Exception:  # noqa: BLE001 — platform-dependent API
+            pass
+        with self._due_lock:
+            self._due_pending.append((q, due_arr))
+
+    def _resolve_dues(self) -> None:
+        if not self._due_pending:
+            return
+        with self._due_lock:
+            pending, self._due_pending = self._due_pending, []
+        for q, arr in pending:
+            q._schedule(int(jax.device_get(arr)))
+
     def on_ingest_ts(self, last_ts: int,
                      first_ts: Optional[int] = None) -> None:
         """Advance the playback clock (and due timers) to an ingested
         timestamp — shared by the row and columnar ingest paths."""
+        self._resolve_dues()
         if self._playback:
             if not self._cron_armed:
                 # playback cron schedules anchor at the first event time
@@ -1097,6 +1221,40 @@ class SiddhiAppRuntime:
                 raise KeyError(f"no stream '{target}' to subscribe to")
             j.subscribe(StreamCallbackReceiver(callback))
 
+    def set_statistics_level(self, level) -> None:
+        """OFF/BASIC/DETAIL at runtime
+        (SiddhiAppRuntimeImpl.setStatisticsLevel:859)."""
+        from .stats import parse_level
+        self.stats_level = parse_level(level) \
+            if isinstance(level, str) else int(level)
+
+    def statistics(self) -> dict:
+        """Per-query throughput/latency/memory/overflow report
+        (util/statistics trackers)."""
+        from .stats import pytree_nbytes
+        report = {}
+        for n, q in self.queries.items():
+            entry = dict(q.stats()) if hasattr(q, "stats") else {}
+            qs = getattr(q, "_qstats", None)
+            if qs is not None:
+                eps = qs.throughput.events_per_sec()
+                if eps is not None:
+                    entry["throughput_eps"] = round(eps, 1)
+                lat = qs.latency.summary()
+                if lat is not None:
+                    entry["latency"] = lat
+            if hasattr(q, "states"):
+                entry["state_bytes"] = pytree_nbytes(
+                    jax.device_get(q.states))
+            report[n] = entry
+        return report
+
+    def debug(self):
+        """Attach a step debugger (SiddhiAppRuntimeImpl.debug():657)."""
+        from .debugger import SiddhiDebugger
+        self.debugger = SiddhiDebugger(self)
+        return self.debugger
+
     def start(self) -> None:
         self.running = True
         self.scheduler.start()
@@ -1156,6 +1314,8 @@ class SiddhiAppRuntime:
                        for tid, t in self.tables.items()},
             "partitions": {n: b.snapshot_state()
                            for n, b in self.partitions.items()},
+            "aggregations": {n: a.snapshot_state()
+                             for n, a in self.aggregations.items()},
             "strings": dump_strings(),
         }
         return serialize(payload)
@@ -1184,6 +1344,9 @@ class SiddhiAppRuntime:
         for n, snap in payload["partitions"].items():
             if n in self.partitions:
                 self.partitions[n].restore_state(snap)
+        for n, snap in payload.get("aggregations", {}).items():
+            if n in self.aggregations:
+                self.aggregations[n].restore_state(snap)
         for q in self.queries.values():
             if hasattr(q, "reschedule"):
                 q.reschedule()
@@ -1233,6 +1396,7 @@ class SiddhiAppRuntime:
     clearAllRevisions = clear_all_revisions
 
     def shutdown(self) -> None:
+        self._resolve_dues()
         self.running = False
         for s in self.sources:
             s.disconnect()
@@ -1312,12 +1476,29 @@ class Planner:
             wq.output_handlers.append(
                 WindowPublishHandler(out_j, wd.output_event_type))
             app.named_windows[wid] = wq
+        # 1c2. incremental aggregations (AggregationParser.java:93)
+        from .aggregation import AggregationRuntime
+        for aid, ad in ast.aggregation_definitions.items():
+            sid = ad.input.stream_id
+            schema = app.schemas.get(sid)
+            if schema is None:
+                raise CompileError(
+                    f"aggregation '{aid}': undefined stream '{sid}'")
+            ar = AggregationRuntime(app, ad, schema)
+            app.junctions[sid].subscribe(ar)
+            app.aggregations[aid] = ar
         # 1d. triggers: scheduled event publishers into stream <tid>
         for tid, td in ast.trigger_definitions.items():
             schema = StreamSchema(tid, (
                 Attribute("triggered_time", AttrType.LONG),))
             tj = app.junction_for(tid, schema)
             app.triggers[tid] = TriggerRuntime(app, td, tj)
+        # @app:statistics level (SiddhiAppParser.java:116-141)
+        sa = A.find_annotation(ast.annotations, "statistics")
+        if sa is not None:
+            from .stats import parse_level
+            lvl = sa.element() or sa.element("level") or "BASIC"
+            app.stats_level = parse_level(lvl)
         # playback mode
         pb = A.find_annotation(ast.annotations, "playback")
         if pb is not None:

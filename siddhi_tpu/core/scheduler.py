@@ -37,6 +37,8 @@ class Scheduler:
         self._cv = threading.Condition()
         self._thread: Optional[threading.Thread] = None
         self._running = False
+        # called each worker tick to pull in async-deferred dues
+        self.resolve_hook = None
 
     # -- lifecycle -------------------------------------------------------
     def start(self) -> None:
@@ -75,6 +77,11 @@ class Scheduler:
     # -- wall-clock worker ----------------------------------------------
     def _run(self) -> None:
         while True:
+            if self.resolve_hook is not None:
+                try:
+                    self.resolve_hook()
+                except Exception:  # noqa: BLE001
+                    pass
             with self._cv:
                 if not self._running:
                     return

@@ -1,0 +1,92 @@
+"""REST microservice: deploy / undeploy SiddhiQL apps over HTTP.
+
+Reference mapping: modules/siddhi-service/ —
+- POST /siddhi/artifact/deploy            (body: SiddhiQL text)
+- GET  /siddhi/artifact/undeploy/{app}
+(SiddhiApi.java:31,37-52; impl SiddhiApiServiceImpl.java:51,100)
+plus GET /siddhi/artifacts (list deployed app names).
+
+A stdlib http.server on a daemon thread fronting a SiddhiManager — the
+reference uses MSF4J, the role is identical: remote lifecycle control."""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class SiddhiService:
+    def __init__(self, manager=None, host: str = "127.0.0.1",
+                 port: int = 0):
+        from .manager import SiddhiManager
+        self.manager = manager or SiddhiManager()
+        self._deployed: dict = {}
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/siddhi/artifact/deploy":
+                    return self._send(404, {"error": "not found"})
+                n = int(self.headers.get("Content-Length", 0))
+                text = self.rfile.read(n).decode()
+                try:
+                    name = service.deploy(text)
+                except Exception as e:  # noqa: BLE001 — surface to client
+                    return self._send(400, {"error": str(e)})
+                self._send(200, {"status": "deployed", "app": name})
+
+            def do_GET(self):
+                if self.path.startswith("/siddhi/artifact/undeploy/"):
+                    name = self.path.rsplit("/", 1)[-1]
+                    if service.undeploy(name):
+                        return self._send(200, {"status": "undeployed",
+                                                "app": name})
+                    return self._send(404, {"error": f"no app '{name}'"})
+                if self.path == "/siddhi/artifacts":
+                    return self._send(200,
+                                      {"apps": sorted(service._deployed)})
+                self._send(404, {"error": "not found"})
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name="siddhi-service")
+        self._thread.start()
+
+    def stop(self) -> None:
+        for name in list(self._deployed):
+            self.undeploy(name)
+        self._server.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    # -- operations -------------------------------------------------------
+    def deploy(self, siddhi_ql: str) -> str:
+        rt = self.manager.create_siddhi_app_runtime(siddhi_ql)
+        rt.start()
+        self._deployed[rt.name] = rt
+        return rt.name
+
+    def undeploy(self, name: str) -> bool:
+        rt = self._deployed.pop(name, None)
+        if rt is None:
+            return False
+        rt.shutdown()
+        return True

@@ -1,0 +1,119 @@
+"""Statistics: throughput / latency / memory trackers with OFF / BASIC /
+DETAIL levels.
+
+Reference mapping:
+- util/statistics/* (ThroughputTracker, LatencyTracker,
+  MemoryUsageTracker, BufferedEventsTracker; Dropwizard impls in
+  util/statistics/metrics/)
+- levels OFF/BASIC/DETAIL (util/statistics/metrics/Level.java)
+- @app:statistics parsing (SiddhiAppParser.java:116-141) and runtime
+  switching (SiddhiAppRuntimeImpl.setStatisticsLevel:859)
+
+Measurement model for an async device pipeline: BASIC counts events and
+wall time at the host boundary (no device syncs — the numbers are free);
+DETAIL additionally blocks until the device step completes to measure
+true per-step latency (accurate, but serializes the pipeline — exactly
+the reference's caveat that DETAIL metrics cost throughput)."""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+OFF, BASIC, DETAIL = 0, 1, 2
+_LEVELS = {"OFF": OFF, "BASIC": BASIC, "DETAIL": DETAIL}
+
+
+def parse_level(text: Optional[str]) -> int:
+    if text is None:
+        return OFF
+    return _LEVELS.get(str(text).upper(), BASIC)
+
+
+class ThroughputTracker:
+    def __init__(self):
+        self.count = 0
+        self._t0: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    def mark(self, n: int) -> None:
+        now = time.perf_counter()
+        if self._t0 is None:
+            self._t0 = now
+        self._t_last = now
+        self.count += n
+
+    def events_per_sec(self) -> Optional[float]:
+        if self._t0 is None or self._t_last is None or \
+                self._t_last <= self._t0:
+            return None
+        return self.count / (self._t_last - self._t0)
+
+
+class LatencyTracker:
+    """Windowed latency stats in ms (markIn/markOut around a step).
+    mark_in/mark_out pair up per thread so concurrent steps (ingest vs
+    scheduler timers) don't cross-contaminate samples."""
+
+    CAP = 4096
+
+    def __init__(self):
+        import threading
+        self.samples: list[float] = []
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+
+    def mark_in(self) -> None:
+        self._tls.t0 = time.perf_counter()
+
+    def mark_out(self) -> None:
+        t0 = getattr(self._tls, "t0", None)
+        if t0 is None:
+            return
+        self._tls.t0 = None
+        dt = (time.perf_counter() - t0) * 1000.0
+        with self._lock:
+            if len(self.samples) >= self.CAP:
+                del self.samples[: self.CAP // 2]
+            self.samples.append(dt)
+
+    def summary(self) -> Optional[dict]:
+        if not self.samples:
+            return None
+        s = sorted(self.samples)
+        n = len(s)
+        return {"avg_ms": round(sum(s) / n, 3),
+                "p50_ms": round(s[n // 2], 3),
+                "p99_ms": round(s[min(n - 1, (n * 99) // 100)], 3),
+                "samples": n}
+
+
+def pytree_nbytes(tree) -> int:
+    import numpy as np
+    total = 0
+    if isinstance(tree, dict):
+        vals = tree.values()
+    elif isinstance(tree, (tuple, list)):
+        vals = tree
+    else:
+        vals = [tree]
+        if hasattr(tree, "nbytes"):
+            return int(tree.nbytes)
+        if isinstance(tree, (int, float, bool)):
+            return 8
+        return 0
+    for v in vals:
+        if hasattr(v, "nbytes"):
+            total += int(v.nbytes)
+        elif isinstance(v, (dict, tuple, list)):
+            total += pytree_nbytes(v)
+        elif isinstance(v, np.generic):
+            total += int(v.nbytes)
+    return total
+
+
+class QueryStats:
+    """Per-query tracker bundle (created when statistics are enabled)."""
+
+    def __init__(self):
+        self.throughput = ThroughputTracker()
+        self.latency = LatencyTracker()

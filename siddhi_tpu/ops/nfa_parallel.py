@@ -347,7 +347,10 @@ class ParallelNfaEngine(NfaEngine):
         cand = cand & at_rows[:, None]
         csum = jnp.cumsum(cand.astype(jnp.int32), axis=1)
         take = cand & (csum <= room[:, None])
-        k = jnp.where(at_rows, jnp.sum(take.astype(jnp.int32), axis=1), 0)
+        # dtype=int32: jnp.sum promotes int32 inputs to int64 under x64
+        # (NumPy accumulator promotion), which would widen the carried
+        # slot count and break the fori_loop carry contract
+        k = jnp.where(at_rows, jnp.sum(take, axis=1, dtype=jnp.int32), 0)
         absorbed = at_rows & (k > 0)
 
         # place the r-th taken event at slot position n + r - 1

@@ -195,6 +195,14 @@ class WindowOp(Operator):
         if this window never needs timer wakeups."""
         return None
 
+    # host_due_bound(ts_min) -> int: a LOWER bound on this window's next
+    # due after ingesting a chunk whose earliest timestamp is ts_min.
+    # Lets the runtime schedule timers without reading the device due
+    # back through the host link (one RTT per step on a TPU tunnel);
+    # a too-early (spurious) timer step is cheap and its own deferred
+    # device due re-arms the true one. None = no host bound available.
+    host_due_bound = None
+
     def findable_buffer(self, state) -> dict:
         """The window content a join/table find() searches (= the
         reference's expiredEventQueue handed to OperatorParser in
@@ -273,6 +281,9 @@ class TimeWindowOp(WindowOp):
         buf = state["buf"]
         due = jnp.where(buf["valid"], buf["ts"] + self.T, POS_INF)
         return jnp.min(due)
+
+    def host_due_bound(self, ts_min: int) -> int:
+        return ts_min + self.T
 
     def findable_buffer(self, state):
         return state["buf"]

@@ -204,6 +204,9 @@ class TimeLengthWindowOp(WindowOp):
         due = jnp.where(buf["valid"], buf["ts"] + self.T, POS_INF)
         return jnp.min(due)
 
+    def host_due_bound(self, ts_min: int) -> int:
+        return ts_min + self.T
+
     def findable_buffer(self, state):
         return state["buf"]
 
@@ -259,6 +262,9 @@ class DelayWindowOp(WindowOp):
         buf = state["buf"]
         due = jnp.where(buf["valid"], buf["ts"] + self.T, POS_INF)
         return jnp.min(due)
+
+    def host_due_bound(self, ts_min: int) -> int:
+        return ts_min + self.T
 
     def findable_buffer(self, state):
         return state["buf"]
@@ -1245,6 +1251,9 @@ class SessionWindowOp(WindowOp):
 
     def next_due(self, state):
         return jnp.min(jnp.where(state["open"], state["end"], POS_INF))
+
+    def host_due_bound(self, ts_min: int) -> int:
+        return ts_min + self.gap
 
 
 class CronWindowOp(WindowOp):

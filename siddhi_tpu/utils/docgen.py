@@ -1,0 +1,86 @@
+"""Documentation generation: markdown API pages from the component
+registries (reference: modules/siddhi-doc-gen — Maven mojos scanning
+@Extension metadata into mkdocs pages).
+
+Here the registries ARE the metadata: window classes, aggregator names,
+scalar functions, source/sink types and registered extensions, with
+their docstrings. `python -m siddhi_tpu.utils.docgen [out_dir]` writes
+one markdown page per category."""
+from __future__ import annotations
+
+import inspect
+import os
+
+
+def _doc(obj) -> str:
+    d = inspect.getdoc(obj) or ""
+    return d.strip()
+
+
+def generate(manager=None) -> dict:
+    """-> {page_name: markdown text}."""
+    from ..core import io as sio
+    from ..core.runtime import WINDOW_CLASSES
+    from ..ops.selector import AGGREGATOR_NAMES
+
+    pages = {}
+
+    lines = ["# Windows", "",
+             "Retention operators available as `#window.<name>(...)`.",
+             ""]
+    for name, cls in sorted(WINDOW_CLASSES.items()):
+        lines += [f"## {getattr(cls, 'kind_name', name)}", "",
+                  _doc(cls), ""]
+    pages["windows.md"] = "\n".join(lines)
+
+    lines = ["# Aggregate functions", "",
+             "Usable in any select clause; removal-aware where the window "
+             "emits expired events.", ""]
+    from ..ops import aggregators as agg
+    specs = {
+        "sum": agg.SumAgg, "avg": agg.AvgAgg, "count": agg.CountAgg,
+        "stdDev": agg.StdDevAgg, "min/max": agg.MinMaxAgg,
+        "min/max (sliding)": agg.SlidingMinMaxAgg,
+        "minForever/maxForever": agg.ForeverMinMaxAgg,
+        "and/or": agg.BoolAgg, "distinctCount": agg.DistinctCountAgg,
+    }
+    for name, cls in specs.items():
+        lines += [f"## {name}", "", _doc(cls), ""]
+    lines += ["", f"Registered names: {sorted(AGGREGATOR_NAMES)}"]
+    pages["aggregators.md"] = "\n".join(lines)
+
+    lines = ["# Sources and sinks", ""]
+    for name, cls in sorted(sio.SOURCE_TYPES.items()):
+        lines += [f"## source: {name}", "", _doc(cls), ""]
+    for name, cls in sorted(sio.SINK_TYPES.items()):
+        lines += [f"## sink: {name}", "", _doc(cls), ""]
+    for name, cls in sorted(sio.SOURCE_MAPPERS.items()):
+        lines += [f"## source mapper: {name}", "", _doc(cls), ""]
+    for name, cls in sorted(sio.SINK_MAPPERS.items()):
+        lines += [f"## sink mapper: {name}", "", _doc(cls), ""]
+    pages["io.md"] = "\n".join(lines)
+
+    if manager is not None and getattr(manager, "extensions", None):
+        lines = ["# Registered extensions", ""]
+        for key, obj in sorted(manager.extensions.items()):
+            lines += [f"## {key}", "", _doc(obj) or repr(obj), ""]
+        pages["extensions.md"] = "\n".join(lines)
+    return pages
+
+
+def write(out_dir: str, manager=None) -> list[str]:
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for name, text in generate(manager).items():
+        path = os.path.join(out_dir, name)
+        with open(path, "w") as f:
+            f.write(text)
+        written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else "docs/api"
+    for p in write(out):
+        print(p)

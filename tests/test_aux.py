@@ -1,0 +1,136 @@
+"""Auxiliary subsystems: statistics levels, debugger, REST service
+(reference corpus: managment/StatisticsTestCase.java, debugger/,
+siddhi-service REST test)."""
+import json
+import threading
+import urllib.request
+
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+
+PLAYBACK = "@app:playback "
+
+
+class TestStatistics:
+    def test_basic_level_throughput(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(PLAYBACK + """
+            @app:statistics('BASIC')
+            define stream S (v int);
+            @info(name = 'q') from S[v > 0] select v insert into Out;
+        """)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(5):
+            h.send(Event(1000 + i, (i,)))
+        stats = rt.statistics()
+        rt.shutdown()
+        q = stats["q"]
+        assert q["emitted"] == 4          # v=0 filtered
+        assert q["throughput_eps"] is not None
+        assert q["state_bytes"] >= 0
+
+    def test_detail_level_latency(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """)
+        rt.set_statistics_level("DETAIL")
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(3):
+            h.send(Event(1000 + i, (i,)))
+        stats = rt.statistics()
+        rt.shutdown()
+        lat = stats["q"]["latency"]
+        assert lat["samples"] == 3 and lat["p99_ms"] >= lat["p50_ms"] >= 0
+
+
+class TestDebugger:
+    def test_in_breakpoint_pause_and_next(self):
+        from siddhi_tpu.core.debugger import QueryTerminal
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        dbg = rt.debug()
+        hits = []
+        dbg.callback = lambda q, t, evs: hits.append(
+            (q, t.value, [e.data for e in evs]))
+        dbg.acquire_break_point("q", QueryTerminal.IN)
+        rt.start()
+
+        def sender():
+            rt.get_input_handler("S").send(Event(1000, (7,)))
+        t = threading.Thread(target=sender)
+        t.start()
+        # the sender blocks on the breakpoint until next() releases it
+        for _ in range(100):
+            if hits:
+                break
+            import time
+            time.sleep(0.01)
+        assert hits == [("q", "IN", [(7,)])]
+        assert t.is_alive()            # paused
+        dbg.next()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [7]
+
+    def test_out_breakpoint_play(self):
+        from siddhi_tpu.core.debugger import QueryTerminal
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q') from S[v > 1] select v insert into Out;
+        """)
+        dbg = rt.debug()
+        hits = []
+        dbg.callback = lambda q, t, evs: hits.append(
+            (t.value, [e.data for e in evs]))
+        dbg.acquire_break_point("q", QueryTerminal.OUT)
+        dbg.play()                      # don't pause, just observe
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(Event(1000, (5,)))
+        h.send(Event(1001, (0,)))       # filtered: no OUT rows
+        rt.shutdown()
+        assert ("OUT", [(5,)]) in hits
+
+
+class TestRestService:
+    def test_deploy_query_undeploy(self):
+        from siddhi_tpu.core.io import InMemoryBroker
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService()
+        svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        ql = PLAYBACK + """
+            @app:name('restapp')
+            @source(type='inMemory', topic='rest.in')
+            define stream S (v int);
+            @sink(type='inMemory', topic='rest.out')
+            define stream Out (v int);
+            @info(name = 'q') from S[v > 1] select v insert into Out;
+        """
+        req = urllib.request.Request(f"{base}/siddhi/artifact/deploy",
+                                     data=ql.encode(), method="POST")
+        with urllib.request.urlopen(req) as r:
+            body = json.load(r)
+        assert body["status"] == "deployed"
+        name = body["app"]
+        got = []
+        InMemoryBroker.subscribe("rest.out", got.append)
+        InMemoryBroker.publish("rest.in", (5,))
+        assert [tuple(e.data) for e in got] == [(5,)]
+        with urllib.request.urlopen(
+                f"{base}/siddhi/artifacts") as r:
+            assert name in json.load(r)["apps"]
+        with urllib.request.urlopen(
+                f"{base}/siddhi/artifact/undeploy/{name}") as r:
+            assert json.load(r)["status"] == "undeployed"
+        svc.stop()

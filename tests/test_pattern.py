@@ -227,3 +227,85 @@ class TestFiveStateSequence:
             h.send(Event(1000 + i * 10, (k, key, v)))
         rt.shutdown()
         assert [e.data for e in got] == [(7, 5.0)]
+
+
+def run_pattern(ql, sends, out="Out"):
+    rt, got = build(ql, targets=(out,))
+    for sid, ts, data in sends:
+        rt.get_input_handler(sid).send(Event(ts, tuple(data)))
+    rt.shutdown()
+    return got
+
+
+class TestLogicalPatterns:
+    def test_and_waits_for_both(self):
+        # LogicalPatternTestCase: A and B fires only when both arrived
+        got = run_pattern("""
+            @app:playback
+            define stream A (v int);
+            define stream B (w int);
+            @info(name = 'q')
+            from e1=A and e2=B select e1.v as v, e2.w as w
+            insert into Out;
+        """, [("A", 1000, (1,)), ("B", 1500, (2,))])
+        assert [tuple(e.data) for e in got] == [(1, 2)]
+
+    def test_and_reverse_arrival(self):
+        got = run_pattern("""
+            @app:playback
+            define stream A (v int);
+            define stream B (w int);
+            @info(name = 'q')
+            from e1=A and e2=B select e1.v as v, e2.w as w
+            insert into Out;
+        """, [("B", 1000, (9,)), ("A", 1500, (3,))])
+        assert [tuple(e.data) for e in got] == [(3, 9)]
+
+    def test_or_fires_on_either(self):
+        got = run_pattern("""
+            @app:playback
+            define stream A (v int);
+            define stream B (w int);
+            @info(name = 'q')
+            from e1=A or e2=B select e1.v as v insert into Out;
+        """, [("B", 1000, (4,))])
+        # e1 slot empty -> null projection of e1.v
+        assert len(got) == 1
+
+    def test_logical_then_next(self):
+        got = run_pattern("""
+            @app:playback
+            define stream A (v int);
+            define stream B (w int);
+            define stream C (x int);
+            @info(name = 'q')
+            from e1=A and e2=B -> e3=C
+            select e1.v as v, e3.x as x insert into Out;
+        """, [("A", 1000, (1,)), ("B", 1100, (2,)), ("C", 1200, (3,))])
+        assert [tuple(e.data) for e in got] == [(1, 3)]
+
+
+class TestAbsentPatterns:
+    def test_not_for_fires_after_quiet_period(self):
+        # AbsentPatternTestCase: A -> not B for 1 sec
+        got = run_pattern("""
+            @app:playback
+            define stream A (v int);
+            define stream B (w int);
+            @info(name = 'q')
+            from e1=A -> not B for 1 sec
+            select e1.v as v insert into Out;
+        """, [("A", 1000, (7,)), ("A", 3000, (8,))])
+        assert (7,) in [tuple(e.data) for e in got]
+
+    def test_not_for_suppressed_by_b(self):
+        got = run_pattern("""
+            @app:playback
+            define stream A (v int);
+            define stream B (w int);
+            @info(name = 'q')
+            from e1=A -> not B for 1 sec
+            select e1.v as v insert into Out;
+        """, [("A", 1000, (7,)), ("B", 1500, (1,)), ("A", 5000, (8,))])
+        # B arrived within the wait window: the first match is suppressed
+        assert (7,) not in [tuple(e.data) for e in got]

@@ -145,3 +145,62 @@ class TestOnDemandQueries:
         rows = rt.query("from W select v")
         assert sorted(rows) == [(2,), (3,)]
         rt.shutdown()
+
+
+class TestIncrementalAggregation:
+    QL = PLAYBACK + """
+        define stream Trades (symbol string, price double, ts long);
+        define aggregation TradeAgg
+        from Trades
+        select symbol, avg(price) as ap, sum(price) as tp,
+               count() as n, max(price) as mx
+        group by symbol
+        aggregate by ts every seconds, minutes, hours;
+    """
+
+    def _loaded(self):
+        rt, _ = build(self.QL)
+        h = rt.get_input_handler("Trades")
+        # two seconds buckets for IBM, one for WSO2
+        rows = [("IBM", 10.0, 1_000), ("IBM", 20.0, 1_500),
+                ("WSO2", 5.0, 1_200), ("IBM", 40.0, 2_300)]
+        for i, r in enumerate(rows):
+            h.send(Event(100 + i, r))
+        return rt
+
+    def test_seconds_buckets(self):
+        rt = self._loaded()
+        rows = rt.query(
+            "from TradeAgg within 0L, 10000L per 'seconds' "
+            "select symbol, ap, n, AGG_TIMESTAMP")
+        rt.shutdown()
+        assert sorted(rows) == [
+            ("IBM", 15.0, 2, 1000), ("IBM", 40.0, 1, 2000),
+            ("WSO2", 5.0, 1, 1000)]
+
+    def test_minutes_rollup(self):
+        rt = self._loaded()
+        rows = rt.query(
+            "from TradeAgg within 0L, 100000L per 'minutes' "
+            "select symbol, tp, mx")
+        rt.shutdown()
+        assert sorted(rows) == [("IBM", 70.0, 40.0), ("WSO2", 5.0, 5.0)]
+
+    def test_out_of_order_events_land_in_their_bucket(self):
+        rt = self._loaded()
+        # a late event for the 1000 bucket after the 2000 bucket opened
+        rt.get_input_handler("Trades").send(Event(200, ("IBM", 30.0,
+                                                        1_800)))
+        rows = rt.query(
+            "from TradeAgg within 1000L, 2000L per 'seconds' "
+            "select symbol, n")
+        rt.shutdown()
+        assert ("IBM", 3) in rows
+
+    def test_within_filters_buckets(self):
+        rt = self._loaded()
+        rows = rt.query(
+            "from TradeAgg within 2000L, 3000L per 'seconds' "
+            "select symbol, n")
+        rt.shutdown()
+        assert rows == [("IBM", 1)]
