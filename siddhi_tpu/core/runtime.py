@@ -917,7 +917,8 @@ class JoinQueryRuntime(QueryRuntime):
         if fn is None:
             my_ops = self.side_ops[side]
             opp = "R" if side == "L" else "L"
-            opp_window = self.side_ops[opp][-1]
+            opp_window = self.side_ops[opp][-1] \
+                if self.side_ops[opp] else None  # table side: no window
             cross = self.crosses[side]
             sel_ops = self.operators
             has_timers = self._has_timers
@@ -2100,16 +2101,44 @@ class Planner:
                 ops.append(window)
             return schema, ops
 
-        l_schema, l_ops = side_chain(jin.left, "L")
-        r_schema, r_ops = side_chain(jin.right, "R")
+        # stream-table joins: a side naming a table contributes its
+        # columnar buffer as the findable content and never triggers
+        # (JoinInputStreamParser's table branch; the runtime's
+        # side_tables machinery reads the live table state per step)
+        side_tables = {}
+
+        def table_side(sin: A.SingleInputStream, key: str):
+            t = app.tables[sin.stream_id]
+            if sin.handlers:
+                raise CompileError(
+                    f"query '{name}': windows/filters on the table side "
+                    "of a join are not supported")
+            side_tables[key] = t
+            return t.schema, []
+
+        l_is_table = jin.left.stream_id in app.tables
+        r_is_table = jin.right.stream_id in app.tables
+        if l_is_table and r_is_table:
+            raise CompileError(
+                f"query '{name}': joining two tables needs an on-demand "
+                "query, not a stream join")
+        if (l_is_table or r_is_table) and jin.unidirectional:
+            raise CompileError(
+                f"query '{name}': 'unidirectional' with a table side is "
+                "redundant (tables never trigger) and would silence the "
+                "stream side")
+        l_schema, l_ops = table_side(jin.left, "L") if l_is_table \
+            else side_chain(jin.left, "L")
+        r_schema, r_ops = table_side(jin.right, "R") if r_is_table \
+            else side_chain(jin.right, "R")
         side_scope = JoinSideScope(l_schema, jin.left.alias,
                                    r_schema, jin.right.alias)
         jschema = combined_schema(target, l_schema, r_schema)
         crosses = {"L": None, "R": None}
-        if jin.unidirectional != "right":
+        if jin.unidirectional != "right" and not l_is_table:
             crosses["L"] = JoinCross(True, l_schema, r_schema, jin.on,
                                      side_scope, jin.join_type)
-        if jin.unidirectional != "left":
+        if jin.unidirectional != "left" and not r_is_table:
             crosses["R"] = JoinCross(False, l_schema, r_schema, jin.on,
                                      side_scope, jin.join_type)
 
@@ -2128,16 +2157,19 @@ class Planner:
         if name in app.queries:
             raise CompileError(f"duplicate query name '{name}'")
         qr = JoinQueryRuntime(name, l_ops, r_ops, crosses, sel_ops,
-                              {"L": l_schema, "R": r_schema}, jschema, app)
+                              {"L": l_schema, "R": r_schema}, jschema, app,
+                              side_tables=side_tables)
         # cron windows on join sides are host-scheduled like single-stream
         # ones; their fires reach both sides as TIMER batches
         qr._host_sched.extend(
             op.host_schedule for op in l_ops + r_ops
             if getattr(op, "host_schedule", None))
-        app.junctions[jin.left.stream_id].subscribe(
-            JoinStreamReceiver(qr, "L"))
-        app.junctions[jin.right.stream_id].subscribe(
-            JoinStreamReceiver(qr, "R"))
+        if not l_is_table:
+            app.junctions[jin.left.stream_id].subscribe(
+                JoinStreamReceiver(qr, "L"))
+        if not r_is_table:
+            app.junctions[jin.right.stream_id].subscribe(
+                JoinStreamReceiver(qr, "R"))
         app.queries[name] = qr
         if isinstance(out, A.InsertIntoStream):
             tj = app.junction_for(out.target, qr.out_schema)

@@ -122,3 +122,79 @@ class TestOuterJoin:
         twitter.send(Event(1200, ("u", "t2", "WSO2")))  # must NOT trigger
         rt.shutdown()
         assert [e.data for e in got] == [("WSO2", "t1")]
+
+
+class TestStreamTableJoin:
+    QL = PLAYBACK + """
+        define stream S (sym string, qty int);
+        define stream Feed (sym string, price float);
+        define table Prices (sym string, price float);
+        @info(name = 'load') from Feed select sym, price
+        insert into Prices;
+        @info(name = 'j')
+        from S join Prices on S.sym == Prices.sym
+        select S.sym as sym, qty, Prices.price as price
+        insert into Out;
+    """
+
+    def _build(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(self.QL)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        rt.start()
+        return rt, got
+
+    def test_stream_joins_table_rows(self):
+        rt, got = self._build()
+        f = rt.get_input_handler("Feed")
+        f.send(Event(1000, ("IBM", 75.0)))
+        f.send(Event(1001, ("WSO2", 57.0)))
+        rt.get_input_handler("S").send(Event(2000, ("IBM", 10)))
+        rt.shutdown()
+        assert [tuple(e.data) for e in got] == [("IBM", 10, 75.0)]
+
+    def test_table_updates_visible_to_later_triggers(self):
+        rt, got = self._build()
+        f = rt.get_input_handler("Feed")
+        s = rt.get_input_handler("S")
+        s.send(Event(1000, ("IBM", 1)))      # no match yet
+        f.send(Event(1500, ("IBM", 80.0)))
+        s.send(Event(2000, ("IBM", 2)))      # matches now
+        rt.shutdown()
+        assert [tuple(e.data) for e in got] == [("IBM", 2, 80.0)]
+
+    def test_left_outer_with_table(self):
+        ql = self.QL.replace("from S join Prices",
+                             "from S left outer join Prices")
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        rt.start()
+        rt.get_input_handler("S").send(Event(1000, ("GOOG", 3)))
+        rt.shutdown()
+        # unmatched trigger emits with null table columns
+        assert [tuple(e.data) for e in got] == [("GOOG", 3, None)]
+
+    def test_table_on_left_side(self):
+        ql = PLAYBACK + """
+            define stream S (sym string, qty int);
+            define stream Feed (sym string, price float);
+            define table Prices (sym string, price float);
+            @info(name = 'load') from Feed select sym, price
+            insert into Prices;
+            @info(name = 'j')
+            from Prices join S on S.sym == Prices.sym
+            select S.sym as sym, Prices.price as price
+            insert into Out;
+        """
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        rt.start()
+        rt.get_input_handler("Feed").send(Event(1000, ("IBM", 75.0)))
+        rt.get_input_handler("S").send(Event(2000, ("IBM", 5)))
+        rt.shutdown()
+        assert [tuple(e.data) for e in got] == [("IBM", 75.0)]
