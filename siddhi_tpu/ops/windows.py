@@ -105,7 +105,12 @@ def _rel32(seq):
 
 def keep_newest(pool: dict, keep_mask, cap: int):
     """Retain the newest (by seq) `cap` rows where keep_mask; returns
-    (buffer dict of size cap in seq order, overflow_count)."""
+    (buffer dict of size cap in seq order, overflow_count).
+
+    Implemented with one int32 argsort + gather. (A sort-free variant —
+    reversed prefix count + scatter into [cap] — was measured SLOWER on
+    TPU v5-lite: dynamic-index scatters lower worse than the native
+    int32 sort, 271k vs 316k ev/s on the window_agg bench.)"""
     n = pool["seq"].shape[0]
     keep = keep_mask & pool["valid"]
     key = _rel32(jnp.where(keep, pool["seq"], NEG_INF))

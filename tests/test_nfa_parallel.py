@@ -5,7 +5,7 @@ same order — on randomized multi-stream replays, across chunk-size splits.
 import numpy as np
 import pytest
 
-from siddhi_tpu import SiddhiManager
+from siddhi_tpu import SiddhiManager, StreamCallback
 from siddhi_tpu.core.runtime import PatternQueryRuntime
 from siddhi_tpu.ops.nfa import NfaEngine
 from siddhi_tpu.ops.nfa_parallel import ParallelNfaEngine, \
@@ -98,3 +98,33 @@ def test_chunk_split_invariance():
             small.append((sid, ts[s:s + 11],
                           [c[s:s + 11] for c in cols]))
     assert run(ql, base) == run(ql, small)
+
+
+class TestSubBatchedCounting:
+    def test_kleene_across_sub_batches(self):
+        # regression: jnp.sum int32->int64 promotion widened the counting
+        # slot's carry and broke the fori_loop carry contract whenever a
+        # batch exceeded the PB sub-batch size
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+            @app:playback
+            define stream A (v int);
+            define stream B (v int);
+            @info(name = 'q')
+            from every e1=A[v > 10]+, e2=B[v > e1.v] within 10 sec
+            select count(e1.v) as n, e2.v as bv
+            insert into Out;
+        """)
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        assert isinstance(rt.queries["q"].engine, ParallelNfaEngine)
+        rt.start()
+        B = ParallelNfaEngine.PB * 2  # force the sub-batched fori_loop
+        ts = 1_700_000_000_000 + np.arange(B, dtype=np.int64)
+        rng = np.random.default_rng(3)
+        rt.get_input_handler("A").send_arrays(
+            ts, [rng.integers(0, 100, B).astype(np.int32)])
+        rt.get_input_handler("B").send_arrays(
+            ts + B, [np.full(B, 99, dtype=np.int32)])
+        rt.shutdown()
+        assert len(got) > 0  # matches produced, no dtype crash
