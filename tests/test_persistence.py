@@ -205,3 +205,73 @@ class TestManagerlessRuntime:
         rt.shutdown()
         assert [e.data for e in got] == [(6,)]
         assert rt.restore_last_revision() == rev
+
+
+class TestNewStateHolders:
+    def test_named_window_contents_survive_restore(self):
+        from siddhi_tpu.lang.parser import parse
+        from siddhi_tpu.core.runtime import SiddhiAppRuntime
+        rt = SiddhiAppRuntime(parse("""
+            @app:playback
+            define stream S (sym string, v int);
+            define window W (sym string, v int) length(3);
+            @info(name = 'f') from S select sym, v insert into W;
+        """))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i, v in enumerate([1, 2, 3]):
+            h.send(Event(1000 + i, ("a", v)))
+        rev = rt.persist()
+        h.send(Event(2000, ("a", 9)))  # evicts v=1 after the snapshot
+        rt.restore_revision(rev)
+        rows = rt.query("from W select v")
+        rt.shutdown()
+        assert sorted(rows) == [(1,), (2,), (3,)]
+
+    def test_aggregation_buckets_survive_restore(self):
+        from siddhi_tpu.lang.parser import parse
+        from siddhi_tpu.core.runtime import SiddhiAppRuntime
+        rt = SiddhiAppRuntime(parse("""
+            @app:playback
+            define stream T (sym string, p double, ts long);
+            define aggregation Agg from T
+            select sym, sum(p) as tp group by sym
+            aggregate by ts every seconds;
+        """))
+        rt.start()
+        h = rt.get_input_handler("T")
+        h.send(Event(100, ("a", 2.0, 1000)))
+        h.send(Event(101, ("a", 3.0, 1500)))
+        rev = rt.persist()
+        h.send(Event(102, ("a", 10.0, 1600)))   # post-snapshot
+        rt.restore_revision(rev)
+        rows = rt.query("from Agg within 0L, 10000L per 'seconds' "
+                        "select sym, tp")
+        rt.shutdown()
+        assert rows == [("a", 5.0)]
+
+    def test_rate_limiter_counters_survive_restore(self):
+        from siddhi_tpu.lang.parser import parse
+        from siddhi_tpu.core.runtime import SiddhiAppRuntime
+        from siddhi_tpu import StreamCallback
+        rt = SiddhiAppRuntime(parse("""
+            @app:playback
+            define stream S (v int);
+            @info(name = 'q') from S select v
+            output last every 3 events
+            insert into Out;
+        """))
+        got = []
+        rt.add_callback("Out", StreamCallback(fn=lambda e: got.extend(e)))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(Event(1000, (1,)))
+        h.send(Event(1001, (2,)))
+        rev = rt.persist()     # counter at 2-of-3
+        h.send(Event(1002, (3,)))   # post-snapshot: emits, counter resets
+        assert [e.data[0] for e in got] == [3]
+        got.clear()
+        rt.restore_revision(rev)    # back to 2-of-3
+        h.send(Event(1003, (4,)))   # 3rd again -> emits immediately
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [4]
