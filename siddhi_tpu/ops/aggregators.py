@@ -36,6 +36,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.event import (CURRENT, EXPIRED, RESET, Attribute, EventBatch,
                           StreamSchema)
@@ -49,8 +50,7 @@ from .operators import Operator
 from .selector import (AGGREGATOR_NAMES, compile_order_by, const_int,
                        output_attribute_name, shape_output)
 
-I64_MIN = jnp.int64(-(2 ** 62))
-I64_MAX = jnp.int64(2 ** 62)
+from .sentinels import POS_INF as I64_MAX  # noqa: N811
 
 
 # ---------------------------------------------------------------------------

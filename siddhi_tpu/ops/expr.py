@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.types import AttrType, GLOBAL_STRINGS, NUMERIC_TYPES, np_dtype, promote
 from ..lang import ast as A
@@ -41,15 +42,19 @@ class Col:
 
     @classmethod
     def const(cls, value, t: AttrType):
+        # numpy scalars, NOT jnp: constants are built at plan time and
+        # captured by jitted steps; a captured device array poisons the
+        # dispatch fast path on the TPU tunnel (see ops/windows.py note),
+        # while numpy scalars embed as HLO literals.
         dt = np_dtype(t)
         if value is None:
-            v = jnp.zeros((), dtype=dt)
-            n = jnp.ones((), dtype=jnp.bool_)
+            v = np.zeros((), dtype=dt)
+            n = np.ones((), dtype=np.bool_)
         else:
             if t is AttrType.STRING:
                 value = GLOBAL_STRINGS.encode(value)
-            v = jnp.asarray(value, dtype=dt)
-            n = jnp.zeros((), dtype=jnp.bool_)
+            v = np.asarray(value, dtype=dt)
+            n = np.zeros((), dtype=np.bool_)
         return cls(v, n)
 
 

@@ -22,6 +22,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.event import (CURRENT, EXPIRED, RESET, Attribute, EventBatch,
                           StreamSchema)
@@ -29,7 +30,7 @@ from ..core.types import AttrType, np_dtype
 from ..lang import ast as A
 from .expr import Col, CompileError, Scope, compile_expression
 
-POS_INF = jnp.int64(2 ** 62)
+from .sentinels import POS_INF
 
 
 class JoinSideScope(Scope):

@@ -17,9 +17,10 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 # sentinel for "row not placed in any slot"
-NO_SLOT = jnp.int32(-1)
+from .sentinels import NO_SLOT  # noqa: F401
 
 
 def mix64(h, v):

@@ -35,6 +35,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.event import (CURRENT, Attribute, EventBatch, StreamSchema)
 from ..core.types import AttrType, np_dtype
@@ -42,8 +43,9 @@ from ..lang import ast as A
 from .expr import Col, CompileError, CompiledExpr, Scope, compile_expression
 from .keyed import cumsum_fast
 
-NEG1 = jnp.int32(-1)
-POS_INF = jnp.int64(2 ** 62)
+from .sentinels import POS_INF
+
+NEG1 = np.int32(-1)
 
 
 # ---------------------------------------------------------------------------
@@ -349,7 +351,7 @@ class NfaEngine:
         for st in states:
             if st.is_absent and st.waiting_ms > 0:
                 wait_of[st.anchor] = st.waiting_ms
-        self._wait_of = wait_of
+        self._wait_of = np.asarray(wait_of, np.int64)
 
         # flattened match-batch schema: slot j attr a copy c
         attrs = []
@@ -630,7 +632,7 @@ class NfaEngine:
                 # rows newly waiting at an absent anchor start their clock
                 # at this event's time (arrival into the state, or first
                 # observed time for the initial pending)
-                w = jnp.asarray(self._wait_of, jnp.int64)[
+                w = jnp.asarray(self._wait_of)[
                     jnp.clip(table2["state"], 0, len(self.states))]
                 needs = table2["valid"] & (w > 0) & ev_valid & \
                     (table2["deadline"] >= POS_INF)

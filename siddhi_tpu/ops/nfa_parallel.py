@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.event import CURRENT, EventBatch
 from ..core.types import np_dtype
@@ -49,7 +50,7 @@ from .expr import Col
 from .keyed import cumsum_fast
 from .nfa import NfaEngine, NfaStateSpec, POS_INF, SlotSpec
 
-BIG = jnp.int32(2 ** 30)
+BIG = np.int32(2 ** 30)  # numpy, not jnp: see ops/sentinels.py
 
 
 def _cond_refs_own_indexed(st: NfaStateSpec, slots: list[SlotSpec]) -> bool:

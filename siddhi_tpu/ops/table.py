@@ -24,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.event import (CURRENT, EXPIRED, Attribute, EventBatch,
                           StreamSchema)
@@ -33,7 +34,7 @@ from .expr import Col, CompileError, Scope, compile_expression
 from .keyed import cumsum_fast, hash_columns
 from .operators import Operator
 
-POS_INF = jnp.int64(2 ** 62)
+from .sentinels import POS_INF
 
 
 class TableRuntime:

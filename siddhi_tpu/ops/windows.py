@@ -37,6 +37,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.event import CURRENT, EXPIRED, RESET, EventBatch, StreamSchema
 from ..core.types import np_dtype
@@ -44,8 +45,7 @@ from .expr import CompileError
 from .keyed import cumsum_fast
 from .operators import Operator
 
-NEG_INF = jnp.int64(-(2 ** 62))
-POS_INF = jnp.int64(2 ** 62)
+from .sentinels import I32_LO, I32_MAX, NEG_INF, POS_INF  # noqa: F401
 
 
 # ---------------------------------------------------------------------------
@@ -86,10 +86,6 @@ def make_pool(buf: dict, batch: EventBatch, arrival_seq, arrival_valid) -> dict:
                        for b, c in zip(buf["nulls"], batch.nulls)),
         "valid": jnp.concatenate([buf["valid"], arrival_valid]),
     }
-
-
-I32_MAX = jnp.int32(2 ** 31 - 1)
-I32_LO = -(2 ** 31) + 1
 
 
 def _rel32(seq):

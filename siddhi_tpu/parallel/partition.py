@@ -66,7 +66,7 @@ from ..ops.expr import CompiledExpr, env_from_batch
 from ..ops.keyed import hash_columns, lookup_or_insert
 from ..ops.windows import POS_INF, WindowOp
 
-NO_SLOT = jnp.int32(-1)
+from ..ops.sentinels import NO_SLOT
 
 # combined-output compaction bound: several key slots can emit in the same
 # step (e.g. a timer flushing every slot's timeBatch window), so the cap

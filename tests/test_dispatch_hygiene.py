@@ -1,0 +1,34 @@
+"""Dispatch-path hygiene: no module-level jax device arrays.
+
+A jax array created at import/plan time and captured by a jitted step as a
+constant knocks the whole process off the runtime's fast dispatch path on
+the TPU tunnel (~2.4 ms added to EVERY subsequent dispatch — measured on
+TPU v5-lite via the axon tunnel; see ops/sentinels.py). Constants that
+jitted code touches must be numpy scalars/arrays, which embed as HLO
+literals. This test walks every siddhi_tpu module and rejects module-level
+jax.Array attributes so the pattern cannot creep back in.
+"""
+import importlib
+import pkgutil
+
+import jax
+
+import siddhi_tpu
+
+
+def _iter_modules():
+    yield siddhi_tpu
+    for pkg in pkgutil.walk_packages(siddhi_tpu.__path__,
+                                     prefix="siddhi_tpu."):
+        yield importlib.import_module(pkg.name)
+
+
+def test_no_module_level_device_arrays():
+    offenders = []
+    for mod in _iter_modules():
+        for name, val in vars(mod).items():
+            if isinstance(val, jax.Array):
+                offenders.append(f"{mod.__name__}.{name}")
+    assert not offenders, (
+        "module-level jax arrays poison the dispatch fast path when "
+        f"captured by jitted steps: {offenders}")
