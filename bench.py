@@ -294,12 +294,27 @@ def bench_seq5(n=1_048_576, chunk=65_536):
         h.send_arrays(*mk(i, chunk))
         _drain(outs)
         lat.append(time.perf_counter() - c0)
+    # small-chunk latency mode: batch.size.max-style dial at 1024 rows —
+    # honest match latency, not throughput wearing a latency label
+    small = 1024
+    h.send_arrays(*mk(2 * n_chunks + 16, small))   # warm the 1024 bucket
+    _drain(outs)
+    lat1k = []
+    for i in range(2 * n_chunks + 17, 2 * n_chunks + 81):
+        c0 = time.perf_counter()
+        h.send_arrays(*mk(i, small))
+        _drain(outs)
+        lat1k.append(time.perf_counter() - c0)
     rt.shutdown()
     lat_ms = np.array(lat) * 1000.0
+    lat1k_ms = np.array(lat1k) * 1000.0
     return _entry("seq5", n_chunks * chunk, dt, extra={
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),
         "chunk": chunk,
+        "p50_ms_1k": round(float(np.percentile(lat1k_ms, 50)), 2),
+        "p99_ms_1k": round(float(np.percentile(lat1k_ms, 99)), 2),
+        "latency_chunk": small,
     })
 
 
@@ -319,6 +334,7 @@ def main():
         "vs_baseline": head["vs_baseline"],
         "baseline": "assumed",
         "p99_match_latency_ms": head["p99_ms"],
+        "p99_match_latency_ms_1k": head["p99_ms_1k"],
         "configs": configs,
     }))
 

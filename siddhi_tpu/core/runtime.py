@@ -322,15 +322,26 @@ class QueryRuntime(Receiver):
         """Fused unpack + operator chain over a PackedChunk's single buffer
         (the high-throughput ingest path, see core/ingest.py). One compile
         per (encoding tuple, capacity); encodings are sticky so this stays
-        small."""
+        small.
+
+        Sort-heavy queries (max_step_capacity set) do NOT shrink the
+        transfer: the whole chunk still travels and dispatches once, and
+        the step body runs a lax.scan over max_step_capacity-row
+        sub-batches. XLA sort compile time grows superlinearly with row
+        count (~169 s at 65k rows for a window+aggregate chain, measured),
+        so the scan keeps the compiled sort width small while one dispatch
+        covers the full chunk. Playback per-sub-batch time advances as the
+        running max event time — the same clock the pre-scan split path
+        derived per sub-chunk on the host."""
         fn = self._packed_steps.get((enc, capacity))
         if fn is None:
             ops = self.operators
             has_timers = self._has_timers
             schema = self.in_schema
+            sub_cap = self.max_step_capacity
+            playback = self.app._playback
 
-            def pstep(states, tstates, emitted, buf):
-                batch, now = unpack_buffer(schema, enc, capacity, buf)
+            def chain(states, tstates, emitted, batch, now):
                 new_states = []
                 for op, st in zip(ops, states):
                     if op.needs_tables:
@@ -351,9 +362,58 @@ class QueryRuntime(Receiver):
                 emitted = emitted + batch.count().astype(jnp.int64)
                 return tuple(new_states), tstates, emitted, batch, due
 
+            if sub_cap is not None and capacity > sub_cap:
+                k = capacity // sub_cap
+
+                def pstep(states, tstates, emitted, buf):
+                    batch, now = unpack_buffer(schema, enc, capacity, buf)
+                    subs = jax.tree_util.tree_map(
+                        lambda x: x.reshape((k, sub_cap) + x.shape[1:]),
+                        batch)
+
+                    def body(carry, sub):
+                        states, tstates, emitted, run_ts = carry
+                        if playback:
+                            sub_now = jnp.maximum(run_ts, jnp.max(
+                                jnp.where(sub.valid, sub.ts,
+                                          jnp.int64(-(2 ** 62)))))
+                        else:
+                            sub_now = now
+                        states, tstates, emitted, out, due = chain(
+                            states, tstates, emitted, sub, sub_now)
+                        return ((states, tstates, emitted, sub_now),
+                                (out, due))
+
+                    carry0 = (states, tstates, emitted,
+                              jnp.int64(-(2 ** 62)))
+                    (states, tstates, emitted, _), (outs, dues) = \
+                        jax.lax.scan(body, carry0, subs)
+                    out = jax.tree_util.tree_map(
+                        lambda x: x.reshape((x.shape[0] * x.shape[1],)
+                                            + x.shape[2:]), outs)
+                    return states, tstates, emitted, out, dues[-1]
+            else:
+                def pstep(states, tstates, emitted, buf):
+                    batch, now = unpack_buffer(schema, enc, capacity, buf)
+                    return chain(states, tstates, emitted, batch, now)
+
             fn = jax.jit(pstep)
             self._packed_steps[(enc, capacity)] = fn
         return fn
+
+    # sort-heavy queries cap the COMPILED sort width via the in-step scan
+    # (see _packed_step_for), so the packed transfer chunk can be larger
+    # than max_step_capacity — but not unbounded: XLA compile time of the
+    # scanned step grows with total capacity (k=8 sub-steps: ~53 s;
+    # k=128: ~452 s, measured), so packed chunks cap at 64k rows
+    # (8 dispatches/1M events instead of 123, ~2x the throughput of
+    # dispatch-per-8k with a first-compile cost that stays bounded)
+    SCAN_CHUNK_CAP = 65536
+
+    @property
+    def max_packed_capacity(self):
+        return None if self.max_step_capacity is None \
+            else max(self.SCAN_CHUNK_CAP, self.max_step_capacity)
 
     def process_packed(self, chunk: PackedChunk) -> None:
         lat = self._stats_mark(chunk.n)
@@ -1366,6 +1426,10 @@ class SiddhiAppRuntime:
         for s in self.sources:
             s.pause()
         try:
+            # drain @Async buffers so queued events land in the snapshot
+            for j in self.junctions.values():
+                if j.async_conf is not None:
+                    j.flush_async()
             store.save(self.name, rev, self.snapshot())
         finally:
             for s in self.sources:
@@ -1397,8 +1461,20 @@ class SiddhiAppRuntime:
     clearAllRevisions = clear_all_revisions
 
     def shutdown(self) -> None:
+        self.running = False  # reject new sends before draining
+        flush_errors = []
+        for j in self.junctions.values():
+            if j.async_conf is not None:
+                try:
+                    j.flush_async()
+                except Exception as e:  # noqa: BLE001 — shutdown must finish
+                    flush_errors.append((j.stream_id, e))
+                finally:
+                    j.stop_async()
+        if flush_errors:
+            print(f"[siddhi_tpu] app '{self.name}': async streams did not "
+                  f"drain cleanly on shutdown: {flush_errors}")
         self._resolve_dues()
-        self.running = False
         for s in self.sources:
             s.disconnect()
         for s in self.sinks:
@@ -1439,6 +1515,18 @@ class Planner:
                 Attribute(a.name, a.type) for a in sd.attributes))
             j = app.junction_for(sid, schema)
             app.input_handlers[sid] = InputHandler(sid, j, app)
+            asy = A.find_annotation(sd.annotations, "Async")
+            if asy is not None:
+                # @Async(buffer.size, workers, batch.size.max)
+                # (StreamJunction.java:101-131; batch.size.max is the
+                # reference's latency/throughput dial, ours too)
+                buf = int(asy.element("buffer.size") or 1024)
+                batch_max = int(asy.element("batch.size.max") or buf)
+                if batch_max <= 0 or buf <= 0:
+                    raise CompileError(
+                        f"stream '{sid}': @Async buffer.size and "
+                        "batch.size.max must be positive")
+                j.enable_async(app, buf, batch_max)
             oe = A.find_annotation(sd.annotations, "OnError")
             if oe is not None:
                 action = (oe.element("action") or "LOG").upper()

@@ -3,7 +3,13 @@
 Reference mapping:
 - Event (io.siddhi.core.event.Event)            -> Event dataclass
 - StreamJunction (stream/StreamJunction.java:61) -> StreamJunction (sync pub/sub;
-  async micro-batch pipelining is a junction option, see @Async in runtime.py)
+  `@Async(buffer.size, workers, batch.size.max)` switches it to a bounded
+  host-side micro-batch queue drained by a worker thread — the TPU-shaped
+  stand-in for the reference's LMAX Disruptor ring buffer,
+  StreamJunction.java:276-313. batch.size.max is the latency/throughput
+  dial: small batches -> low latency, large -> high throughput; on the
+  columnar send_arrays path it caps the device chunk size instead, since
+  that path already pipelines device-side without a thread hop.)
 - InputHandler (stream/input/InputHandler.java:28) -> InputHandler
 - StreamCallback (stream/output/StreamCallback.java:38) -> StreamCallback
 - QueryCallback (query/output/callback/QueryCallback.java:37) -> QueryCallback
@@ -37,6 +43,17 @@ class Receiver:
         raise NotImplementedError
 
 
+# sentinel that stops an @Async junction's drain worker (a dedicated
+# object, not None: the sentinel can be dequeued mid-coalesce and must
+# survive the carry slot)
+_STOP = object()
+
+# set while a drain worker holds the app barrier dispatching a batch —
+# lets chained @Async publishes detect they must not block on a full
+# downstream buffer (see StreamJunction.publish)
+_IN_DISPATCH = threading.local()
+
+
 class StreamJunction:
     """Per-stream pub/sub hub. Synchronous: publish calls every receiver
     inline, preserving the reference's sync-mode semantics
@@ -49,9 +66,99 @@ class StreamJunction:
         self.fault_junction: Optional["StreamJunction"] = None
         self.on_error_action: str = "LOG"
         self._lock = threading.Lock()
+        # @Async state (None = synchronous junction)
+        self.async_conf: Optional[tuple[int, int]] = None  # (buffer, batch)
+        self._queue = None
+        self._worker: Optional[threading.Thread] = None
+        self._drained = threading.Condition()
+        self._pending = 0
+        self._app = None
 
     def subscribe(self, receiver: Receiver) -> None:
         self.receivers.append(receiver)
+
+    # -- @Async micro-batch pipeline -------------------------------------
+    def enable_async(self, app, buffer_size: int, batch_max: int) -> None:
+        """Switch to async mode: publishes enqueue into a bounded buffer
+        (backpressure blocks the producer, like the Disruptor's
+        BlockingWaitStrategy) and one worker drains it, coalescing up to
+        batch.size.max events per dispatch (StreamHandler batching).
+        `workers` collapses to one: device steps serialize on the chip, so
+        extra host threads only add contention."""
+        import queue as _q
+        self.async_conf = (int(buffer_size), int(batch_max))
+        self._queue = _q.Queue(maxsize=int(buffer_size))
+        self._app = app
+        self._worker = threading.Thread(
+            target=self._drain_loop, name=f"async-{self.stream_id}",
+            daemon=True)
+        self._worker.start()
+
+    def _drain_loop(self) -> None:
+        # publishes are pre-split to <= batch.size.max at enqueue, so this
+        # only ever coalesces whole items (order preserved via `carry`;
+        # the _STOP sentinel also rides the carry slot so it is never
+        # lost when dequeued mid-coalesce)
+        import queue as _q
+        _, batch_max = self.async_conf
+        carry = None
+        while True:
+            item = carry if carry is not None else self._queue.get()
+            carry = None
+            if item is _STOP:
+                with self._drained:
+                    self._pending -= 1
+                    self._drained.notify_all()
+                return
+            batch = list(item)
+            n_items = 1
+            while len(batch) < batch_max:
+                try:
+                    nxt = self._queue.get_nowait()
+                except _q.Empty:
+                    break
+                if nxt is _STOP or len(batch) + len(nxt) > batch_max:
+                    carry = nxt
+                    break
+                batch.extend(nxt)
+                n_items += 1
+            _IN_DISPATCH.active = True
+            try:
+                with self._app.barrier:
+                    self._app.on_ingest(self.stream_id, batch)
+                    self._publish_sync(batch)
+            finally:
+                _IN_DISPATCH.active = False
+            with self._drained:
+                self._pending -= n_items
+                self._drained.notify_all()
+
+    def flush_async(self, timeout: float = 30.0) -> None:
+        """Block until every queued publish has been dispatched."""
+        if self._queue is None:
+            return
+        import time as _t
+        deadline = _t.monotonic() + timeout
+        with self._drained:
+            while self._pending > 0:
+                remaining = deadline - _t.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"@Async stream '{self.stream_id}' did not drain "
+                        f"within {timeout}s ({self._pending} pending)")
+                self._drained.wait(remaining)
+
+    def stop_async(self) -> None:
+        if self._worker is None:
+            return
+        with self._drained:
+            self._pending += 1
+        self._queue.put(_STOP)
+        self._worker.join(timeout=10)
+        self._worker = None
+        # later publishes fall back to the sync path instead of feeding a
+        # dead queue (sends are already rejected by the running check)
+        self._queue = None
 
     def _handle_error(self, events: Optional[list[Event]],
                       exc: Exception) -> None:
@@ -73,6 +180,38 @@ class StreamJunction:
     def publish(self, events: list[Event]) -> None:
         if not events:
             return
+        if self._queue is not None:
+            # async mode: enqueue in <= batch.size.max slices; a full
+            # buffer blocks the producer (Disruptor BlockingWaitStrategy).
+            # EXCEPT when the producer is itself a drain worker holding
+            # the app barrier (chained @Async streams): blocking there
+            # deadlocks — no other worker can take the barrier to drain
+            # this queue — so the slice is dispatched inline instead
+            # (possible reordering against queued items, only in the
+            # already-pathological full-buffer case; the reference's
+            # Disruptor deadlocks outright in the same cycle).
+            import queue as _q
+            _, batch_max = self.async_conf
+            slices = [events[i:i + batch_max]
+                      for i in range(0, len(events), batch_max)]
+            for s in slices:
+                if getattr(_IN_DISPATCH, "active", False):
+                    try:
+                        with self._drained:
+                            self._pending += 1
+                        self._queue.put_nowait(s)
+                    except _q.Full:
+                        with self._drained:
+                            self._pending -= 1
+                        self._publish_sync(s)
+                else:
+                    with self._drained:
+                        self._pending += 1
+                    self._queue.put(s)
+            return
+        self._publish_sync(events)
+
+    def _publish_sync(self, events: list[Event]) -> None:
         for r in list(self.receivers):
             try:
                 r.receive(events)
@@ -135,6 +274,11 @@ class InputHandler:
             events = [Event(timestamp=now(), data=tuple(d)) for d in data]
         else:
             events = [Event(timestamp=now(), data=tuple(data))]
+        if self.junction._queue is not None:
+            # @Async: hand off to the junction's worker, which advances
+            # the clock when the batch is actually dispatched
+            self.junction.publish(events)
+            return
         with self.app.barrier:
             self.app.on_ingest(self.stream_id, events)
             self.junction.publish(events)
@@ -163,11 +307,23 @@ class InputHandler:
         max_cap = BATCH_BUCKETS[-1]
         # sort-heavy receivers cap their step capacity (see runtime.py
         # SORT_HEAVY_CAP): chunk accordingly so every receiver can consume
-        # the chunk without re-splitting
+        # the chunk without re-splitting. Packed consumers that scan
+        # sub-batches inside the step (max_packed_capacity=None) take the
+        # whole chunk in one dispatch instead.
         for r in self.junction.receivers:
-            rc = getattr(r, "max_step_capacity", None)
+            if packed_ok:
+                rc = getattr(r, "max_packed_capacity",
+                             getattr(r, "max_step_capacity", None))
+            else:
+                rc = getattr(r, "max_step_capacity", None)
             if rc is not None:
                 max_cap = min(max_cap, rc)
+        if self.junction.async_conf is not None:
+            # @Async batch.size.max caps the device chunk on the columnar
+            # path — the latency/throughput dial (small chunks = low
+            # latency, big = throughput); no thread hop is added since
+            # packed dispatch already pipelines device-side
+            max_cap = min(max_cap, self.junction.async_conf[1])
         for start in range(0, n, max_cap):
             t = ts[start:start + max_cap]
             c = [col[start:start + max_cap] for col in cols]

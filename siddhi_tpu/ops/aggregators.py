@@ -36,7 +36,6 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.event import (CURRENT, EXPIRED, RESET, Attribute, EventBatch,
                           StreamSchema)

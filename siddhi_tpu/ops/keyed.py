@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 # sentinel for "row not placed in any slot"
 from .sentinels import NO_SLOT  # noqa: F401

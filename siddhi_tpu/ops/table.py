@@ -24,7 +24,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.event import (CURRENT, EXPIRED, Attribute, EventBatch,
                           StreamSchema)

@@ -37,7 +37,6 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..core.event import CURRENT, EXPIRED, RESET, EventBatch, StreamSchema
 from ..core.types import np_dtype

@@ -1,0 +1,129 @@
+"""@Async stream pipelining (StreamJunction.java:101-131, 276-313).
+
+The reference switches an @Async stream's junction to an LMAX Disruptor
+ring buffer with worker threads batching up to batch.size.max events.
+Here the junction gets a bounded host-side queue drained by one worker
+that coalesces micro-batches — same knobs, same backpressure contract
+(full buffer blocks the producer).
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+
+
+def _app(extra=""):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(f"""
+        @app:playback
+        @Async(buffer.size='64', batch.size.max='8'{extra})
+        define stream S (v int);
+        @info(name = 'q')
+        from S[v > 10] select v insert into O;
+    """)
+    return rt
+
+
+def test_async_results_match_sync():
+    rt = _app()
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(50):
+        h.send(Event(1000 + i, (i,)))
+    rt.junctions["S"].flush_async()
+    assert [e.data[0] for e in got] == list(range(11, 50))
+    rt.shutdown()
+
+
+def test_async_coalesces_batches():
+    rt = _app()
+    seen_sizes = []
+    q = rt.queries["q"]
+    orig = q.receive
+
+    def spy(events):
+        seen_sizes.append(len(events))
+        return orig(events)
+
+    q.receive = spy
+    rt.start()
+    h = rt.get_input_handler("S")
+    # one oversize publish must be split to batch.size.max slices
+    h.send([Event(1000 + i, (i,)) for i in range(20)])
+    rt.junctions["S"].flush_async()
+    assert seen_sizes and max(seen_sizes) <= 8
+    assert sum(seen_sizes) == 20
+    rt.shutdown()
+
+
+def test_async_flush_on_shutdown_delivers_everything():
+    rt = _app()
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(30):
+        h.send(Event(1000 + i, (100 + i,)))
+    rt.shutdown()  # flushes the queue before stopping the worker
+    assert len(got) == 30
+
+
+def test_async_send_arrays_caps_chunk():
+    rt = _app()
+    q = rt.queries["q"]
+    caps = []
+    orig = q.process_packed
+
+    def spy(chunk):
+        caps.append(chunk.n)
+        return orig(chunk)
+
+    q.process_packed = spy
+    outs = []
+    q.batch_callbacks.append(outs.append)
+    rt.start()
+    h = rt.get_input_handler("S")
+    n = 64
+    h.send_arrays(np.arange(1000, 1000 + n, dtype=np.int64),
+                  [np.arange(n, dtype=np.int32)])
+    # batch.size.max=8 caps the columnar chunk (latency dial)
+    assert caps and max(caps) <= 8 and sum(caps) == n
+    rt.shutdown()
+
+
+def test_chained_async_streams_no_deadlock():
+    """A (@Async) -> query -> B (@Async, tiny buffer) -> query -> O.
+    A's drain worker publishes into B while holding the app barrier; a
+    full B buffer must dispatch inline instead of deadlocking."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:playback
+        @Async(buffer.size='16', batch.size.max='4')
+        define stream A (v int);
+        @Async(buffer.size='2', batch.size.max='2')
+        define stream B (v int);
+        from A[v >= 0] select v insert into B;
+        @info(name = 'q2')
+        from B select v insert into O;
+    """)
+    got = []
+    rt.add_callback("O", StreamCallback(lambda evs: got.extend(evs)))
+    rt.start()
+    h = rt.get_input_handler("A")
+    for i in range(200):
+        h.send(Event(1000 + i, (i,)))
+    rt.shutdown()  # flushes both queues
+    assert sorted(e.data[0] for e in got) == list(range(200))
+
+
+def test_async_bad_params_rejected():
+    mgr = SiddhiManager()
+    from siddhi_tpu.ops.expr import CompileError
+    with pytest.raises(CompileError):
+        mgr.create_siddhi_app_runtime("""
+            @Async(buffer.size='0')
+            define stream S (v int);
+            from S select v insert into O;
+        """)

@@ -1,0 +1,76 @@
+"""Scanned packed steps: sort-heavy queries consume large packed chunks
+via an in-step lax.scan over max_step_capacity-row sub-batches (one device
+dispatch per chunk) — outputs must be identical to the host-side split
+path the row route still uses (core/runtime.py _packed_step_for).
+"""
+import numpy as np
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.event import rows_from_batch
+
+QL = """
+    @app:playback
+    define stream S (sym int, price float);
+    @info(name = 'q')
+    from S#window.lengthBatch(997)
+    select sum(price) as total, count() as n
+    insert into O;
+"""
+
+N = 20_000
+TS0 = 1_600_000_000_000
+
+
+def _run(send):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(QL)
+    q = rt.queries["q"]
+    outs = []
+    q.batch_callbacks.append(outs.append)
+    rt.start()
+    send(rt.get_input_handler("S"))
+    rows = []
+    for o in outs:
+        rows.extend(rows_from_batch(q.out_schema.types, o))
+    rt.shutdown()
+    return [(ts, kind, vals) for ts, kind, vals in rows]
+
+
+def test_scanned_packed_matches_split_rows():
+    rng = np.random.default_rng(42)
+    ts = TS0 + np.arange(N, dtype=np.int64)
+    sym = rng.integers(0, 5, N).astype(np.int32)
+    price = rng.uniform(0, 100, N).astype(np.float32)
+
+    def send_big(h):
+        h.send_arrays(ts, [sym, price])          # one 65536-bucket chunk
+
+    def send_split(h):
+        for s in range(0, N, 4096):              # forced small chunks
+            h.send_arrays(ts[s:s + 4096],
+                          [sym[s:s + 4096], price[s:s + 4096]])
+
+    big = _run(send_big)
+    small = _run(send_split)
+    assert len(big) == len(small) == N // 997  # one agg row per flush
+    for (ts_a, k_a, v_a), (ts_b, k_b, v_b) in zip(big, small):
+        assert (ts_a, k_a) == (ts_b, k_b)
+        assert abs(v_a[0] - v_b[0]) < 1e-2
+        assert v_a[1] == v_b[1]
+
+
+def test_scan_engages_one_dispatch():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(QL)
+    q = rt.queries["q"]
+    chunks = []
+    orig = q.process_packed
+    q.process_packed = lambda c: (chunks.append(c.capacity), orig(c))
+    rt.start()
+    rng = np.random.default_rng(1)
+    ts = TS0 + np.arange(N, dtype=np.int64)
+    rt.get_input_handler("S").send_arrays(
+        ts, [rng.integers(0, 5, N).astype(np.int32),
+             rng.uniform(0, 100, N).astype(np.float32)])
+    assert chunks == [65536]  # whole send in ONE scanned dispatch
+    rt.shutdown()
