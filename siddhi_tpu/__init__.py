@@ -5,11 +5,29 @@ A from-scratch re-design of the capabilities of the reference Siddhi engine
 micro-batches through pure, jitted (state, batch) -> (state', out) step
 functions on TPU.
 """
+import os
+
 import jax
 
 # Java long/double semantics (bit-parity with the reference) require 64-bit
 # types; must be set before any array is created.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: TPU first-compiles of window/NFA steps
+# run 20-60 s; caching makes every later process start in ~2 s (measured).
+# Opt out with SIDDHI_TPU_NO_CACHE=1 or point elsewhere with
+# SIDDHI_TPU_CACHE_DIR.
+if not os.environ.get("SIDDHI_TPU_NO_CACHE"):
+    _cache = os.environ.get(
+        "SIDDHI_TPU_CACHE_DIR",
+        os.path.join(os.path.expanduser("~"), ".cache", "siddhi_tpu",
+                     "xla"))
+    try:
+        os.makedirs(_cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 — cache is best-effort
+        pass
 
 from .core.manager import SiddhiManager  # noqa: E402
 from .core.persistence import (  # noqa: E402

@@ -1761,7 +1761,8 @@ class Planner:
         return cls
 
     def make_window(self, h: A.WindowHandler, schema: StreamSchema,
-                    expired_enabled: bool) -> WindowOp:
+                    expired_enabled: bool,
+                    cap_override: Optional[int] = None) -> WindowOp:
         name = h.name if h.namespace is None else f"{h.namespace}:{h.name}"
         params = []
         for p in h.parameters:
@@ -1774,6 +1775,7 @@ class Planner:
                     f"window '{name}' parameters must be constants or "
                     "attributes")
         key = name.lower()
+        time_cap = cap_override or self.DEFAULT_TIME_CAP
 
         def attr_idx(p, role):
             if not isinstance(p, A.Variable):
@@ -1794,7 +1796,7 @@ class Planner:
         if key == "time":
             _expect(params, 1, name)
             return TimeWindowOp(schema, _ms(params[0], name),
-                                cap=self.DEFAULT_TIME_CAP,
+                                cap=time_cap,
                                 expired_enabled=expired_enabled)
         if key == "length":
             _expect(params, 1, name)
@@ -1816,7 +1818,7 @@ class Planner:
                 if len(params) == 2 else None
             return TimeBatchWindowOp(schema, _ms(params[0], name),
                                      start_time=start,
-                                     cap=self.DEFAULT_TIME_CAP,
+                                     cap=time_cap,
                                      expired_enabled=expired_enabled)
         if key == "externaltimebatch":
             if len(params) not in (2, 3):
@@ -1829,7 +1831,7 @@ class Planner:
                 if len(params) == 3 else None
             return ExternalTimeBatchWindowOp(
                 schema, ti, _ms(params[1], name), start_time=start,
-                cap=self.DEFAULT_TIME_CAP, expired_enabled=expired_enabled)
+                cap=time_cap, expired_enabled=expired_enabled)
         if key == "externaltime":
             _expect(params, 2, name)
             ti = attr_idx(params[0], "timestamp parameter")
@@ -1837,7 +1839,7 @@ class Planner:
                 raise CompileError(
                     f"window '{name}' timestamp attribute must be LONG")
             return ExternalTimeWindowOp(schema, ti, _ms(params[1], name),
-                                        cap=self.DEFAULT_TIME_CAP,
+                                        cap=time_cap,
                                         expired_enabled=expired_enabled)
         if key == "timelength":
             _expect(params, 2, name)
@@ -1847,13 +1849,13 @@ class Planner:
         if key == "delay":
             _expect(params, 1, name)
             return DelayWindowOp(schema, _ms(params[0], name),
-                                 cap=self.DEFAULT_TIME_CAP,
+                                 cap=time_cap,
                                  expired_enabled=expired_enabled)
         if key == "batch":
             if len(params) > 1:
                 raise CompileError(f"{name} takes 0-1 parameters")
             length = int(const_of(params[0], 'length')) if params else 0
-            return BatchWindowOp(schema, length, cap=self.DEFAULT_TIME_CAP,
+            return BatchWindowOp(schema, length, cap=time_cap,
                                  expired_enabled=expired_enabled)
         if key == "cron":
             _expect(params, 1, name)
@@ -1863,7 +1865,7 @@ class Planner:
             from ..utils.cron import CronError
             try:
                 return CronWindowOp(schema, params[0],
-                                    cap=self.DEFAULT_TIME_CAP,
+                                    cap=time_cap,
                                     expired_enabled=expired_enabled)
             except CronError as e:
                 raise CompileError(f"window '{name}': {e}")
@@ -2024,6 +2026,7 @@ class Planner:
         (= SingleInputStreamParser.parseInputStream + SelectorParser)."""
         app = self.app
         needs_agg = selector_needs_aggregation(q.selector)
+        cap_window, _ = self._cap_annotation(q)
         operators: list[Operator] = []
         window_op: Optional[WindowOp] = None
         for h in sin.handlers:
@@ -2056,7 +2059,8 @@ class Planner:
                 # (outputExpectsExpiredEvents in the reference)
                 expired_enabled = expired_on if cls.is_batch \
                     else (expired_on or needs_agg)
-                window_op = self.make_window(h, schema, expired_enabled)
+                window_op = self.make_window(h, schema, expired_enabled,
+                                             cap_override=cap_window)
                 operators.append(window_op)
             else:
                 from ..ops.streamfn import make_stream_function
@@ -2143,10 +2147,39 @@ class Planner:
                 InsertIntoStreamHandler(tj, out_type))
 
     # -- join queries ----------------------------------------------------
+    @staticmethod
+    def _cap_annotation(q: A.Query):
+        """`@cap(window.size='N', join.pairs='M')` — bounded-state tuning
+        knob (the reference's queues are unbounded; ours are static-shape
+        device buffers, so capacity is an explicit per-query dial).
+        window.size: rows a time-based window retains; join.pairs: max
+        joined pairs emitted per step (overflow is counted, never
+        silent)."""
+        ca = A.find_annotation(q.annotations, "cap")
+        if ca is None:
+            return None, None
+
+        def to_int(v, key):
+            if v is None:
+                return None
+            try:
+                n = int(v)
+            except ValueError:
+                raise CompileError(
+                    f"@cap({key}='{v}'): expected a positive integer")
+            if n <= 0:
+                raise CompileError(
+                    f"@cap({key}='{v}'): expected a positive integer")
+            return n
+
+        return (to_int(ca.element("window.size"), "window.size"),
+                to_int(ca.element("join.pairs"), "join.pairs"))
+
     def plan_join_query(self, q: A.Query, name: str) -> None:
         app = self.app
         jin: A.JoinInputStream = q.input
         out = q.output
+        cap_window, cap_pairs = self._cap_annotation(q)
         if isinstance(out, (A.InsertIntoStream, A.ReturnStream)):
             out_type = out.output_event_type
         else:
@@ -2177,7 +2210,8 @@ class Planner:
                     cls = self.window_class(h)
                     expired_enabled = expired_on if cls.is_batch \
                         else True  # joins need expired pairs for aggregates
-                    window = self.make_window(h, schema, expired_enabled)
+                    window = self.make_window(h, schema, expired_enabled,
+                                              cap_override=cap_window)
                     ops.append(window)
                 else:
                     raise CompileError(
@@ -2223,12 +2257,15 @@ class Planner:
                                    r_schema, jin.right.alias)
         jschema = combined_schema(target, l_schema, r_schema)
         crosses = {"L": None, "R": None}
+        join_cap = cap_pairs or 1024
         if jin.unidirectional != "right" and not l_is_table:
             crosses["L"] = JoinCross(True, l_schema, r_schema, jin.on,
-                                     side_scope, jin.join_type)
+                                     side_scope, jin.join_type,
+                                     join_cap=join_cap)
         if jin.unidirectional != "left" and not r_is_table:
             crosses["R"] = JoinCross(False, l_schema, r_schema, jin.on,
-                                     side_scope, jin.join_type)
+                                     side_scope, jin.join_type,
+                                     join_cap=join_cap)
 
         sel_scope = JoinCombinedScope(side_scope, len(l_schema.types))
         if needs_agg:

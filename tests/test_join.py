@@ -198,3 +198,22 @@ class TestStreamTableJoin:
         rt.get_input_handler("S").send(Event(2000, ("IBM", 5)))
         rt.shutdown()
         assert [tuple(e.data) for e in got] == [("IBM", 75.0)]
+
+
+def test_cap_annotation_sizes_window_and_pairs():
+    """@cap(window.size, join.pairs) — the bounded-state tuning dial
+    (static device buffers replace the reference's unbounded queues)."""
+    from siddhi_tpu import SiddhiManager
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        define stream L (k int);
+        define stream R (k int);
+        @info(name = 'q') @cap(window.size='256', join.pairs='4096')
+        from L#window.time(1 sec) join R#window.time(1 sec) on L.k == R.k
+        select L.k as k insert into O;
+    """)
+    q = rt.queries["q"]
+    assert q.side_ops["L"][-1].cap == 256
+    assert q.side_ops["R"][-1].cap == 256
+    assert q.crosses["L"].cap == 4096
+    assert q.crosses["R"].cap == 4096
