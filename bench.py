@@ -329,39 +329,45 @@ def bench_seq5(n=1_048_576, chunk=65_536):
     rng = np.random.default_rng(12)
     syms = np.array([GLOBAL_STRINGS.encode(s) for s in SYMS], np.int32)
 
-    def mk(i, m):
-        ts = TS0 + np.arange(m, dtype=np.int64) + i * m
+    # one monotone clock across ALL passes — a rewound playback clock
+    # would let stale within-60s partials from earlier passes pollute
+    # the small-chunk latency measurement
+    clock = [TS0]
+
+    def mk(m):
+        ts = clock[0] + np.arange(m, dtype=np.int64)
+        clock[0] += m
         sym = syms[rng.integers(0, len(syms), m)]
         stage = rng.integers(1, 6, m).astype(np.int32)
         v = rng.integers(0, 1000, m).astype(np.int32)
         return ts, [sym, stage, v]
 
-    h.send_arrays(*mk(0, chunk))
+    h.send_arrays(*mk(chunk))
     _drain(outs)
     n_chunks = n // chunk
     # throughput pass: pipelined sends, one drain at the end (the
     # reference harness also measures throughput streaming)
     t0 = time.perf_counter()
-    for i in range(1, n_chunks + 1):
-        h.send_arrays(*mk(i, chunk))
+    for _ in range(n_chunks):
+        h.send_arrays(*mk(chunk))
     _drain(outs)
     dt = time.perf_counter() - t0
     # latency pass: per-chunk sync measures send -> matches visible
     lat = []
-    for i in range(n_chunks + 1, n_chunks + 9):
+    for _ in range(8):
         c0 = time.perf_counter()
-        h.send_arrays(*mk(i, chunk))
+        h.send_arrays(*mk(chunk))
         _drain(outs)
         lat.append(time.perf_counter() - c0)
     # small-chunk latency mode: batch.size.max-style dial at 1024 rows —
     # honest match latency, not throughput wearing a latency label
     small = 1024
-    h.send_arrays(*mk(2 * n_chunks + 16, small))   # warm the 1024 bucket
+    h.send_arrays(*mk(small))   # warm the 1024 bucket
     _drain(outs)
     lat1k = []
-    for i in range(2 * n_chunks + 17, 2 * n_chunks + 81):
+    for _ in range(64):
         c0 = time.perf_counter()
-        h.send_arrays(*mk(i, small))
+        h.send_arrays(*mk(small))
         _drain(outs)
         lat1k.append(time.perf_counter() - c0)
     rt.shutdown()

@@ -41,7 +41,7 @@ from ..ops.windows2 import (BatchWindowOp, CronWindowOp, DelayWindowOp,
                             ExternalTimeWindowOp, FrequentWindowOp,
                             LossyFrequentWindowOp, SessionWindowOp,
                             SortWindowOp, TimeLengthWindowOp)
-from ..ops.windows import (POS_INF, LengthBatchWindowOp, LengthWindowOp,
+from ..ops.windows import (NEG_INF, POS_INF, LengthBatchWindowOp, LengthWindowOp,
                            TimeBatchWindowOp, TimeWindowOp, WindowOp)
 from .event import (CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema,
                     batch_from_rows, rows_from_batch)
@@ -306,7 +306,7 @@ class QueryRuntime(Receiver):
                 for d in dues[1:]:
                     due = jnp.minimum(due, d)
             else:
-                due = jnp.int64(2 ** 62)
+                due = jnp.asarray(POS_INF)
             emitted = emitted + batch.count().astype(jnp.int64)
             return tuple(new_states), tstates, emitted, batch, due
 
@@ -358,7 +358,7 @@ class QueryRuntime(Receiver):
                     for d in dues[1:]:
                         due = jnp.minimum(due, d)
                 else:
-                    due = jnp.int64(2 ** 62)
+                    due = jnp.asarray(POS_INF)
                 emitted = emitted + batch.count().astype(jnp.int64)
                 return tuple(new_states), tstates, emitted, batch, due
 
@@ -376,7 +376,7 @@ class QueryRuntime(Receiver):
                         if playback:
                             sub_now = jnp.maximum(run_ts, jnp.max(
                                 jnp.where(sub.valid, sub.ts,
-                                          jnp.int64(-(2 ** 62)))))
+                                          jnp.asarray(NEG_INF))))
                         else:
                             sub_now = now
                         states, tstates, emitted, out, due = chain(
@@ -385,7 +385,7 @@ class QueryRuntime(Receiver):
                                 (out, due))
 
                     carry0 = (states, tstates, emitted,
-                              jnp.int64(-(2 ** 62)))
+                              jnp.asarray(NEG_INF))
                     (states, tstates, emitted, _), (outs, dues) = \
                         jax.lax.scan(body, carry0, subs)
                     out = jax.tree_util.tree_map(
@@ -1016,11 +1016,11 @@ class JoinQueryRuntime(QueryRuntime):
                     dues = [op.next_due(st) for op, st in
                             zip(my_ops, new_my) if isinstance(op, WindowOp)]
                     dues = [d for d in dues if d is not None]
-                    due = dues[0] if dues else jnp.int64(2 ** 62)
+                    due = dues[0] if dues else jnp.asarray(POS_INF)
                     for d in dues[1:]:
                         due = jnp.minimum(due, d)
                 else:
-                    due = jnp.int64(2 ** 62)
+                    due = jnp.asarray(POS_INF)
                 return (tuple(new_my), tuple(new_sel), tstates, joined,
                         lost, due)
 
@@ -1520,12 +1520,22 @@ class Planner:
                 # @Async(buffer.size, workers, batch.size.max)
                 # (StreamJunction.java:101-131; batch.size.max is the
                 # reference's latency/throughput dial, ours too)
-                buf = int(asy.element("buffer.size") or 1024)
-                batch_max = int(asy.element("batch.size.max") or buf)
-                if batch_max <= 0 or buf <= 0:
-                    raise CompileError(
-                        f"stream '{sid}': @Async buffer.size and "
-                        "batch.size.max must be positive")
+                def async_int(key, default):
+                    v = asy.element(key)
+                    if v is None:
+                        return default
+                    try:
+                        n = int(v)
+                    except ValueError:
+                        n = 0
+                    if n <= 0:
+                        raise CompileError(
+                            f"stream '{sid}': @Async {key}='{v}' must be "
+                            "a positive integer")
+                    return n
+
+                buf = async_int("buffer.size", 1024)
+                batch_max = async_int("batch.size.max", buf)
                 j.enable_async(app, buf, batch_max)
             oe = A.find_annotation(sd.annotations, "OnError")
             if oe is not None:

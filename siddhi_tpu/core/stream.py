@@ -180,7 +180,8 @@ class StreamJunction:
     def publish(self, events: list[Event]) -> None:
         if not events:
             return
-        if self._queue is not None:
+        queue = self._queue  # snapshot: stop_async may null it concurrently
+        if queue is not None:
             # async mode: enqueue in <= batch.size.max slices; a full
             # buffer blocks the producer (Disruptor BlockingWaitStrategy).
             # EXCEPT when the producer is itself a drain worker holding
@@ -199,15 +200,20 @@ class StreamJunction:
                     try:
                         with self._drained:
                             self._pending += 1
-                        self._queue.put_nowait(s)
+                        queue.put_nowait(s)
                     except _q.Full:
                         with self._drained:
                             self._pending -= 1
-                        self._publish_sync(s)
+                        # inline dispatch still advances the clock (the
+                        # drain path does this before _publish_sync too);
+                        # the worker already holds the app barrier
+                        with self._app.barrier:
+                            self._app.on_ingest(self.stream_id, s)
+                            self._publish_sync(s)
                 else:
                     with self._drained:
                         self._pending += 1
-                    self._queue.put(s)
+                    queue.put(s)
             return
         self._publish_sync(events)
 

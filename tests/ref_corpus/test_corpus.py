@@ -1,0 +1,143 @@
+"""Replay the reference's pattern/sequence test corpus.
+
+Fixtures in this directory are machine-extracted from
+/root/reference/modules/siddhi-core/src/test/java/io/siddhi/core/query/
+{pattern,sequence}/** by tools/extract_ref_corpus.py (353 of 409 cases;
+the skipped remainder are loop-driven or API-built tests, listed with
+reasons inside each JSON). Each case replays the reference's exact app
+text, event data, and inter-send sleeps under @app:playback with a
+virtual clock, then asserts the reference's own expected rows/counts —
+the BASELINE.md "bit-equal outputs on the pattern test suite" claim,
+case by case.
+
+Queries using SiddhiQL features this framework rejects at compile time
+xfail with the CompileError message, keeping the remaining gap inventory
+visible in the test report instead of hidden.
+"""
+import json
+import pathlib
+
+import pytest
+
+from siddhi_tpu import Event, QueryCallback, SiddhiManager, StreamCallback
+from siddhi_tpu.ops.expr import CompileError
+
+DIR = pathlib.Path(__file__).parent
+T0 = 1_500_000_000_000
+
+# Cases where this framework's output does not yet match the reference —
+# the live parity worklist (each fix prunes lines). Listed cases still
+# REPLAY every run; a mismatch xfails, an unexpected pass XPASSes so
+# stale entries surface.
+KNOWN_FAILURES = frozenset(
+    ln.strip()
+    for ln in (DIR / "known_failures.txt").read_text().splitlines()
+    if ln.strip() and not ln.startswith("#"))
+
+
+def _cases():
+    out = []
+    for f in sorted(DIR.glob("*.json")):
+        d = json.loads(f.read_text())
+        stem = f.stem
+        for c in d["cases"]:
+            cid = f"{stem}.{c['name']}"
+            marks = ([pytest.mark.xfail(
+                reason="known output divergence (known_failures.txt)",
+                strict=False)] if cid in KNOWN_FAILURES else [])
+            out.append(pytest.param(c, id=cid, marks=marks))
+    return out
+
+
+def _rows_match(got, exp):
+    if len(got) != len(exp):
+        return False
+    for g, e in zip(got, exp):
+        if isinstance(e, float):
+            if g != pytest.approx(e, rel=1e-5, abs=1e-6):
+                return False
+        elif g != e:
+            return False
+    return True
+
+
+def _is_ordered_subset(got_rows, exp_rows):
+    i = 0
+    for g in got_rows:
+        if i < len(exp_rows) and _rows_match(list(g), exp_rows[i]):
+            i += 1
+    return i == len(exp_rows)
+
+
+@pytest.mark.parametrize("case", _cases())
+def test_ref_case(case):
+    mgr = SiddhiManager()
+    try:
+        rt = mgr.create_siddhi_app_runtime("@app:playback " + case["app"])
+    except CompileError as e:
+        pytest.xfail(f"unsupported construct: {e}")
+    state = {"in": 0, "rm": 0, "in_rows": [], "rm_rows": []}
+
+    def on_query(_ts, in_events, rm_events):
+        if in_events:
+            state["in"] += len(in_events)
+            state["in_rows"] += [tuple(e.data) for e in in_events]
+        if rm_events:
+            state["rm"] += len(rm_events)
+            state["rm_rows"] += [tuple(e.data) for e in rm_events]
+
+    def on_stream(events):
+        state["in"] += len(events)
+        state["in_rows"] += [tuple(e.data) for e in events]
+
+    targets = case["callbacks"] or list(rt.queries)
+    q_targets = [t for t in targets if t in rt.queries]
+    if q_targets:
+        for t in q_targets:
+            rt.add_callback(t, QueryCallback(fn=on_query))
+    else:
+        for t in targets:
+            rt.add_callback(t, StreamCallback(fn=on_stream))
+    rt.start()
+
+    clock = T0
+    for act in case["actions"]:
+        if act[0] == "send":
+            _, sid, row = act
+            rt.get_input_handler(sid).send(Event(clock, tuple(row)))
+            clock += 1
+        elif act[0] == "sleep":
+            clock += act[1]
+            with rt.barrier:
+                rt.on_ingest_ts(clock)
+        elif act[0] == "wait_in":
+            # TestUtil.waitForInEvents: poll sleepTime ms per round,
+            # stop when inEventCount == 1 or after retryCount rounds
+            _, sleep_ms, retries = act
+            for _ in range(retries):
+                clock += sleep_ms
+                with rt.barrier:
+                    rt.on_ingest_ts(clock)
+                if state["in"] == 1:
+                    break
+    rt.shutdown()
+
+    if case["expected_in"] is not None:
+        assert state["in"] == case["expected_in"], \
+            f"in-events {state['in']} != {case['expected_in']} " \
+            f"(rows={state['in_rows']})"
+    if case["expected_removed"] is not None:
+        assert state["rm"] == case["expected_removed"]
+    if case["event_arrived"] is not None:
+        arrived = state["in"] > 0 or state["rm"] > 0
+        assert arrived == case["event_arrived"]
+    exp_rows = case["expected_in_rows"]
+    if exp_rows:
+        got = state["in_rows"]
+        if case["row_mode"] == "exact":
+            assert len(got) == len(exp_rows) and all(
+                _rows_match(list(g), e) for g, e in zip(got, exp_rows)), \
+                f"rows {got} != {exp_rows}"
+        else:
+            assert _is_ordered_subset(got, exp_rows), \
+                f"rows {got} missing expected {exp_rows}"
