@@ -61,13 +61,13 @@ class InMemoryPersistenceStore(PersistenceStore):
             self._revisions.setdefault(app_name, {})[revision] = data
 
     def load(self, app_name, revision):
-        return self._revisions.get(app_name, {}).get(revision)
+        with self._lock:  # save() mutates nested dicts concurrently
+            return self._revisions.get(app_name, {}).get(revision)
 
     def get_last_revision(self, app_name):
-        revs = self._revisions.get(app_name)
-        if not revs:
-            return None
-        return sorted(revs)[-1]
+        with self._lock:
+            revs = self._revisions.get(app_name)
+            return sorted(revs)[-1] if revs else None
 
     def clear_all_revisions(self, app_name):
         with self._lock:
@@ -158,8 +158,43 @@ def serialize(payload: dict) -> bytes:
                         protocol=pickle.HIGHEST_PROTOCOL)
 
 
+class _SnapshotUnpickler(pickle.Unpickler):
+    """Restricted unpickler: snapshot payloads are pure data (numpy
+    arrays/scalars, containers, strings, numbers), so only numpy
+    reconstruction callables are allowed. A tampered revision file can
+    then corrupt state but NOT execute arbitrary code — the reference's
+    Java-serialization snapshots have the same class of weakness with no
+    such guard."""
+
+    _ALLOWED = {
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy.core.numeric", "_frombuffer"),
+        ("numpy._core.numeric", "_frombuffer"),
+        ("numpy", "ndarray"),
+        ("numpy", "dtype"),
+        ("numpy", "bool_"),
+    }
+
+    def find_class(self, module, name):
+        # exact allowlist + numpy.dtypes dtype classes ONLY — a broad
+        # "any public numpy callable" rule would admit gadgets like
+        # numpy.savetxt/fromfile (attacker-controlled file IO)
+        if (module, name) in self._ALLOWED or (
+                module == "numpy.dtypes" and name.endswith("DType")):
+            import importlib
+            mod = importlib.import_module(module)
+            return getattr(mod, name)
+        raise pickle.UnpicklingError(
+            f"snapshot refers to non-data callable {module}.{name} — "
+            "refusing to unpickle (tampered or incompatible revision)")
+
+
 def deserialize(data: bytes) -> dict:
-    payload = pickle.loads(data)
+    import io
+    payload = _SnapshotUnpickler(io.BytesIO(data)).load()
     if payload.get("format") != SNAPSHOT_FORMAT:
         raise ValueError(f"unsupported snapshot format "
                          f"{payload.get('format')!r}")

@@ -846,6 +846,7 @@ class PatternQueryRuntime(QueryRuntime):
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
         self._dispatch_output(out, chunk.last_ts)
+        self._schedule_absent()
 
     def process_stream_events(self, stream_id: str, events) -> None:
         schema = self.app.schemas[stream_id]
@@ -872,6 +873,11 @@ class PatternQueryRuntime(QueryRuntime):
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
         self._dispatch_output(out, timestamp)
+        # arm the scheduler at the earliest live absent deadline so the
+        # pattern fires on clock advance even when no further events come
+        # (AbsentStreamPreStateProcessor's scheduler role); costs one
+        # device readback per step, only for has_absent engines
+        self._schedule_absent()
 
 
 class JoinStreamReceiver(Receiver):

@@ -7,7 +7,15 @@ Reference mapping: modules/siddhi-service/ —
 plus GET /siddhi/artifacts (list deployed app names).
 
 A stdlib http.server on a daemon thread fronting a SiddhiManager — the
-reference uses MSF4J, the role is identical: remote lifecycle control."""
+reference uses MSF4J, the role is identical: remote lifecycle control.
+
+Security: deployed SiddhiQL can contain `define function f[python]`
+bodies that are evaluated at plan time (core/extension.py), so deploy is
+code execution by design. The service binds 127.0.0.1 by default; for
+any other host an `auth_token` is REQUIRED and checked against the
+`Authorization: Bearer <token>` header on every request, and script
+function definitions are rejected for service-deployed apps unless
+`allow_scripts=True` is passed explicitly."""
 from __future__ import annotations
 
 import json
@@ -16,11 +24,22 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 
+class DuplicateAppError(ValueError):
+    """Deploy of an app name that is already running (HTTP 409)."""
+
+
 class SiddhiService:
     def __init__(self, manager=None, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, auth_token: Optional[str] = None,
+                 allow_scripts: bool = False):
         from .manager import SiddhiManager
+        if host not in ("127.0.0.1", "localhost") and not auth_token:
+            raise ValueError(
+                "binding a non-loopback host requires auth_token= "
+                "(deploy evaluates script functions: code execution)")
         self.manager = manager or SiddhiManager()
+        self.auth_token = auth_token
+        self.allow_scripts = allow_scripts
         self._deployed: dict = {}
         service = self
 
@@ -36,18 +55,30 @@ class SiddhiService:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _authorized(self) -> bool:
+                if service.auth_token is None:
+                    return True
+                got = self.headers.get("Authorization", "")
+                return got == f"Bearer {service.auth_token}"
+
             def do_POST(self):
+                if not self._authorized():
+                    return self._send(401, {"error": "unauthorized"})
                 if self.path != "/siddhi/artifact/deploy":
                     return self._send(404, {"error": "not found"})
                 n = int(self.headers.get("Content-Length", 0))
                 text = self.rfile.read(n).decode()
                 try:
                     name = service.deploy(text)
+                except DuplicateAppError as e:
+                    return self._send(409, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — surface to client
                     return self._send(400, {"error": str(e)})
                 self._send(200, {"status": "deployed", "app": name})
 
             def do_GET(self):
+                if not self._authorized():
+                    return self._send(401, {"error": "unauthorized"})
                 if self.path.startswith("/siddhi/artifact/undeploy/"):
                     name = self.path.rsplit("/", 1)[-1]
                     if service.undeploy(name):
@@ -79,6 +110,20 @@ class SiddhiService:
 
     # -- operations -------------------------------------------------------
     def deploy(self, siddhi_ql: str) -> str:
+        # both checks run on the PARSED app before any runtime is built:
+        # a textual scan is comment-bypassable, and building a duplicate
+        # runtime would clobber the manager registry entry of the live one
+        from ..lang.parser import parse
+        app_ast = parse(siddhi_ql)
+        if not self.allow_scripts and app_ast.function_definitions:
+            raise ValueError(
+                "script function definitions are disabled for "
+                "service-deployed apps (pass allow_scripts=True to "
+                "accept remote code execution)")
+        if app_ast.name and app_ast.name in self._deployed:
+            raise DuplicateAppError(
+                f"app '{app_ast.name}' is already deployed — undeploy it "
+                "first")
         rt = self.manager.create_siddhi_app_runtime(siddhi_ql)
         rt.start()
         self._deployed[rt.name] = rt

@@ -429,34 +429,53 @@ class Parser:
         return self._parse_standard_stream()
 
     def _classify_input(self) -> str:
-        depth = 0
-        saw_binding = saw_every = saw_not = saw_join = False
+        """Scan the from-clause and decide standard/join/pattern/sequence.
+
+        Pattern/sequence signals (`->`, state-ref `=` bindings, `every`,
+        `not`, separator commas) count inside parenthesized GROUPS too —
+        `from (every e1=A -> e2=B) within 1 sec` is a pattern
+        (SiddhiQL.g4 every_pattern_source_chain nests freely) — but not
+        inside `[...]` filter expressions or `name(...)` call argument
+        lists, where the same tokens mean something else."""
+        saw_binding = saw_every = saw_not = saw_join = saw_comma = False
+        stack: list = []  # frames: 'group' | 'call' | 'expr'
         i = self.pos
         toks = self.toks
         while i < len(toks):
             t = toks[i]
             if t.kind == "EOF":
                 break
+            in_state = not any(f != "group" for f in stack)
             if t.kind == "OP":
-                if t.value in ("(", "["):
-                    depth += 1
+                if t.value == "[":
+                    stack.append("expr")
+                elif t.value == "(":
+                    prev = toks[i - 1] if i > self.pos else None
+                    is_call = prev is not None and (
+                        prev.kind == "ID"
+                        or (prev.kind == "KW" and prev.value not in (
+                            "from", "every", "not", "and", "or")))
+                    stack.append("call" if is_call else "group")
                 elif t.value in (")", "]"):
-                    depth -= 1
-                    if depth < 0:
+                    if not stack:
                         break
-                elif depth == 0:
+                    stack.pop()
+                elif in_state:
                     if t.value == "->":
                         return "pattern"
                     if t.value == ",":
-                        # a top-level comma inside a join input only occurs in
-                        # `within start, end` (SiddhiQL.g4 within_time_range),
-                        # which always follows the JOIN keyword
-                        return "join" if saw_join else "sequence"
+                        if not stack:
+                            # a top-level comma inside a join input only
+                            # occurs in `within start, end`
+                            # (SiddhiQL.g4 within_time_range), which
+                            # always follows the JOIN keyword
+                            return "join" if saw_join else "sequence"
+                        saw_comma = True  # sequence sep inside a group
                     if (t.value == "=" and i > self.pos
                             and toks[i - 1].kind in ("ID", "KW")):
                         saw_binding = True
-            elif t.kind == "KW" and depth == 0:
-                if t.value in _OUTPUT_BOUNDARY_KWS:
+            elif t.kind == "KW" and in_state:
+                if not stack and t.value in _OUTPUT_BOUNDARY_KWS:
                     break
                 if t.value == "join":
                     saw_join = True
@@ -467,6 +486,8 @@ class Parser:
             i += 1
         if saw_join:
             return "join"
+        if saw_comma and (saw_binding or saw_every or saw_not):
+            return "sequence"
         if saw_binding or saw_every or saw_not:
             return "pattern"
         return "standard"

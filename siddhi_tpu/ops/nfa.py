@@ -345,6 +345,13 @@ class NfaEngine:
                 if st.cond.type is not AttrType.BOOL:
                     raise CompileError("pattern filter must be BOOL")
         self.has_absent = any(st.is_absent for st in states)
+        # any absent deadline-fire that must re-arm an `every` scope?
+        # (compiling the re-arm appends into _advance_time roughly
+        # doubles the step body — skip it when statically impossible)
+        self._absent_rearms = any(
+            st.is_absent and st.waiting_ms > 0 and
+            (st.every_arm >= 0 or states[st.anchor].every_arm >= 0)
+            for st in states)
         # waiting time keyed by the ANCHOR state rows wait at (standalone
         # absent states anchor themselves; logical groups anchor left)
         wait_of = [0] * (len(states) + 1)
@@ -683,6 +690,9 @@ class NfaEngine:
         new_valid = table["valid"]
         deadline = table["deadline"]
         out_rows = jnp.zeros((M,), jnp.bool_)
+        rearm_target = jnp.full((M,), -1, jnp.int32)
+        rearm_clear = jnp.zeros((M,), jnp.int32)
+        rearm_dl = jnp.full((M,), POS_INF, jnp.int64)
         for st in self.states:
             if not (st.is_absent and st.waiting_ms > 0):
                 continue
@@ -691,7 +701,15 @@ class NfaEngine:
             if st.partner >= 0:
                 # logical absent side: the present partner must have filled
                 pn = table["slots"][self.states[st.partner].slot]["n"]
+                blocked = rows & (pn == 0)
                 rows = rows & (pn > 0)
+                # deadline passed with the partner still empty: the
+                # absence is SATISFIED and the row now only waits for the
+                # partner event. Mark with -1 (still reads as "deadline
+                # in the past" to the completion/kill checks) so next_due
+                # stops re-offering the stale instant to the scheduler —
+                # leaving it armed livelocks the timer loop.
+                deadline = jnp.where(blocked, jnp.int64(-1), deadline)
             if anchor.next_idx == -1:
                 out_rows = out_rows | rows
                 new_valid = jnp.where(rows, False, new_valid)
@@ -699,10 +717,37 @@ class NfaEngine:
                 new_state = jnp.where(rows, jnp.int32(anchor.next_idx),
                                       new_state)
             deadline = jnp.where(rows, POS_INF, deadline)
+            # `every`-scoped absents re-arm on the deadline fire
+            # (AbsentStreamPreStateProcessor re-schedules itself); when
+            # the re-armed entry IS the absent anchor, the next wait
+            # rides the OLD deadline so recurring fires keep the
+            # reference's fixed cadence (fire at D, D+w, D+2w, ...)
+            arm = st.every_arm if st.every_arm >= 0 else anchor.every_arm
+            if arm >= 0:
+                clear = st.clear_from if st.every_arm >= 0 \
+                    else anchor.clear_from
+                rearm_target = jnp.where(rows, jnp.int32(arm),
+                                         rearm_target)
+                rearm_clear = jnp.where(rows, jnp.int32(clear),
+                                        rearm_clear)
+                w_next = int(self._wait_of[arm])
+                if w_next > 0:
+                    rearm_dl = jnp.where(rows, table["deadline"] + w_next,
+                                         rearm_dl)
         out = self._emit(out, table, table["slots"], out_rows,
                          table["deadline"], table["seq"])
-        return ({**table, "state": new_state, "valid": new_valid,
-                 "deadline": deadline}, out)
+        table = {**table, "state": new_state, "valid": new_valid,
+                 "deadline": deadline}
+        if self._absent_rearms:
+            do_rearm = rearm_target >= 0
+            # born = counter-1: the deadline fired BETWEEN events (the
+            # reference's scheduler), so the re-armed clone must be
+            # visible to the very next event — e.g. a Stream3 arrival
+            # right after the fire kills the new waiter
+            table = self._append_rows(
+                table, [("rearm", do_rearm, rearm_target, rearm_clear)],
+                table["counter"] - 1, deadline_src=rearm_dl)
+        return table, out
 
     def make_timer_step(self):
         """(table, now) -> (table', match_batch): deadline-only advance,
@@ -730,12 +775,14 @@ class NfaEngine:
         return step
 
     def next_due(self, table):
-        """Earliest live absent deadline (POS_INF when none)."""
-        return jnp.min(jnp.where(table["valid"], table["deadline"],
-                                 POS_INF))
+        """Earliest live absent deadline (POS_INF when none; satisfied
+        markers < 0 never re-arm the scheduler)."""
+        return jnp.min(jnp.where(
+            table["valid"] & (table["deadline"] >= 0),
+            table["deadline"], POS_INF))
 
     # -- helpers ---------------------------------------------------------
-    def _append_rows(self, table, appends, counter):
+    def _append_rows(self, table, appends, counter, deadline_src=None):
         """Place append-candidate rows into free table slots."""
         M = self.M
         free = ~table["valid"]
@@ -757,14 +804,14 @@ class NfaEngine:
             dest = jnp.where(ok, dest, M)  # M => dropped
             out_table = self._scatter_append(
                 out_table, table, dest, ok, target_state, clear_from,
-                counter)
+                counter, deadline_src=deadline_src)
             k = k + jnp.sum(mask.astype(jnp.int32))
         out_table = {**out_table,
                      "overflow": out_table["overflow"] + total_lost}
         return out_table
 
     def _scatter_append(self, table, src_table, dest, ok, target_state,
-                        clear_from, counter):
+                        clear_from, counter, deadline_src=None):
         """Copy source rows (with slots >= clear_from cleared) into dest
         positions as fresh pendings."""
         M = self.M
@@ -773,7 +820,9 @@ class NfaEngine:
         valid = table["valid"].at[d].set(True, mode="drop")
         born = table["born"].at[d].set(counter, mode="drop")
         min_at = table["min_at"].at[d].set(jnp.int64(-1), mode="drop")
-        deadline = table["deadline"].at[d].set(POS_INF, mode="drop")
+        dl_vals = jnp.asarray(POS_INF) if deadline_src is None \
+            else deadline_src
+        deadline = table["deadline"].at[d].set(dl_vals, mode="drop")
         table = {**table, "min_at": min_at, "deadline": deadline}
         seq = table["seq"].at[d].set(
             table["next_seq"] + cumsum_fast(ok.astype(jnp.int64)) - 1,

@@ -9,6 +9,14 @@ the config must be updated back after import.
 """
 import os
 
+# persistent compile cache: identical-structure queries across test cases
+# (the ref corpus reuses a handful of query shapes over hundreds of cases)
+# compile once per shape instead of once per case
+os.environ.setdefault(
+    "SIDDHI_TPU_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                 ".jax_cache"))
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

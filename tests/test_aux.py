@@ -134,3 +134,87 @@ class TestRestService:
                 f"{base}/siddhi/artifact/undeploy/{name}") as r:
             assert json.load(r)["status"] == "undeployed"
         svc.stop()
+
+
+class TestServiceHardening:
+    def test_duplicate_deploy_409(self):
+        import urllib.request
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService()
+        svc.start()
+        app = "@app:name('dup') define stream S (v int); from S select v insert into O;"
+        url = f"http://127.0.0.1:{svc.port}/siddhi/artifact/deploy"
+        urllib.request.urlopen(urllib.request.Request(
+            url, data=app.encode(), method="POST"))
+        try:
+            urllib.request.urlopen(urllib.request.Request(
+                url, data=app.encode(), method="POST"))
+            assert False, "expected 409"
+        except urllib.error.HTTPError as e:
+            assert e.code == 409
+        svc.stop()
+
+    def test_auth_token_required_for_nonloopback(self):
+        import pytest
+        from siddhi_tpu.core.service import SiddhiService
+        with pytest.raises(ValueError):
+            SiddhiService(host="0.0.0.0")
+
+    def test_auth_token_checked(self):
+        import urllib.request
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService(auth_token="s3cret")
+        svc.start()
+        url = f"http://127.0.0.1:{svc.port}/siddhi/artifacts"
+        try:
+            urllib.request.urlopen(url)
+            assert False, "expected 401"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+        req = urllib.request.Request(
+            url, headers={"Authorization": "Bearer s3cret"})
+        assert urllib.request.urlopen(req).status == 200
+        svc.stop()
+
+    def test_script_functions_refused(self):
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService()
+        try:
+            svc.deploy("define function f[python] return int { v0 + 1 };"
+                       "define stream S (v int); "
+                       "from S select f(v) as x insert into O;")
+            assert False, "expected refusal"
+        except ValueError as e:
+            assert "script" in str(e)
+
+    def test_snapshot_unpickler_rejects_code(self):
+        import pickle
+        import pytest
+        from siddhi_tpu.core import persistence as P
+        evil = pickle.dumps({"format": 1, "x": print})
+        with pytest.raises(pickle.UnpicklingError):
+            P.deserialize(evil)
+
+    def test_script_refusal_not_comment_bypassable(self):
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService()
+        try:
+            svc.deploy("define/**/function f[python] return int { v0 };"
+                       "define stream S (v int); "
+                       "from S select f(v) as x insert into O;")
+            assert False, "expected refusal"
+        except ValueError as e:
+            assert "script" in str(e)
+
+    def test_snapshot_unpickler_rejects_numpy_gadgets(self):
+        import pickle
+        import pytest
+        import numpy as np
+        from siddhi_tpu.core import persistence as P
+
+        class Evil:
+            def __reduce__(self):
+                return (np.savetxt, ("/tmp/_gadget_should_not_exist",
+                                     np.zeros(1)))
+        with pytest.raises(pickle.UnpicklingError):
+            P.deserialize(pickle.dumps({"format": 1, "x": Evil()}))

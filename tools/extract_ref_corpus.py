@@ -174,6 +174,17 @@ def extract_case(name: str, body: str, rel: str, line_no: int):
         r"|TestUtil\.waitForInEvents\s*\(\s*(\d+)\s*,\s*\w+\s*,\s*(\d+)\s*\)")
     after_start = body[body.index(".start()"):] if ".start()" in body \
         else body
+    # replay stops where the reference test starts asserting: sleeps after
+    # the final assertion (or shutdown) must not advance the clock — for
+    # recurring every-absent patterns they would inflate the fire count
+    # (e.g. EveryAbsentPatternTestCase.java:75 sleeps 2 s AFTER shutdown)
+    stop = len(after_start)
+    for pat in (r"\bAssert(?:JUnit)?\s*\.\s*assert", r"\.shutdown\s*\(",
+                r"\.throwAssertionErrors\s*\("):
+        m = re.search(pat, after_start)
+        if m:
+            stop = min(stop, m.start())
+    after_start = after_start[:stop]
     for m in token_re.finditer(after_start):
         if m.group(3):
             actions.append(["sleep", int(m.group(3))])
