@@ -84,6 +84,62 @@ def _decode(values, nulls, typ):
     return out
 
 
+def rows_of_table(table) -> list:
+    """Decode a device TableRuntime's valid rows (seq order) to python
+    tuples — the host boundary used by cache maintenance."""
+    st = jax.device_get(table.state)
+    order = np.argsort(np.where(st["valid"], st["seq"], 2 ** 62))
+    rows = []
+    for i in order:
+        if not st["valid"][i]:
+            continue
+        vals = []
+        for c, t in enumerate(table.schema.types):
+            v, nl = st["cols"][c][i], st["nulls"][c][i]
+            if nl:
+                vals.append(None)
+            elif t is AttrType.STRING:
+                vals.append(GLOBAL_STRINGS.decode(int(v)))
+            elif t is AttrType.BOOL:
+                vals.append(bool(v))
+            elif t in (AttrType.FLOAT, AttrType.DOUBLE):
+                vals.append(float(v))
+            else:
+                vals.append(int(v))
+        rows.append(tuple(vals))
+    return rows
+
+
+def insert_rows_of_table(table, rows: list, now_ms: int) -> None:
+    from .event import batch_from_rows
+    from .runtime import bucket_capacity
+    with table.lock:
+        for start in range(0, len(rows), 8192):
+            chunk = rows[start:start + 8192]
+            batch = batch_from_rows(table.schema, [tuple(r) for r in chunk],
+                                    [now_ms] * len(chunk),
+                                    bucket_capacity(len(chunk)))
+            table.state = table.insert(table.state, batch, batch.valid)
+
+
+def delete_rows_of_table(table, rows: list) -> None:
+    """Invalidate rows equal (as decoded tuples) to any of `rows`."""
+    if not rows:
+        return
+    kill = {tuple(r) for r in rows}
+    with table.lock:
+        st = jax.device_get(table.state)
+        current = rows_of_table(table)
+        # map seq-ordered decode back to physical indices
+        order = np.argsort(np.where(st["valid"], st["seq"], 2 ** 62))
+        phys = [i for i in order if st["valid"][i]]
+        valid = np.array(st["valid"])
+        for i, row in zip(phys, current):
+            if row in kill:
+                valid[i] = False
+        table.state = {**table.state, "valid": jnp.asarray(valid)}
+
+
 class OnDemandExecutor:
     """Per-app executor for store queries."""
 
@@ -129,6 +185,12 @@ class OnDemandExecutor:
         if isinstance(q, str):
             from ..lang.parser import parse_on_demand_query
             q = parse_on_demand_query(q)
+        tid = q.input_id
+        if tid is None and q.output is not None:
+            tid = getattr(q.output, "target", None)
+        rt = self.app.record_tables.get(tid)
+        if rt is not None:
+            return self._execute_record(q, rt)
         table, schema, buf = self._source(q)
         scope = SingleStreamScope(schema, aliases=(q.alias,))
         batch = _batch_of_buffer(buf)
@@ -328,3 +390,70 @@ class OnDemandExecutor:
         with table.lock:
             table.state = table.insert(table.state, batch,
                                        batch.valid)
+
+
+    # -- record (@Store) tables: host path --------------------------------
+    def _execute_record(self, q, rt):
+        """On-demand queries against @Store tables: conditions push down
+        through the store SPI (OnDemandQueryParser's record-table branch,
+        AbstractQueryableRecordTable.java:99); selection/order/limit run
+        host-side on the returned records."""
+        from .store import host_eval
+        out = q.output
+        cond_ast = getattr(out, "on", None) if out is not None else None
+        if cond_ast is None:
+            cond_ast = q.on
+        empty = StreamSchema("#none", ())
+        cond = rt.compile_condition(cond_ast,
+                                    lambda e: host_eval(e, empty),
+                                    alias=q.alias)
+        if out is None or isinstance(out, A.ReturnStream):
+            rows = rt.find_rows(cond, [None])
+            sel = q.selector
+            if sel.select_all or not sel.attributes:
+                names = list(rt.schema.names)
+                out_rows = [tuple(r) for r in rows]
+            else:
+                names, fns = [], []
+                for oa in sel.attributes:
+                    e = oa.expression
+                    if not isinstance(e, (A.Variable, A.Constant,
+                                          A.MathOp)):
+                        raise CompileError(
+                            "record-table on-demand select supports "
+                            "attributes/constants/arithmetic")
+                    fns.append(host_eval(e, rt.schema))
+                    names.append(oa.rename or (
+                        e.attribute if isinstance(e, A.Variable)
+                        else f"c{len(names)}"))
+                out_rows = [tuple(f(r) for f in fns) for r in rows]
+            return self._order_limit(q, out_rows, names)
+        if isinstance(out, A.DeleteStream):
+            return rt.delete_rows(cond, [None])
+        if isinstance(out, (A.UpdateStream, A.UpdateOrInsertStream)):
+            sets = q.output.set_clause
+            if not sets:
+                raise CompileError("on-demand update needs a SET clause")
+            set_map = {}
+            for var, expr in sets:
+                set_map[rt.schema.index_of(var.attribute)] = \
+                    host_eval(expr, empty)(None)
+            if isinstance(out, A.UpdateOrInsertStream):
+                add = [None] * len(rt.schema.attributes)
+                for i, v in set_map.items():
+                    add[i] = v
+                rt.update_or_add_rows(cond, [None], [set_map],
+                                      [tuple(add)])
+                return 1
+            return rt.update_rows(cond, [None], [set_map])
+        if isinstance(out, A.InsertIntoStream):
+            sel = q.selector
+            if sel.select_all or not sel.attributes:
+                raise CompileError(
+                    "on-demand insert needs a value selection")
+            row = tuple(host_eval(oa.expression, empty)(None)
+                        for oa in sel.attributes)
+            rt.insert_rows([row])
+            return 1
+        raise CompileError(
+            f"unsupported on-demand output {type(out).__name__}")

@@ -1166,6 +1166,7 @@ class SiddhiAppRuntime:
         self.input_handlers: dict[str, InputHandler] = {}
         self.queries: dict[str, QueryRuntime] = {}
         self.tables: dict[str, TableRuntime] = {}
+        self.record_tables: dict = {}  # tid -> RecordTableRuntime (@Store)
         self.named_windows: dict[str, QueryRuntime] = {}
         self.triggers: dict[str, TriggerRuntime] = {}
         self.sources: list = []
@@ -1325,6 +1326,7 @@ class SiddhiAppRuntime:
     def start(self) -> None:
         self.running = True
         self.scheduler.start()
+        self._start_record_tables()
         for s in self.sources:
             s.connect_with_retry()
         for s in self.sinks:
@@ -1332,11 +1334,29 @@ class SiddhiAppRuntime:
         if not self._playback:
             self._arm_cron(self.current_time())
 
+    def _start_record_tables(self) -> None:
+        from .store import CacheTableRuntime
+        for rt in self.record_tables.values():
+            rt.store.connect()
+            if isinstance(rt, CacheTableRuntime):
+                rt.now_fn = self.current_time  # event-time in playback
+                now = self.current_time()
+                rt.preload(now)
+                interval = getattr(rt, "purge_interval_ms", None)
+                if interval:
+                    def fire(due, rt=rt, interval=interval):
+                        if not self.running:
+                            return
+                        rt.purge_expired(due)
+                        self.scheduler.notify_at(due + interval, fire)
+                    self.scheduler.notify_at(now + interval, fire)
+
     def start_without_sources(self) -> None:
         """Lifecycle split (SiddhiAppRuntimeImpl.startWithoutSources
         :495): run queries but keep sources disconnected."""
         self.running = True
         self.scheduler.start()
+        self._start_record_tables()
         if not self._playback:
             self._arm_cron(self.current_time())
 
@@ -1486,6 +1506,8 @@ class SiddhiAppRuntime:
         for s in self.sinks:
             s.disconnect()
         self.scheduler.shutdown()
+        for rt in self.record_tables.values():
+            rt.store.disconnect()
         for q in self.queries.values():
             if hasattr(q, "_sched_due") and isinstance(
                     getattr(q, "_sched_due"), (int, type(None))):
@@ -1552,18 +1574,38 @@ class Planner:
                     fschema = StreamSchema("!" + sid, schema.attributes + (
                         Attribute("_error", AttrType.STRING),))
                     j.fault_junction = app.junction_for("!" + sid, fschema)
-        # 1b. defined tables (@PrimaryKey -> upsert semantics)
+        # 1b. defined tables (@PrimaryKey -> upsert semantics);
+        # @Store tables become host-side record tables, with an optional
+        # device-resident @Cache front registered under the table id so
+        # joins/filters run on-device against the cache (core/store.py)
+        from .store import CacheTableRuntime, build_record_table
         for tid, td in ast.table_definitions.items():
             schema = StreamSchema(tid, tuple(
                 Attribute(a.name, a.type) for a in td.attributes))
+            sa = A.find_annotation(td.annotations, "Store")
+            if sa is not None:
+                rt = build_record_table(tid, schema, sa, self.extensions)
+                app.record_tables[tid] = rt
+                if isinstance(rt, CacheTableRuntime):
+                    app.tables[tid] = rt.cache
+                continue
             pk = []
             pka = A.find_annotation(td.annotations, "PrimaryKey")
             if pka is not None:
                 for nm in pka.positional or list(pka.elements.values()):
                     pk.append(schema.index_of(nm.strip("'\"")))
+            idxs = []
+            ia = A.find_annotation(td.annotations, "Index")
+            if ia is not None:
+                for nm in ia.positional or list(ia.elements.values()):
+                    idxs.append(schema.index_of(nm.strip("'\"")))
+            cap_a = A.find_annotation(td.annotations, "cap")
+            tcap = int(cap_a.element()) if cap_a is not None \
+                else self.DEFAULT_TABLE_CAP
             app.tables[tid] = TableRuntime(tid, schema,
-                                           capacity=self.DEFAULT_TABLE_CAP,
-                                           pk_indices=pk)
+                                           capacity=tcap,
+                                           pk_indices=pk,
+                                           index_indices=idxs)
         # 1c. named windows: one shared window instance per definition
         # (window/Window.java:65); queries consume from its junction,
         # insert-into feeds the instance
@@ -2120,6 +2162,8 @@ class Planner:
         app = self.app
         sel_schema = operators[-1].out_schema
         escope = OutputScope(sel_schema)
+        if getattr(out, "target", None) in app.record_tables:
+            return  # wired as a StoreOutputHandler (host IO boundary)
         if isinstance(out, A.InsertIntoStream) and out.target in app.tables:
             operators.append(TableOutputOp(
                 "insert", app.tables[out.target], None, None, escope,
@@ -2148,6 +2192,25 @@ class Planner:
 
     def wire_stream_output(self, qr, out, out_type: str) -> None:
         app = self.app
+        target = getattr(out, "target", None)
+        if target in app.record_tables:
+            from .store import StoreOutputHandler
+            kind = {"InsertIntoStream": "insert", "DeleteStream": "delete",
+                    "UpdateStream": "update",
+                    "UpdateOrInsertStream": "update_or_insert"}[
+                type(out).__name__]
+            set_clause = getattr(out, "set_clause", None)
+            if kind in ("update", "update_or_insert") and not set_clause:
+                rt = app.record_tables[target]
+                set_clause = [
+                    (A.Variable(attribute=att.name),
+                     A.Variable(attribute=att.name))
+                    for att in rt.schema.attributes
+                    if att.name in qr.out_schema.names]
+            qr.output_handlers.append(StoreOutputHandler(
+                app.record_tables[target], kind, getattr(out, "on", None),
+                set_clause, qr.out_schema))
+            return
         if isinstance(out, A.InsertIntoStream) and \
                 out.target in app.named_windows:
             qr.output_handlers.append(
@@ -2254,6 +2317,13 @@ class Planner:
             side_tables[key] = t
             return t.schema, []
 
+        for side_id in (jin.left.stream_id, jin.right.stream_id):
+            if side_id in app.record_tables and side_id not in app.tables:
+                raise CompileError(
+                    f"query '{name}': joining @Store table '{side_id}' "
+                    "requires @Cache(...) — the device join step reads "
+                    "the cache buffer; an uncached store cannot be "
+                    "called from inside the jitted step")
         l_is_table = jin.left.stream_id in app.tables
         r_is_table = jin.right.stream_id in app.tables
         if l_is_table and r_is_table:

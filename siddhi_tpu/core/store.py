@@ -1,0 +1,788 @@
+"""Record tables: external-store-backed tables + cache fronting.
+
+Reference mapping:
+- table/record/AbstractRecordTable.java:55 — store SPI (init/add/find/
+  contains/delete/update/updateOrAdd over Object[] records, conditions
+  handed to the store pre-compiled)            -> RecordTable
+- table/record/ExpressionBuilder.java + BaseExpressionVisitor.java —
+  condition AST walked through a visitor the store implements (RDBMS
+  stores build SQL, Mongo stores build queries...) -> StoreCondition
+  tree + ExpressionVisitor
+- table/record/AbstractQueryableRecordTable.java:99 — compiled-selection
+  pushdown                                      -> find() takes the
+  compiled condition; selection/order/limit stay host-side (stores that
+  can push further override find_select)
+- table/CacheTable.java:62 (+ CacheTableFIFO/LRU/LFU, util/cache/
+  CacheExpirer.java) — bounded cache fronting a record table
+                                               -> CacheTableRuntime: the
+  cache is a DEVICE-resident TableRuntime (bounded columnar buffer), so
+  cached store tables join/filter on-device like in-memory tables; the
+  host keeps recency/frequency metadata and applies policy eviction
+- query/table/util/TestStore.java — in-memory AbstractRecordTable test
+  double                                        -> InMemoryStore
+
+TPU-first split: record tables are host/IO objects by nature (network
+stores), so reads/writes run on the host at query-output and on-demand
+boundaries; ONLY the @Cache front is device-resident state. Joins and
+IN-table filters require @Cache (the device step cannot call out to a
+store mid-jit); uncached store tables reject those plans with a clear
+error, matching the "explicit capacity, explicit boundary" design
+stance.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional
+
+from ..lang import ast as A
+from ..ops.expr import CompileError
+from .event import StreamSchema
+
+# ---------------------------------------------------------------------------
+# compiled store conditions (ExpressionBuilder / BaseExpressionVisitor)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StoreCompare:
+    op: str                     # '==','!=','<','<=','>','>='
+    left: "StoreNode"
+    right: "StoreNode"
+
+
+@dataclasses.dataclass
+class StoreAnd:
+    left: "StoreNode"
+    right: "StoreNode"
+
+
+@dataclasses.dataclass
+class StoreOr:
+    left: "StoreNode"
+    right: "StoreNode"
+
+
+@dataclasses.dataclass
+class StoreNot:
+    expr: "StoreNode"
+
+
+@dataclasses.dataclass
+class StoreConstant:
+    value: Any
+
+
+@dataclasses.dataclass
+class StoreVariable:
+    """A table attribute reference (the store's own column)."""
+    attribute: str
+    index: int
+
+
+@dataclasses.dataclass
+class StoreParameter:
+    """A stream-side value: bound per matching event at call time
+    (the reference's variableExpressionExecutorMap placeholders)."""
+    name: str
+
+
+StoreNode = Any
+
+
+class ExpressionVisitor:
+    """Walk hooks for store implementations translating a condition to
+    their native query language (BaseExpressionVisitor.java)."""
+
+    def begin_visit_and(self):
+        pass
+
+    def end_visit_and(self):
+        pass
+
+    def begin_visit_or(self):
+        pass
+
+    def end_visit_or(self):
+        pass
+
+    def begin_visit_not(self):
+        pass
+
+    def end_visit_not(self):
+        pass
+
+    def begin_visit_compare(self, op: str):
+        pass
+
+    def end_visit_compare(self, op: str):
+        pass
+
+    def visit_constant(self, value):
+        pass
+
+    def visit_store_variable(self, attribute: str):
+        pass
+
+    def visit_parameter(self, name: str):
+        pass
+
+
+def walk(node: StoreNode, v: ExpressionVisitor) -> None:
+    if isinstance(node, StoreAnd):
+        v.begin_visit_and()
+        walk(node.left, v)
+        walk(node.right, v)
+        v.end_visit_and()
+    elif isinstance(node, StoreOr):
+        v.begin_visit_or()
+        walk(node.left, v)
+        walk(node.right, v)
+        v.end_visit_or()
+    elif isinstance(node, StoreNot):
+        v.begin_visit_not()
+        walk(node.expr, v)
+        v.end_visit_not()
+    elif isinstance(node, StoreCompare):
+        v.begin_visit_compare(node.op)
+        walk(node.left, v)
+        walk(node.right, v)
+        v.end_visit_compare(node.op)
+    elif isinstance(node, StoreConstant):
+        v.visit_constant(node.value)
+    elif isinstance(node, StoreVariable):
+        v.visit_store_variable(node.attribute)
+    elif isinstance(node, StoreParameter):
+        v.visit_parameter(node.name)
+    else:
+        raise TypeError(f"unknown store condition node {node!r}")
+
+
+@dataclasses.dataclass
+class CompiledStoreCondition:
+    """A condition split into a store-side tree + stream-side parameter
+    evaluators (called per triggering event on the host)."""
+    root: Optional[StoreNode]                     # None == match-all
+    param_fns: dict                               # name -> fn(event_row)
+
+    def bind(self, event_row: Optional[tuple]) -> dict:
+        return {n: f(event_row) for n, f in self.param_fns.items()}
+
+    def matches(self, record: tuple, params: dict) -> bool:
+        """Default in-memory evaluation (stores with their own query
+        engine never call this)."""
+        return _eval(self.root, record, params) if self.root is not None \
+            else True
+
+
+def _eval(node, rec, params):
+    if isinstance(node, StoreAnd):
+        return _eval(node.left, rec, params) and \
+            _eval(node.right, rec, params)
+    if isinstance(node, StoreOr):
+        return _eval(node.left, rec, params) or \
+            _eval(node.right, rec, params)
+    if isinstance(node, StoreNot):
+        return not _eval(node.expr, rec, params)
+    if isinstance(node, StoreCompare):
+        lv = _value(node.left, rec, params)
+        rv = _value(node.right, rec, params)
+        if lv is None or rv is None:
+            return False  # compare-with-null is FALSE (reference)
+        return {"==": lv == rv, "!=": lv != rv, "<": lv < rv,
+                "<=": lv <= rv, ">": lv > rv, ">=": lv >= rv}[node.op]
+    raise TypeError(f"non-boolean store node {node!r}")
+
+
+def _value(node, rec, params):
+    if isinstance(node, StoreConstant):
+        return node.value
+    if isinstance(node, StoreVariable):
+        return rec[node.index]
+    if isinstance(node, StoreParameter):
+        return params[node.name]
+    raise TypeError(f"non-value store node {node!r}")
+
+
+def compile_store_condition(expr: Optional[A.Expression],
+                            table_id: str, schema: StreamSchema,
+                            stream_eval: Callable[[A.Expression],
+                                                  Callable],
+                            stream_has: Callable[[str], bool] =
+                            lambda n: False,
+                            alias: Optional[str] = None) -> \
+        CompiledStoreCondition:
+    """Split an ON condition into the store-side tree (references to the
+    table's own attributes, constants, comparisons) and stream-side
+    subexpressions, which become named parameters evaluated per event
+    (CollectionExpressionParser's store/stream split). Bare attribute
+    names bind to the EVENT side when it has the attribute — the same
+    meta resolution order as the device TableOnScope
+    (ExpressionParser.java:1330-1339) — with the table column as
+    fallback."""
+    params: dict = {}
+
+    def is_table_var(e) -> bool:
+        if not isinstance(e, A.Variable):
+            return False
+        if e.stream_ref is not None and e.stream_ref not in (
+                table_id, alias) and not stream_has(e.attribute):
+            raise CompileError(
+                f"unknown stream reference '{e.stream_ref}' in store "
+                f"condition for table '{table_id}'")
+        if e.stream_ref in (table_id, alias) and e.stream_ref is not None:
+            if e.attribute not in schema.names:
+                raise CompileError(
+                    f"'{e.attribute}' is not an attribute of table "
+                    f"'{table_id}'")
+            return True
+        return (e.stream_ref is None and e.attribute in schema.names
+                and not stream_has(e.attribute))
+
+    def mentions_table(e) -> bool:
+        if is_table_var(e):
+            return True
+        for f in getattr(e, "__dataclass_fields__", {}):
+            v = getattr(e, f)
+            if hasattr(v, "__dataclass_fields__") and mentions_table(v):
+                return True
+            if isinstance(v, (list, tuple)):
+                for x in v:
+                    if hasattr(x, "__dataclass_fields__") and \
+                            mentions_table(x):
+                        return True
+        return False
+
+    def as_param(e: A.Expression) -> StoreParameter:
+        name = f"p{len(params)}"
+        params[name] = stream_eval(e)
+        return StoreParameter(name)
+
+    def conv(e: A.Expression) -> StoreNode:
+        if isinstance(e, A.And):
+            return StoreAnd(conv(e.left), conv(e.right))
+        if isinstance(e, A.Or):
+            return StoreOr(conv(e.left), conv(e.right))
+        if isinstance(e, A.Not):
+            return StoreNot(conv(e.expr))
+        if isinstance(e, A.Compare):
+            return StoreCompare(e.op, conv_val(e.left), conv_val(e.right))
+        if isinstance(e, A.Constant):
+            return StoreConstant(e.value)
+        raise CompileError(
+            f"store condition: unsupported construct "
+            f"{type(e).__name__} (push-down supports and/or/not/compare)")
+
+    def conv_val(e: A.Expression) -> StoreNode:
+        if isinstance(e, A.Constant):
+            return StoreConstant(e.value)
+        if is_table_var(e):
+            return StoreVariable(e.attribute,
+                                 schema.index_of(e.attribute))
+        if mentions_table(e):
+            raise CompileError(
+                "store condition: table attributes may only appear as "
+                "bare comparison operands for push-down")
+        return as_param(e)
+
+    if expr is None:
+        return CompiledStoreCondition(None, {})
+    return CompiledStoreCondition(conv(expr), params)
+
+
+# ---------------------------------------------------------------------------
+# the store SPI
+# ---------------------------------------------------------------------------
+
+
+class RecordTable:
+    """Extension point for external stores (AbstractRecordTable.java:55).
+    Subclass, implement the record ops, register the class under its
+    @Store(type='...') name via SiddhiManager.set_extension("store:<type>",
+    cls) — the built-in 'inMemory'/'testStore' need no registration."""
+
+    def init(self, table_id: str, schema: StreamSchema,
+             properties: dict) -> None:
+        self.table_id = table_id
+        self.schema = schema
+        self.properties = properties
+
+    def connect(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+    # -- record operations (each Object[] == one tuple) -------------------
+    def add(self, records: list[tuple]) -> None:
+        raise NotImplementedError
+
+    def find(self, condition: CompiledStoreCondition,
+             params: dict) -> Iterable[tuple]:
+        raise NotImplementedError
+
+    def contains(self, condition: CompiledStoreCondition,
+                 params: dict) -> bool:
+        for _ in self.find(condition, params):
+            return True
+        return False
+
+    def delete(self, condition: CompiledStoreCondition,
+               param_maps: list[dict]) -> int:
+        raise NotImplementedError
+
+    def update(self, condition: CompiledStoreCondition,
+               param_maps: list[dict],
+               set_values: list[dict]) -> int:
+        """set_values[i]: {attr_index: value} applied where condition
+        matches param_maps[i]."""
+        raise NotImplementedError
+
+    def update_or_add(self, condition: CompiledStoreCondition,
+                      param_maps: list[dict], set_values: list[dict],
+                      add_rows: list[tuple]) -> None:
+        raise NotImplementedError
+
+
+class InMemoryStore(RecordTable):
+    """In-tree record-table double (TestStore.java + its condition
+    visitor): a plain Python list of tuples evaluated with the default
+    condition interpreter. Also the reference's 'inMemory' store type."""
+
+    def init(self, table_id, schema, properties):
+        super().init(table_id, schema, properties)
+        self.records: list[tuple] = []
+        self.lock = threading.Lock()
+        self.calls: list[str] = []  # test observability
+
+    def add(self, records):
+        with self.lock:
+            self.calls.append("add")
+            self.records.extend(tuple(r) for r in records)
+
+    def find(self, condition, params):
+        with self.lock:
+            self.calls.append("find")
+            return [r for r in self.records
+                    if condition.matches(r, params)]
+
+    def delete(self, condition, param_maps):
+        with self.lock:
+            self.calls.append("delete")
+            n0 = len(self.records)
+            for params in param_maps:
+                self.records = [r for r in self.records
+                                if not condition.matches(r, params)]
+            return n0 - len(self.records)
+
+    def update(self, condition, param_maps, set_values):
+        with self.lock:
+            self.calls.append("update")
+            n = 0
+            for params, sets in zip(param_maps, set_values):
+                for i, r in enumerate(self.records):
+                    if condition.matches(r, params):
+                        row = list(r)
+                        for ai, v in sets.items():
+                            row[ai] = v
+                        self.records[i] = tuple(row)
+                        n += 1
+            return n
+
+    def update_or_add(self, condition, param_maps, set_values, add_rows):
+        with self.lock:
+            self.calls.append("update_or_add")
+            for params, sets, row in zip(param_maps, set_values, add_rows):
+                hit = False
+                for i, r in enumerate(self.records):
+                    if condition.matches(r, params):
+                        nr = list(r)
+                        for ai, v in sets.items():
+                            nr[ai] = v
+                        self.records[i] = tuple(nr)
+                        hit = True
+                if not hit:
+                    self.records.append(tuple(row))
+
+
+STORE_TYPES: dict = {
+    "inmemory": InMemoryStore,
+    "teststore": InMemoryStore,
+}
+
+
+# ---------------------------------------------------------------------------
+# runtimes
+# ---------------------------------------------------------------------------
+
+
+class RecordTableRuntime:
+    """Host-side runtime for one @Store table: compiles conditions once,
+    evaluates stream-side parameters per row, serializes store access."""
+
+    is_record_table = True
+
+    def __init__(self, table_id: str, schema: StreamSchema,
+                 store: RecordTable):
+        self.table_id = table_id
+        self.schema = schema
+        self.store = store
+        self.lock = threading.Lock()
+
+    def compile_condition(self, on: Optional[A.Expression],
+                          stream_eval,
+                          stream_has=lambda n: False,
+                          alias=None) -> CompiledStoreCondition:
+        return compile_store_condition(on, self.table_id, self.schema,
+                                       stream_eval, stream_has, alias)
+
+    # row-level ops used by output handlers / on-demand ------------------
+    def insert_rows(self, rows: list[tuple]) -> None:
+        with self.lock:
+            self.store.add(rows)
+
+    def find_rows(self, cond: CompiledStoreCondition,
+                  event_rows: list) -> list[tuple]:
+        with self.lock:
+            out = []
+            for ev in event_rows:
+                out.extend(self.store.find(cond, cond.bind(ev)))
+            return out
+
+    def delete_rows(self, cond, event_rows) -> int:
+        with self.lock:
+            return self.store.delete(
+                cond, [cond.bind(ev) for ev in event_rows])
+
+    def update_rows(self, cond, event_rows, set_values) -> int:
+        with self.lock:
+            return self.store.update(
+                cond, [cond.bind(ev) for ev in event_rows], set_values)
+
+    def update_or_add_rows(self, cond, event_rows, set_values,
+                           add_rows) -> None:
+        with self.lock:
+            self.store.update_or_add(
+                cond, [cond.bind(ev) for ev in event_rows], set_values,
+                add_rows)
+
+
+class CacheTableRuntime(RecordTableRuntime):
+    """@Cache(size, cache.policy, retention.period, purge.interval)
+    fronting a record table (CacheTable.java:62 + FIFO/LRU/LFU variants
+    + CacheExpirer). The cache itself is a bounded DEVICE TableRuntime,
+    so cached store tables participate in device joins/filters exactly
+    like in-memory tables; the host owns policy metadata (recency,
+    frequency, insert time) and evicts via masked device deletes."""
+
+    def __init__(self, table_id, schema, store, max_size: int,
+                 policy: str = "FIFO",
+                 retention_ms: Optional[int] = None):
+        from ..ops.table import TableRuntime
+        super().__init__(table_id, schema, store)
+        if policy.upper() not in ("FIFO", "LRU", "LFU"):
+            raise CompileError(
+                f"@Cache policy '{policy}' unknown (FIFO|LRU|LFU)")
+        self.policy = policy.upper()
+        self.max_size = int(max_size)
+        self.retention_ms = retention_ms
+        # the cache registers under the TABLE's id in app.tables so join/
+        # filter table_deps resolve to it transparently
+        self.cache = TableRuntime(table_id, schema,
+                                  capacity=self.max_size)
+        # host-side policy metadata keyed by record tuple
+        self._meta_lock = threading.Lock()
+        self._added_at: dict = {}
+        self._used_at: dict = {}
+        self._uses: dict = {}
+        # True while the cache provably holds EVERY store row (preloaded
+        # fully, no eviction/expiry since): only then may reads be served
+        # from the cache alone — a partially-matching cache would return
+        # incomplete results (CacheTable serves reads from cache only
+        # when the table fits; otherwise queries go to the store)
+        self.cache_complete = False
+        # clock for retention/recency: wired to the app's current_time by
+        # the planner so playback apps expire on event time
+        self.now_fn = lambda: int(time.time() * 1000)
+
+    # -- policy bookkeeping ----------------------------------------------
+    def _touch(self, rows: Iterable[tuple], now_ms: int) -> None:
+        with self._meta_lock:
+            for r in rows:
+                self._used_at[r] = now_ms
+                self._uses[r] = self._uses.get(r, 0) + 1
+
+    def _note_add(self, rows: Iterable[tuple], now_ms: int) -> None:
+        with self._meta_lock:
+            for r in rows:
+                self._added_at[r] = now_ms
+                self._used_at[r] = now_ms
+                self._uses[r] = 0
+
+    def _now(self) -> int:
+        return int(self.now_fn())
+
+    def _evict_candidates(self, n: int) -> list[tuple]:
+        with self._meta_lock:
+            if self.policy == "FIFO":
+                key = lambda r: self._added_at.get(r, 0)  # noqa: E731
+            elif self.policy == "LRU":
+                key = lambda r: self._used_at.get(r, 0)  # noqa: E731
+            else:  # LFU
+                key = lambda r: self._uses.get(r, 0)  # noqa: E731
+            return sorted(self._added_at, key=key)[:n]
+
+    # -- cache maintenance (host boundary) -------------------------------
+    def cache_rows(self) -> list[tuple]:
+        from .ondemand import rows_of_table
+        return rows_of_table(self.cache)
+
+    def _cache_delete(self, rows: list[tuple]) -> None:
+        from .ondemand import delete_rows_of_table
+        delete_rows_of_table(self.cache, rows)
+        with self._meta_lock:
+            for r in rows:
+                self._added_at.pop(r, None)
+                self._used_at.pop(r, None)
+                self._uses.pop(r, None)
+
+    def _cache_add(self, rows: list[tuple], now_ms: int) -> None:
+        if not rows:
+            return
+        current = {tuple(r) for r in self.cache_rows()}
+        fresh = [tuple(r) for r in rows if tuple(r) not in current]
+        # never admit more than the device table can hold: metadata for
+        # silently-dropped rows would accumulate as phantom entries
+        fresh = fresh[: self.max_size]
+        if not fresh:
+            return
+        overflow = len(current) + len(fresh) - self.max_size
+        if overflow > 0:
+            self._cache_delete(self._evict_candidates(overflow))
+            self.cache_complete = False
+        from .ondemand import insert_rows_of_table
+        insert_rows_of_table(self.cache, fresh, now_ms)
+        self._note_add(fresh, now_ms)
+
+    def preload(self, now_ms: int) -> None:
+        """Load up to max_size rows from the store on start
+        (CacheTable preload); completeness recorded for the read path."""
+        all_rows = list(self.store.find(
+            CompiledStoreCondition(None, {}), {}))
+        self._cache_add(all_rows[: self.max_size], now_ms)
+        self.cache_complete = len(all_rows) <= self.max_size
+
+    def purge_expired(self, now_ms: int) -> None:
+        """Drop cache rows older than retention.period
+        (util/cache/CacheExpirer.java)."""
+        if self.retention_ms is None:
+            return
+        with self._meta_lock:
+            stale = [r for r, t in self._added_at.items()
+                     if now_ms - t > self.retention_ms]
+        if stale:
+            self._cache_delete(stale)
+            self.cache_complete = False
+
+    # -- reads: cache only when provably complete ------------------------
+    def find_rows(self, cond, event_rows):
+        now_ms = self._now()
+        if self.cache_complete:
+            cached = self.cache_rows()
+            out = []
+            for ev in event_rows:
+                params = cond.bind(ev)
+                hits = [r for r in cached
+                        if cond.matches(tuple(r), params)]
+                self._touch([tuple(h) for h in hits], now_ms)
+                out.extend(hits)
+            return out
+        # incomplete cache: the store answers (a cache holding SOME
+        # matching rows must not short-circuit); results warm the cache
+        fetched = super().find_rows(cond, event_rows)
+        self._cache_add(fetched, now_ms)
+        self._touch([tuple(r) for r in fetched], now_ms)
+        return fetched
+
+    # -- writes go through to the store AND keep the cache coherent ------
+    def insert_rows(self, rows):
+        super().insert_rows(rows)
+        self._cache_add([tuple(r) for r in rows], self._now())
+
+    def delete_rows(self, cond, event_rows):
+        n = super().delete_rows(cond, event_rows)
+        cached = [tuple(r) for r in self.cache_rows()]  # decode ONCE
+        stale = []
+        for ev in event_rows:
+            params = cond.bind(ev)
+            stale.extend(r for r in cached if cond.matches(r, params))
+        self._cache_delete(stale)
+        return n
+
+    def update_rows(self, cond, event_rows, set_values):
+        n = super().update_rows(cond, event_rows, set_values)
+        self._refresh_after_write(cond, event_rows)
+        return n
+
+    def update_or_add_rows(self, cond, event_rows, set_values, add_rows):
+        super().update_or_add_rows(cond, event_rows, set_values, add_rows)
+        self._refresh_after_write(cond, event_rows)
+
+    def _refresh_after_write(self, cond, event_rows):
+        # updated records change content: drop matching cache rows; the
+        # next read re-fetches the fresh values
+        cached = [tuple(r) for r in self.cache_rows()]  # decode ONCE
+        stale = []
+        for ev in event_rows:
+            params = cond.bind(ev)
+            stale.extend(r for r in cached if cond.matches(r, params))
+        self._cache_delete(stale)
+        self.cache_complete = False
+
+
+# ---------------------------------------------------------------------------
+# host-side expression evaluation (stream-side params, SET values)
+# ---------------------------------------------------------------------------
+
+
+def host_eval(expr: A.Expression, schema: StreamSchema) -> Callable:
+    """Compile a stream-side expression to fn(row_values) -> python value
+    (the host boundary mirror of the device expression compiler; store
+    writes happen at on-demand / query-output rates, not per-event)."""
+    if isinstance(expr, A.Constant):
+        v = expr.value
+        return lambda row: v
+    if isinstance(expr, A.Variable):
+        try:
+            idx = schema.index_of(expr.attribute)
+        except KeyError:
+            raise CompileError(
+                f"'{expr.attribute}' is not resolvable in this store "
+                "expression context")
+        return lambda row: row[idx]
+    if isinstance(expr, A.MathOp):
+        lf = host_eval(expr.left, schema)
+        rf = host_eval(expr.right, schema)
+        op = expr.op
+
+        def fn(row):
+            a, b = lf(row), rf(row)
+            if a is None or b is None:
+                return None
+            if op == "+":
+                return a + b
+            if op == "-":
+                return a - b
+            if op == "*":
+                return a * b
+            if op == "/":
+                return a / b if b else None
+            if op == "%":
+                return a % b if b else None
+            raise CompileError(f"host eval: unknown op {op}")
+        return fn
+    raise CompileError(
+        f"store parameter expressions support constants, attributes and "
+        f"arithmetic; got {type(expr).__name__}")
+
+
+def parse_duration_ms(text: str) -> int:
+    """'10 sec' / '1 min' / '500 millisec' -> ms (annotation values)."""
+    parts = str(text).strip().split()
+    if len(parts) == 1 and parts[0].isdigit():
+        return int(parts[0])
+    if len(parts) != 2:
+        raise CompileError(f"cannot parse duration {text!r}")
+    n = int(parts[0])
+    unit = parts[1].lower().rstrip("s")
+    factor = {"millisecond": 1, "millisec": 1, "ms": 1, "second": 1000,
+              "sec": 1000, "minute": 60_000, "min": 60_000,
+              "hour": 3_600_000}.get(unit)
+    if factor is None:
+        raise CompileError(f"cannot parse duration unit {unit!r}")
+    return n * factor
+
+
+class StoreOutputHandler:
+    """Query output -> record table (the host edge of
+    InsertIntoTableCallback / DeleteTableCallback / UpdateTableCallback /
+    UpdateOrInsertTableCallback for @Store tables): decoded CURRENT rows
+    drive store calls with the pre-compiled condition."""
+
+    def __init__(self, rt: RecordTableRuntime, kind: str,
+                 on: Optional[A.Expression], set_clause,
+                 out_schema: StreamSchema):
+        self.rt = rt
+        self.kind = kind
+        self.out_schema = out_schema
+        self.cond = rt.compile_condition(
+            on, lambda e: host_eval(e, out_schema),
+            stream_has=lambda n: n in out_schema.names)
+        self.set_fns = []
+        for var, expr in (set_clause or []):
+            self.set_fns.append((rt.schema.index_of(var.attribute),
+                                 host_eval(expr, out_schema)))
+
+    def handle_device_batch(self, out, timestamp) -> bool:
+        return False  # store IO needs decoded rows
+
+    def handle(self, timestamp, rows) -> None:
+        from .event import CURRENT as _CUR  # row kinds: 0 == CURRENT
+        acting = [vals for ts, kind, vals in rows if kind == 0]
+        if not acting:
+            return
+        if self.kind == "insert":
+            self.rt.insert_rows([tuple(v) for v in acting])
+        elif self.kind == "delete":
+            self.rt.delete_rows(self.cond, acting)
+        elif self.kind == "update":
+            sets = [{i: f(row) for i, f in self.set_fns}
+                    for row in acting]
+            self.rt.update_rows(self.cond, acting, sets)
+        elif self.kind == "update_or_insert":
+            sets = [{i: f(row) for i, f in self.set_fns}
+                    for row in acting]
+            adds = []
+            for row, s in zip(acting, sets):
+                add = [None] * len(self.rt.schema.attributes)
+                for i, v in s.items():
+                    add[i] = v
+                # unset attributes fall back to same-named output values
+                for a, att in enumerate(self.rt.schema.attributes):
+                    if add[a] is None and att.name in self.out_schema.names:
+                        add[a] = row[self.out_schema.index_of(att.name)]
+                adds.append(tuple(add))
+            self.rt.update_or_add_rows(self.cond, acting, sets, adds)
+
+
+def build_record_table(tid: str, schema: StreamSchema,
+                       store_annotation, extensions: dict):
+    """@Store(type='x', key=val..., @Cache(...)) -> runtime
+    (DefinitionParserHelper's store branch)."""
+    stype = store_annotation.element("type")
+    if not stype:
+        raise CompileError(f"table '{tid}': @Store needs type='...'")
+    cls = extensions.get(f"store:{stype.lower()}") or \
+        STORE_TYPES.get(stype.lower())
+    if cls is None:
+        raise CompileError(
+            f"table '{tid}': unknown store type '{stype}' (register it "
+            f"via manager.set_extension('store:{stype}', cls))")
+    store = cls()
+    store.init(tid, schema, dict(store_annotation.elements))
+    cache_a = None
+    for n in store_annotation.nested:
+        if n.name.lower() == "cache":
+            cache_a = n
+    if cache_a is None:
+        return RecordTableRuntime(tid, schema, store)
+    size = int(cache_a.element("size") or 128)
+    policy = cache_a.element("cache.policy") or "FIFO"
+    retention = cache_a.element("retention.period")
+    retention_ms = parse_duration_ms(retention) if retention else None
+    rt = CacheTableRuntime(tid, schema, store, size, policy, retention_ms)
+    purge = cache_a.element("purge.interval")
+    rt.purge_interval_ms = parse_duration_ms(purge) if purge else (
+        30_000 if retention_ms else None)
+    return rt

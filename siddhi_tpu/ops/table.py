@@ -19,6 +19,7 @@ a fixed order). Capacity is static with an overflow counter.
 """
 from __future__ import annotations
 
+import dataclasses
 import threading
 from typing import Optional
 
@@ -40,11 +41,16 @@ class TableRuntime:
     """One `define table` instance (shared across queries)."""
 
     def __init__(self, table_id: str, schema: StreamSchema,
-                 capacity: int = 8192, pk_indices: Optional[list] = None):
+                 capacity: int = 8192, pk_indices: Optional[list] = None,
+                 index_indices: Optional[list] = None):
         self.table_id = table_id
         self.schema = schema
         self.cap = capacity
         self.pk = tuple(pk_indices or ())
+        # @Index attributes (IndexEventHolder.java:60-110): conditions of
+        # the form `T.attr OP <stream expr>` on these rewrite to sorted
+        # probes instead of [B, T] grids (see IndexProbe below)
+        self.indexes = tuple(index_indices or ())
         self.lock = threading.Lock()
         self.state = self.init_state()
 
@@ -211,6 +217,10 @@ class TableOutputOp(Operator):
             scope = TableOnScope(table.table_id, table.schema, event_scope)
             ce = compile_expression(expr, scope)
             self.set_compiled.append((tidx, ce))
+        # index rewrite (delete only: updates need per-row source-event
+        # selection, which the interval trick cannot provide)
+        self.index_probe = analyze_index_probe(on, table, event_scope) \
+            if (kind == "delete" and on is not None) else None
 
     @property
     def out_schema(self):
@@ -223,6 +233,12 @@ class TableOutputOp(Operator):
         acting = batch.valid & (batch.kind == CURRENT)
         if self.kind == "insert":
             tstate = self.table.insert(tstate, batch, acting)
+        elif self.kind == "delete" and self.index_probe is not None:
+            benv = env_from_batch(batch)
+            benv["__now__"] = now
+            touched, _ = probe_touched(self.table, tstate,
+                                       self.index_probe, benv, acting)
+            tstate = {**tstate, "valid": tstate["valid"] & ~touched}
         else:
             benv = env_from_batch(batch)
             benv["__now__"] = now
@@ -274,6 +290,132 @@ class TableOutputOp(Operator):
         return state, batch, tstates
 
 
+@dataclasses.dataclass
+class IndexProbe:
+    """An index-rewritable condition: `T.attr OP <stream expr>` where
+    attr carries @Index or @PrimaryKey. Instead of a [B, T] condition
+    grid, the step sorts the T key column once (int32/float sorts are
+    native TPU ops; O(T log T) beats the O(B*T) grid for large tables —
+    the reference's IndexEventHolder/CollectionExpressionParser rewrite,
+    done the columnar way) and answers every event with two
+    searchsorteds, marking matched rows via interval prefix sums."""
+
+    attr: int
+    op: str                      # attr OP value: '==','<','<=','>','>='
+    value: "CompiledExpr"        # stream-side [B] values
+
+
+def analyze_index_probe(on_ast, table: "TableRuntime",
+                        event_scope: Scope) -> Optional[IndexProbe]:
+    """Single comparison on an indexed attribute -> IndexProbe, else
+    None (full-scan fallback)."""
+    from .expr import CompiledExpr  # noqa: F401 — typing aid
+    if not isinstance(on_ast, A.Compare) or on_ast.op == "!=":
+        return None
+    indexed = set(table.indexes) | set(table.pk)
+    if not indexed:
+        return None
+
+    def table_attr(e) -> Optional[int]:
+        if not isinstance(e, A.Variable) or e.index is not None:
+            return None
+        if e.stream_ref == table.table_id:
+            return table.schema.index_of(e.attribute) \
+                if e.attribute in table.schema.names else None
+        if e.stream_ref is None and e.attribute in table.schema.names:
+            try:
+                event_scope.resolve(e)
+                return None     # bare name binds to the event side
+            except CompileError:
+                return table.schema.index_of(e.attribute)
+        return None
+
+    def stream_side(e) -> Optional["CompiledExpr"]:
+        try:
+            ce = compile_expression(e, event_scope)
+        except CompileError:
+            return None
+        return ce
+
+    la, ra = table_attr(on_ast.left), table_attr(on_ast.right)
+    if (la is None) == (ra is None):
+        return None              # need exactly one table side
+    if la is not None:
+        attr, op, other = la, on_ast.op, on_ast.right
+    else:
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "==": "=="}
+        attr, op, other = ra, flip[on_ast.op], on_ast.left
+    if attr not in indexed:
+        return None
+    ce = stream_side(other)
+    if ce is None or ce.type is AttrType.BOOL:
+        return None
+    # the probe compares in the KEY dtype; only eligible when casting the
+    # stream value into it is exact (the grid path promotes both sides —
+    # a DOUBLE 2.5 against an int key must NOT truncate to 2)
+    import numpy as _np
+    key_dt = _np.dtype(np_dtype(table.schema.types[attr]))
+    val_dt = _np.dtype(np_dtype(ce.type))
+    if _np.promote_types(key_dt, val_dt) != key_dt:
+        return None
+    return IndexProbe(attr, op, ce)
+
+
+def probe_touched(table: "TableRuntime", tstate: dict, probe: IndexProbe,
+                  env: dict, acting):
+    """-> (touched [T] bool: rows matched by ANY acting event,
+           any_hit [B] bool: events with at least one matching row)."""
+    keys = tstate["cols"][probe.attr]
+    knull = tstate["nulls"][probe.attr]
+    live = tstate["valid"] & ~knull
+    T = table.cap
+    if jnp.issubdtype(keys.dtype, jnp.floating):
+        big = jnp.asarray(jnp.inf, keys.dtype)
+    else:
+        import numpy as _np
+        big = _np.asarray(_np.iinfo(_np.dtype(keys.dtype.name)).max,
+                          keys.dtype.name)
+    ks = jnp.where(live, keys, big)
+    # pad-last LEXSORT (pad flag primary): a live row whose key equals the
+    # padding sentinel (dtype max / +inf) must sort BEFORE the padding so
+    # the n_live clamp cannot cut it off
+    order = jnp.lexsort((ks, (~live).astype(jnp.int8)))
+    sk = ks[order]
+    n_live = jnp.sum(live.astype(jnp.int32))
+
+    vc = probe.value.fn(env)
+    v = jnp.broadcast_to(vc.values, acting.shape).astype(keys.dtype)
+    vnull = jnp.broadcast_to(vc.nulls, acting.shape)
+    act = acting & ~vnull
+    if probe.op == "==":
+        lo = jnp.searchsorted(sk, v, side="left")
+        hi = jnp.searchsorted(sk, v, side="right")
+    elif probe.op == "<":
+        lo = jnp.zeros_like(acting, jnp.int32)
+        hi = jnp.searchsorted(sk, v, side="left")
+    elif probe.op == "<=":
+        lo = jnp.zeros_like(acting, jnp.int32)
+        hi = jnp.searchsorted(sk, v, side="right")
+    elif probe.op == ">":
+        lo = jnp.searchsorted(sk, v, side="right")
+        hi = jnp.broadcast_to(n_live, acting.shape)
+    else:  # '>='
+        lo = jnp.searchsorted(sk, v, side="left")
+        hi = jnp.broadcast_to(n_live, acting.shape)
+    lo = jnp.minimum(lo.astype(jnp.int32), n_live)
+    hi = jnp.minimum(hi.astype(jnp.int32), n_live)
+    any_hit = act & (hi > lo)
+    # interval coverage via +1/-1 prefix sums over sorted positions
+    lo_m = jnp.where(any_hit, lo, T)
+    hi_m = jnp.where(any_hit, hi, T)
+    delta = jnp.zeros((T + 1,), jnp.int32)
+    delta = delta.at[lo_m].add(1, mode="drop")
+    delta = delta.at[hi_m].add(-1, mode="drop")
+    covered_sorted = jnp.cumsum(delta)[:T] > 0
+    touched = jnp.zeros((T,), jnp.bool_).at[order].set(covered_sorted)
+    return touched & tstate["valid"], any_hit
+
+
 class InTableRewriter:
     """Extracts `expr IN table` subexpressions from a filter, replacing
     them with __in_<k>__ placeholder variables whose [B] values are
@@ -293,8 +435,9 @@ class InTableRewriter:
             ce = compile_expression(expr.expr, scope)
             if ce.type is not AttrType.BOOL:
                 raise CompileError("IN <table> expression must be BOOL")
+            probe = analyze_index_probe(expr.expr, tr, self.event_scope)
             k = len(self.found)
-            self.found.append((tr, ce))
+            self.found.append((tr, ce, probe))
             return A.Variable(attribute=f"__in_{k}__")
         if isinstance(expr, A.MathOp):
             return A.MathOp(expr.op, self.rewrite(expr.left),
@@ -332,7 +475,7 @@ class TableFilterOp(Operator):
     needs_tables = True
 
     def table_ids(self):
-        return tuple(tr.table_id for tr, _ in self.contains)
+        return tuple(tr.table_id for tr, _, _ in self.contains)
 
     def __init__(self, cond_ast: A.Expression, schema: StreamSchema,
                  tables: dict, event_scope: Scope):
@@ -354,8 +497,14 @@ class TableFilterOp(Operator):
         from .expr import env_from_batch
         env = env_from_batch(batch)
         env["__now__"] = now
-        for k, (tr, ce) in enumerate(self.contains):
+        for k, (tr, ce, probe) in enumerate(self.contains):
             tstate = tstates[tr.table_id]
+            if probe is not None:
+                _, any_hit = probe_touched(tr, tstate, probe, env,
+                                           batch.valid)
+                env[("in", k)] = Col(
+                    any_hit, jnp.zeros((batch.capacity,), jnp.bool_))
+                continue
             genv = grid_env(tstate, env)
             c = ce.fn(genv)
             grid = jnp.broadcast_to(c.values & ~c.nulls,

@@ -1,0 +1,300 @@
+"""Record-table SPI + cache tables (@Store / @Cache).
+
+Reference: table/record/AbstractRecordTable.java:55 (store SPI),
+ExpressionBuilder/BaseExpressionVisitor (condition visitor),
+table/CacheTable.java:62 + CacheTableFIFO/LRU/LFU + CacheExpirer
+(cache fronting), query/table/util/TestStore.java (in-memory double).
+"""
+import pytest
+
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+from siddhi_tpu.core.store import (CompiledStoreCondition, ExpressionVisitor,
+                                   InMemoryStore, RecordTable, walk)
+from siddhi_tpu.ops.expr import CompileError
+
+APP = """
+    @app:playback
+    @Store(type='testStore')
+    define table T (sym string, price float);
+    define stream S (sym string, price float);
+    @info(name = 'ins') from S select sym, price insert into T;
+"""
+
+
+def _store_of(rt, tid="T"):
+    return rt.record_tables[tid].store
+
+
+class TestStoreWrites:
+    def test_insert_into_store(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(APP)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(Event(1000, ("IBM", 10.0)))
+        h.send(Event(1001, ("WSO2", 20.0)))
+        st = _store_of(rt)
+        assert sorted(st.records) == [("IBM", 10.0), ("WSO2", 20.0)]
+        assert "add" in st.calls
+        rt.shutdown()
+
+    def test_delete_with_stream_param(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(APP + """
+            define stream D (sym string);
+            @info(name = 'del') from D delete T on T.sym == sym;
+        """)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(Event(1000, ("IBM", 10.0)))
+        h.send(Event(1001, ("WSO2", 20.0)))
+        rt.get_input_handler("D").send(Event(1002, ("IBM",)))
+        assert _store_of(rt).records == [("WSO2", 20.0)]
+        rt.shutdown()
+
+    def test_update_and_upsert(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(APP + """
+            define stream U (sym string, price float);
+            @info(name = 'up')
+            from U update or insert into T
+            set T.price = price on T.sym == sym;
+        """)
+        rt.start()
+        rt.get_input_handler("S").send(Event(1000, ("IBM", 10.0)))
+        u = rt.get_input_handler("U")
+        u.send(Event(1001, ("IBM", 99.0)))       # update
+        u.send(Event(1002, ("GOOG", 55.0)))      # insert path
+        assert sorted(_store_of(rt).records) == [
+            ("GOOG", 55.0), ("IBM", 99.0)]
+        rt.shutdown()
+
+
+class TestOnDemand:
+    def _rt(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(APP)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i, (s, p) in enumerate([("IBM", 10.0), ("WSO2", 20.0),
+                                    ("GOOG", 30.0)]):
+            h.send(Event(1000 + i, (s, p)))
+        return rt
+
+    def test_select_with_pushdown(self):
+        rt = self._rt()
+        rows = rt.query("from T on price > 15.0 select sym, price")
+        assert sorted(rows) == [("GOOG", 30.0), ("WSO2", 20.0)]
+        rt.shutdown()
+
+    def test_select_star_and_limit(self):
+        rt = self._rt()
+        rows = rt.query("from T select * limit 2")
+        assert len(rows) == 2
+        rt.shutdown()
+
+    def test_delete_update_insert(self):
+        rt = self._rt()
+        assert rt.query("delete T on T.sym == 'IBM'") == 1
+        rt.query("update T set T.price = 1.0 on T.sym == 'WSO2'")
+        rt.query("select 'NEW', 5.0 insert into T")
+        st = _store_of(rt)
+        assert ("WSO2", 1.0) in st.records
+        assert ("NEW", 5.0) in st.records
+        assert all(r[0] != "IBM" for r in st.records)
+        rt.shutdown()
+
+
+class TestVisitor:
+    def test_walk_builds_native_query(self):
+        """The SPI demonstration: a store translating the pushed-down
+        condition to its own query language (an SQL-ish string here)."""
+        from siddhi_tpu.lang.parser import parse_expression
+        from siddhi_tpu.core.store import compile_store_condition
+        from siddhi_tpu.core.event import StreamSchema, Attribute
+        from siddhi_tpu.core.types import AttrType
+
+        schema = StreamSchema("T", (Attribute("sym", AttrType.STRING),
+                                    Attribute("price", AttrType.FLOAT)))
+        expr = parse_expression("price > 15.0 and sym == 'IBM'")
+        cond = compile_store_condition(expr, "T", schema,
+                                       lambda e: (lambda row: None))
+
+        class Sql(ExpressionVisitor):
+            def __init__(self):
+                self.parts = []
+
+            def begin_visit_compare(self, op):
+                self.parts.append("(")
+
+            def end_visit_compare(self, op):
+                r = self.parts.pop()
+                left = self.parts.pop()
+                assert self.parts.pop() == "("
+                self.parts.append(f"({left} {op} {r})")
+
+            def end_visit_and(self):
+                r, left = self.parts.pop(), self.parts.pop()
+                self.parts.append(f"({left} AND {r})")
+
+            def visit_constant(self, v):
+                self.parts.append(repr(v))
+
+            def visit_store_variable(self, a):
+                self.parts.append(a)
+
+        v = Sql()
+        walk(cond.root, v)
+        assert v.parts == ["((price > 15.0) AND (sym == 'IBM'))"]
+
+
+class TestCustomStore:
+    def test_registered_via_extension(self):
+        calls = []
+
+        class MyStore(RecordTable):
+            def init(self, table_id, schema, properties):
+                super().init(table_id, schema, properties)
+                calls.append(("init", properties.get("uri")))
+                self.rows = []
+
+            def add(self, records):
+                self.rows.extend(records)
+                calls.append(("add", len(records)))
+
+            def find(self, condition, params):
+                return [r for r in self.rows
+                        if condition.matches(r, params)]
+
+        mgr = SiddhiManager()
+        mgr.set_extension("store:myStore", MyStore)
+        rt = mgr.create_siddhi_app_runtime("""
+            @app:playback
+            @Store(type='myStore', uri='proto://host')
+            define table T (k int);
+            define stream S (k int);
+            from S select k insert into T;
+        """)
+        rt.start()
+        rt.get_input_handler("S").send(Event(1000, (7,)))
+        assert ("init", "proto://host") in calls
+        assert ("add", 1) in calls
+        assert rt.query("from T select k") == [(7,)]
+        rt.shutdown()
+
+    def test_unknown_store_type_rejected(self):
+        with pytest.raises(CompileError):
+            SiddhiManager().create_siddhi_app_runtime("""
+                @Store(type='nosuch') define table T (k int);
+                define stream S (k int);
+                from S select k insert into T;
+            """)
+
+
+CACHED = """
+    @app:playback
+    @Store(type='testStore', @Cache(size='2', cache.policy='{policy}'))
+    define table T (sym string, price float);
+    define stream S (sym string, price float);
+    @info(name = 'ins') from S select sym, price insert into T;
+"""
+
+
+class TestCache:
+    def test_fifo_eviction_bounds_cache(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            CACHED.format(policy="FIFO"))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i, s in enumerate(["A", "B", "C"]):
+            h.send(Event(1000 + i, (s, float(i))))
+        t = rt.record_tables["T"]
+        cached = {r[0] for r in t.cache_rows()}
+        assert cached == {"B", "C"}          # A evicted first-in-first-out
+        assert len(t.store.records) == 3     # store keeps everything
+        rt.shutdown()
+
+    def test_incomplete_cache_reads_store_and_warms(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(CACHED.format(policy="FIFO"))
+        t = rt.record_tables["T"]
+        # 3 store rows, cache size 2: preload cannot cover the store, so
+        # reads MUST consult the store (a partial cache would silently
+        # return incomplete results) and warm the cache with the hits
+        t.store.add([("X", 9.0), ("Y", 8.0), ("Z", 7.0)])
+        rt.start()
+        assert not t.cache_complete
+        rows = rt.query("from T on T.price < 8.5 select sym, price")
+        assert sorted(rows) == [("Y", 8.0), ("Z", 7.0)]
+        cached = {r[0] for r in t.cache_rows()}
+        assert {"Y", "Z"} & cached           # hits warmed the cache
+        rt.shutdown()
+
+    def test_preload_on_start(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            CACHED.format(policy="FIFO"))
+        t = rt.record_tables["T"]
+        t.store.add([("P", 1.0), ("Q", 2.0)])
+        rt.start()
+        assert {r[0] for r in t.cache_rows()} == {"P", "Q"}
+        rt.shutdown()
+
+    def test_lru_keeps_recently_used(self):
+        rt = SiddhiManager().create_siddhi_app_runtime(
+            CACHED.format(policy="LRU"))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(Event(1000, ("A", 1.0)))
+        h.send(Event(1001, ("B", 2.0)))
+        with rt.barrier:                   # advance the playback clock so
+            rt.on_ingest_ts(1500)          # the touch gets a later stamp
+        rt.query("from T on T.sym == 'A' select sym")  # touch A @1500
+        h.send(Event(2000, ("C", 3.0)))                # evicts B (LRU)
+        cached = {r[0] for r in rt.record_tables["T"].cache_rows()}
+        assert cached == {"A", "C"}
+        rt.shutdown()
+
+    def test_join_reads_cache_on_device(self):
+        rt = SiddhiManager().create_siddhi_app_runtime("""
+            @app:playback
+            @Store(type='testStore', @Cache(size='16'))
+            define table T (sym string, label string);
+            define stream L (sym string);
+            define stream S (sym string, v int);
+            @info(name='ins') from L select sym, 'tag' as label insert into T;
+            @info(name = 'j')
+            from S join T on S.sym == T.sym
+            select S.sym as sym, T.label as label, v
+            insert into O;
+        """)
+        got = []
+        rt.add_callback("O", StreamCallback(lambda e: got.extend(e)))
+        rt.start()
+        rt.get_input_handler("L").send(Event(999, ("IBM",)))
+        rt.get_input_handler("S").send(Event(1000, ("IBM", 5)))
+        rt.shutdown()
+        assert [tuple(e.data) for e in got] == [("IBM", "tag", 5)]
+
+    def test_uncached_store_join_rejected(self):
+        with pytest.raises(CompileError):
+            SiddhiManager().create_siddhi_app_runtime("""
+                @Store(type='testStore') define table T (sym string);
+                define stream S (sym string);
+                from S join T on S.sym == T.sym
+                select S.sym as sym insert into O;
+            """)
+
+    def test_expiry_purges_cache(self):
+        rt = SiddhiManager().create_siddhi_app_runtime("""
+            @Store(type='testStore',
+                   @Cache(size='8', retention.period='1 sec',
+                          purge.interval='1 sec'))
+            define table T (k int);
+            define stream S (k int);
+            from S select k insert into T;
+        """)
+        rt.start()
+        t = rt.record_tables["T"]
+        rt.get_input_handler("S").send((3,))
+        assert t.cache_rows() == [(3,)]
+        t.purge_expired(int(__import__("time").time() * 1000) + 5000)
+        assert t.cache_rows() == []
+        assert t.store.records == [(3,)]  # store unaffected
+        rt.shutdown()

@@ -42,7 +42,7 @@ def main():
         if k != "pass":
             for item in out[k]:
                 print("  ", item[0], "|", item[1])
-    json_path = pathlib.Path("triage.json")
+    json_path = pathlib.Path("/tmp/triage.json")
     json_path.write_text(json.dumps(
         {k: [list(i) if isinstance(i, tuple) else i for i in v]
          for k, v in out.items()}, indent=1))
