@@ -39,8 +39,9 @@ from ..ops.table import (TableFilterOp, TableOutputOp, TableRuntime,
 from ..ops.windows2 import (BatchWindowOp, CronWindowOp, DelayWindowOp,
                             EmptyWindowOp, ExternalTimeBatchWindowOp,
                             ExternalTimeWindowOp, FrequentWindowOp,
-                            LossyFrequentWindowOp, SessionWindowOp,
-                            SortWindowOp, TimeLengthWindowOp)
+                            HoppingWindowOp, LossyFrequentWindowOp,
+                            SessionWindowOp, SortWindowOp,
+                            TimeLengthWindowOp)
 from ..ops.windows import (NEG_INF, POS_INF, LengthBatchWindowOp, LengthWindowOp,
                            TimeBatchWindowOp, TimeWindowOp, WindowOp)
 from .event import (CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema,
@@ -74,6 +75,8 @@ WINDOW_CLASSES = {
     "externaltimebatch": ExternalTimeBatchWindowOp,
     "session": SessionWindowOp,
     "cron": CronWindowOp,
+    "hopping": HoppingWindowOp,
+    "hoping": HoppingWindowOp,   # the reference's spelling
 }
 
 
@@ -636,9 +639,28 @@ class QueryRuntime(Receiver):
         out_rows = rows_from_batch(self.out_schema.types, out_host)
         if not out_rows:
             return
+        out_rows = self._host_shape_rows(out_rows)
         for h in row_handlers:
             h.handle(timestamp, out_rows)
         self.callback_handler.handle(timestamp, out_rows)
+
+    def _host_shape_rows(self, rows):
+        """STRING order-by (+ its offset/limit) applied on decoded rows —
+        the host edge of shape_output (batch_callbacks stay unordered,
+        documented in ops/selector.compile_order_by)."""
+        shape = getattr(self.operators[-1], "host_shape", None)
+        if not shape:
+            return rows
+        order, offset, limit = shape
+        for idx, direction in reversed(order):
+            rows = sorted(rows,
+                          key=lambda r: (r[2][idx] is None, r[2][idx]),
+                          reverse=(direction == "desc"))
+        if offset or limit:
+            off = offset or 0
+            rows = rows[off:off + limit] if limit is not None \
+                else rows[off:]
+        return rows
 
     # -- timers ----------------------------------------------------------
     def _schedule(self, due: int) -> None:
@@ -1869,6 +1891,12 @@ class Planner:
             return LengthBatchWindowOp(schema,
                                        int(const_of(params[0], 'length')),
                                        expired_enabled=expired_enabled)
+        if key in ("hopping", "hoping"):
+            _expect(params, 2, name)
+            return HoppingWindowOp(schema, _ms(params[0], name),
+                                   _ms(params[1], name),
+                                   cap=time_cap,
+                                   expired_enabled=expired_enabled)
         if key == "timebatch":
             if len(params) not in (1, 2):
                 raise CompileError(f"{name} takes 1-2 parameters")
@@ -2162,8 +2190,17 @@ class Planner:
         app = self.app
         sel_schema = operators[-1].out_schema
         escope = OutputScope(sel_schema)
-        if getattr(out, "target", None) in app.record_tables:
-            return  # wired as a StoreOutputHandler (host IO boundary)
+        target = getattr(out, "target", None)
+        if target in app.record_tables:
+            # wired as a StoreOutputHandler: a host IO boundary, so
+            # host-shaped (STRING-ordered) rows reach it correctly
+            return
+        if target in app.tables and \
+                getattr(operators[-1], "host_shape", None):
+            raise CompileError(
+                "order by on a STRING attribute shapes rows at the host "
+                "boundary and cannot feed a device table output (tables "
+                "insert inside the jitted step)")
         if isinstance(out, A.InsertIntoStream) and out.target in app.tables:
             operators.append(TableOutputOp(
                 "insert", app.tables[out.target], None, None, escope,
@@ -2406,6 +2443,17 @@ class Planner:
 
         compiler = NfaCompiler(app.schemas, sin.state_type)
         slots, states = compiler.compile(sin.state)
+        # e[last] / e[last - k] select refs -> ifThenElse chains over the
+        # slot's copy columns (nfa.rewrite_last_refs)
+        from ..ops.nfa import rewrite_last_refs
+        sel = q.selector
+        if sel.attributes:
+            sel.attributes = [
+                dataclasses.replace(
+                    oa, expression=rewrite_last_refs(oa.expression, slots))
+                for oa in sel.attributes]
+        if sel.having is not None:
+            sel.having = rewrite_last_refs(sel.having, slots)
         if parallel_supported(slots, states):
             # the TPU-shaped round-parallel engine (larger pending table —
             # its grids are cheap; the scan engine stays small)

@@ -553,8 +553,12 @@ class CacheTableRuntime(RecordTableRuntime):
         current = {tuple(r) for r in self.cache_rows()}
         fresh = [tuple(r) for r in rows if tuple(r) not in current]
         # never admit more than the device table can hold: metadata for
-        # silently-dropped rows would accumulate as phantom entries
-        fresh = fresh[: self.max_size]
+        # silently-dropped rows would accumulate as phantom entries —
+        # and a truncated admission means the cache no longer mirrors
+        # the store, so completeness is void
+        if len(fresh) > self.max_size:
+            fresh = fresh[: self.max_size]
+            self.cache_complete = False
         if not fresh:
             return
         overflow = len(current) + len(fresh) - self.max_size

@@ -75,6 +75,10 @@ def promote(a: AttrType, b: AttrType) -> AttrType:
     raise AssertionError
 
 
+# interned marker object for uuid() sentinel codes (identity-compared)
+UUID_MARKER = "\x00uuid\x00"
+
+
 class StringTable:
     """Global host-side string interning: string <-> int32 dictionary code.
 
@@ -109,7 +113,14 @@ class StringTable:
         return code
 
     def decode(self, code: int):
-        return self._to_str[int(code)]
+        s = self._to_str[int(code)]
+        if s == UUID_MARKER:
+            # uuid() columns carry a sentinel code on device; each decoded
+            # row materializes a fresh UUID at the host boundary
+            # (UUIDFunctionExecutor.java generates per-event UUIDs)
+            import uuid as _uuid
+            return str(_uuid.uuid4())
+        return s
 
     def __len__(self):
         return len(self._to_str)

@@ -685,10 +685,16 @@ class AggregateOp(Operator):
             if self.having.type is not AttrType.BOOL:
                 raise CompileError("HAVING must be BOOL")
 
-        # order by / limit / offset
-        self.order_by = compile_order_by(selector, self._schema)
+        # order by / limit / offset (STRING keys shape at the host)
+        self.order_by, host_order = compile_order_by(selector,
+                                                     self._schema)
         self.limit = const_int(selector.limit, "limit")
         self.offset = const_int(selector.offset, "offset")
+        if host_order:
+            self.host_shape = (host_order, self.offset, self.limit)
+            self.limit = self.offset = None
+        else:
+            self.host_shape = None
 
     @property
     def out_schema(self):

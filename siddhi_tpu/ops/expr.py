@@ -410,6 +410,24 @@ def _compile_function(e: A.AttributeFunction, comp, scope, functions) -> Compile
             return Col(v, nulls)
         return CompiledExpr(t, fn)
 
+    if key == "uuid":
+        # device rows carry the sentinel code; the string table decodes
+        # each row to a fresh UUID at the host boundary. Device-side
+        # equality between two uuid() columns degenerates (both are the
+        # sentinel) — documented; the reference evaluates per event on
+        # the host, which is exactly where our decode runs.
+        if params:
+            raise CompileError("uuid() takes no arguments")
+        from ..core.types import UUID_MARKER
+        code = GLOBAL_STRINGS.encode(UUID_MARKER)
+
+        def fn(env, code=code):
+            ts = env["__ts__"]
+            shape = ts.values.shape if hasattr(ts.values, "shape") else ()
+            return Col(jnp.full(shape, code, jnp.int32),
+                       jnp.zeros(shape, jnp.bool_))
+        return CompiledExpr(AttrType.STRING, fn)
+
     if key == "eventtimestamp":
         def fn(env):
             return env["__ts__"]

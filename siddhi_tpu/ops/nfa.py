@@ -85,6 +85,9 @@ class NfaStateSpec:
     anchor: int = -1                   # group anchor (== idx when plain)
     is_absent: bool = False
     waiting_ms: int = 0
+    # which deadline lane this absent side arms: 0 = table['deadline'],
+    # 1 = table['deadline2'] (only both-absent logical groups use lane 1)
+    dl_field: int = 0
     cond: Optional[CompiledExpr] = None
 
     @property
@@ -193,12 +196,16 @@ class NfaCompiler:
         li = side(el.left)
         ri = side(el.right)
         ls, rs = self.states[li], self.states[ri]
-        if ls.is_absent and rs.is_absent:
-            raise CompileError("both sides of and/or cannot be absent")
         if el.op not in ("and", "or"):
             raise CompileError(f"unknown logical op '{el.op}'")
-        if el.op == "or" and (ls.is_absent or rs.is_absent):
-            raise CompileError("'or' with an absent side not supported")
+        for st in (ls, rs):
+            if st.is_absent and st.waiting_ms <= 0 and (
+                    (ls.is_absent and rs.is_absent) or el.op == "or"):
+                raise CompileError(
+                    "absent sides of 'or' / double-absent groups need "
+                    "'for <time>' (AbsentLogicalPreStateProcessor)")
+        if ls.is_absent and rs.is_absent:
+            rs.dl_field = 1   # second deadline lane
         ls.partner, rs.partner = ri, li
         ls.logical_op = rs.logical_op = el.op
         ls.anchor = rs.anchor = li
@@ -303,6 +310,55 @@ class PatternScope(Scope):
         return key, spec.schema.types[a]
 
 
+def rewrite_last_refs(expr, slots):
+    """Replace `e[last]` / `e[last - k]` select references with an
+    ifThenElse chain over the slot's copy columns (highest non-null copy
+    wins). Runs on the selector AST before compilation, so the match
+    batch needs no per-row count column. Underflow (`last - k` before
+    k+1 events matched) falls back to copy 0 — the reference returns
+    null there; documented deviation."""
+    if isinstance(expr, A.Variable) and expr.index is not None:
+        idx = expr.index
+        k = 0
+        if idx == "last":
+            k = 0
+        elif isinstance(idx, tuple) and idx[0] == "last":
+            k = int(idx[1])
+        else:
+            return expr
+        slot = None
+        for sp in slots:
+            if sp.ref == expr.stream_ref or (
+                    sp.ref is None and sp.stream_id == expr.stream_ref):
+                slot = sp
+                break
+        if slot is None or slot.cap <= 1:
+            return dataclasses.replace(expr, index=0)
+
+        def ref(j):
+            return dataclasses.replace(expr, index=j)
+
+        out = ref(0)
+        for j in range(max(k, 0), slot.cap):
+            # highest filled copy j selects copy j-k
+            out = A.AttributeFunction(
+                namespace=None, name="ifThenElse",
+                parameters=[A.Not(A.IsNull(expr=ref(j))),
+                            ref(j - k), out])
+        return out
+    for f in getattr(expr, "__dataclass_fields__", {}):
+        v = getattr(expr, f)
+        if hasattr(v, "__dataclass_fields__") and isinstance(
+                v, A.Expression):
+            expr = dataclasses.replace(
+                expr, **{f: rewrite_last_refs(v, slots)})
+        elif isinstance(v, list) and v and isinstance(
+                v[0], A.Expression):
+            expr = dataclasses.replace(
+                expr, **{f: [rewrite_last_refs(x, slots) for x in v]})
+    return expr
+
+
 class MatchScope(PatternScope):
     """Selector scope over the flattened match batch: e1[i].attr resolves to
     the corresponding flattened column."""
@@ -355,10 +411,16 @@ class NfaEngine:
         # waiting time keyed by the ANCHOR state rows wait at (standalone
         # absent states anchor themselves; logical groups anchor left)
         wait_of = [0] * (len(states) + 1)
+        wait2_of = [0] * (len(states) + 1)
         for st in states:
             if st.is_absent and st.waiting_ms > 0:
-                wait_of[st.anchor] = st.waiting_ms
+                if st.dl_field == 0:
+                    wait_of[st.anchor] = st.waiting_ms
+                else:
+                    wait2_of[st.anchor] = st.waiting_ms
         self._wait_of = np.asarray(wait_of, np.int64)
+        self._wait2_of = np.asarray(wait2_of, np.int64)
+        self._has_dl2 = any(w > 0 for w in wait2_of)
 
         # flattened match-batch schema: slot j attr a copy c
         attrs = []
@@ -400,6 +462,7 @@ class NfaEngine:
             "born": jnp.full((M,), -1, dtype=jnp.int64),
             "min_at": jnp.full((M,), -1, dtype=jnp.int64),
             "deadline": jnp.full((M,), POS_INF, dtype=jnp.int64),
+            "deadline2": jnp.full((M,), POS_INF, dtype=jnp.int64),
             "seq": jnp.arange(M, dtype=jnp.int64),
             "slots": tuple(slots_buf),
             "next_seq": jnp.int64(M),
@@ -489,6 +552,9 @@ class NfaEngine:
             new_min_at = table["min_at"]
             slots_upd = table["slots"]
             seq_kill = jnp.zeros((M,), jnp.bool_)
+            dl1 = table["deadline"]
+            dl2 = table["deadline2"]
+            DEAD = jnp.int64(-2)  # or-side killed by an arrival
 
             pre_state = table["state"]  # all personas test pre-event state
 
@@ -515,14 +581,29 @@ class NfaEngine:
                 hit = at_state & cond_ok
 
                 if st.is_absent:
-                    # a matching event violates the absence — kill the
-                    # pending (after the deadline the absence is already
-                    # satisfied, the event no longer matters)
+                    # a matching event violates the absence. For 'and'
+                    # groups (and standalone absents) that kills the
+                    # pending row; for 'or' groups only THIS side dies —
+                    # the group remains completable via the partner
+                    # (AbsentLogicalPreStateProcessor)
+                    my_dl = dl2 if st.dl_field else dl1
                     if st.waiting_ms > 0:
-                        kill = hit & (ev_ts <= table["deadline"])
+                        kill = hit & (ev_ts <= my_dl) & (my_dl >= 0)
                     else:
                         kill = hit
-                    new_valid = jnp.where(kill, False, new_valid)
+                    if st.logical_op == "or":
+                        p = self.states[st.partner]
+                        if st.dl_field:
+                            dl2 = jnp.where(kill, DEAD, dl2)
+                        else:
+                            dl1 = jnp.where(kill, DEAD, dl1)
+                        if p.is_absent:
+                            other = dl1 if st.dl_field else dl2
+                            both_dead = kill & (other == DEAD)
+                            new_valid = jnp.where(both_dead, False,
+                                                  new_valid)
+                    else:
+                        new_valid = jnp.where(kill, False, new_valid)
                     continue
 
                 # fill own slot at position n (persona rows have n=0 there)
@@ -574,16 +655,17 @@ class NfaEngine:
                     anchor = self.states[st.anchor]
                     if st.partner >= 0:
                         p = self.states[st.partner]
-                        if p.is_absent and p.waiting_ms > 0:
+                        if st.logical_op == "or":
+                            complete = hit  # either side completes an OR
+                        elif p.is_absent and p.waiting_ms > 0:
                             # 'X and not Y for t': completes only once the
                             # deadline passed (pre-pass handles the fill-
                             # first order; this handles deadline-first)
-                            complete = hit & (table["deadline"] < ev_ts)
+                            pdl = dl2 if p.dl_field else dl1
+                            complete = hit & (pdl < ev_ts)
                         elif p.is_absent:
                             complete = hit   # 'X and not Y': Y would have
                             # killed the row already
-                        elif st.logical_op == "or":
-                            complete = hit
                         else:  # and, both present: partner slot filled?
                             pf = slots_upd[p.slot]["n"] > 0
                             complete = hit & pf
@@ -618,7 +700,8 @@ class NfaEngine:
 
             table2 = {**table, "state": new_state, "valid": new_valid,
                       "ts0": ts0, "has_ts0": has_ts0, "slots": slots_upd,
-                      "min_at": new_min_at}
+                      "min_at": new_min_at, "deadline": dl1,
+                      "deadline2": dl2}
 
             # every re-arms (cleared clones, born=now)
             do_rearm = (rearm_target >= 0) & is_current
@@ -639,12 +722,18 @@ class NfaEngine:
                 # rows newly waiting at an absent anchor start their clock
                 # at this event's time (arrival into the state, or first
                 # observed time for the initial pending)
-                w = jnp.asarray(self._wait_of)[
-                    jnp.clip(table2["state"], 0, len(self.states))]
+                st_clip = jnp.clip(table2["state"], 0, len(self.states))
+                w = jnp.asarray(self._wait_of)[st_clip]
                 needs = table2["valid"] & (w > 0) & ev_valid & \
                     (table2["deadline"] >= POS_INF)
                 table2 = {**table2, "deadline": jnp.where(
                     needs, ev_ts + w, table2["deadline"])}
+                if self._has_dl2:
+                    w2 = jnp.asarray(self._wait2_of)[st_clip]
+                    needs2 = table2["valid"] & (w2 > 0) & ev_valid & \
+                        (table2["deadline2"] >= POS_INF)
+                    table2 = {**table2, "deadline2": jnp.where(
+                        needs2, ev_ts + w2, table2["deadline2"])}
 
             table2 = {**table2, "counter": counter + 1}
             return (table2, out), None
@@ -683,33 +772,67 @@ class NfaEngine:
             return table, out
         M = self.M
         live = table["valid"]
-        passed = (table["deadline"] < now_ts) if strict \
-            else (table["deadline"] <= now_ts)
-        crossed = live & passed & active
         new_state = table["state"]
         new_valid = table["valid"]
         deadline = table["deadline"]
+        deadline2 = table["deadline2"]
         out_rows = jnp.zeros((M,), jnp.bool_)
         rearm_target = jnp.full((M,), -1, jnp.int32)
         rearm_clear = jnp.zeros((M,), jnp.int32)
         rearm_dl = jnp.full((M,), POS_INF, jnp.int64)
+
+        def lane_passed(dl):
+            armed = dl >= 0   # -1 satisfied / -2 or-side dead never fire
+            p = (dl < now_ts) if strict else (dl <= now_ts)
+            return armed & p
+
         for st in self.states:
             if not (st.is_absent and st.waiting_ms > 0):
                 continue
             anchor = self.states[st.anchor]
-            rows = crossed & (table["state"] == st.anchor)
+            my_dl = deadline2 if st.dl_field else deadline
+            rows = live & active & lane_passed(my_dl) & \
+                (table["state"] == st.anchor)
             if st.partner >= 0:
-                # logical absent side: the present partner must have filled
-                pn = table["slots"][self.states[st.partner].slot]["n"]
-                blocked = rows & (pn == 0)
-                rows = rows & (pn > 0)
-                # deadline passed with the partner still empty: the
-                # absence is SATISFIED and the row now only waits for the
-                # partner event. Mark with -1 (still reads as "deadline
-                # in the past" to the completion/kill checks) so next_due
-                # stops re-offering the stale instant to the scheduler —
-                # leaving it armed livelocks the timer loop.
-                deadline = jnp.where(blocked, jnp.int64(-1), deadline)
+                p_state = self.states[st.partner]
+                if p_state.is_absent and st.logical_op == "and":
+                    # 'not A for t1 AND not B for t2': the group fires
+                    # only when BOTH lanes are done (passed now, or
+                    # already satisfied = -1). A lane that passes while
+                    # the other is still pending becomes satisfied so
+                    # next_due stops re-offering it (livelock guard).
+                    # Lane 0 owns the whole group; lane 1 skips.
+                    if st.dl_field == 1:
+                        continue
+                    base = live & active & (table["state"] == st.anchor)
+                    ok1 = lane_passed(deadline) | (deadline == -1)
+                    ok2 = lane_passed(deadline2) | (deadline2 == -1)
+                    rows = base & ok1 & ok2
+                    deadline = jnp.where(
+                        base & lane_passed(deadline) & ~ok2,
+                        jnp.int64(-1), deadline)
+                    deadline2 = jnp.where(
+                        base & lane_passed(deadline2) & ~ok1,
+                        jnp.int64(-1), deadline2)
+                elif p_state.is_absent:
+                    # 'not A for t OR not B for t': first lane to pass
+                    # completes the group
+                    pass
+                elif st.logical_op == "or":
+                    # 'A or not B for t': the deadline side can complete
+                    # the group on its own (partner slot left null)
+                    pass
+                else:
+                    # 'A and not B for t': the present partner must have
+                    # filled; otherwise the absence is SATISFIED and the
+                    # row only waits for the partner event. Mark -1
+                    # (reads as past to completion/kill checks) so
+                    # next_due stops re-offering the stale instant —
+                    # leaving it armed livelocks the timer loop.
+                    pn = table["slots"][p_state.slot]["n"]
+                    blocked = rows & (pn == 0)
+                    rows = rows & (pn > 0)
+                    deadline = jnp.where(blocked, jnp.int64(-1), deadline)
             if anchor.next_idx == -1:
                 out_rows = out_rows | rows
                 new_valid = jnp.where(rows, False, new_valid)
@@ -717,6 +840,7 @@ class NfaEngine:
                 new_state = jnp.where(rows, jnp.int32(anchor.next_idx),
                                       new_state)
             deadline = jnp.where(rows, POS_INF, deadline)
+            deadline2 = jnp.where(rows, POS_INF, deadline2)
             # `every`-scoped absents re-arm on the deadline fire
             # (AbsentStreamPreStateProcessor re-schedules itself); when
             # the re-armed entry IS the absent anchor, the next wait
@@ -734,10 +858,14 @@ class NfaEngine:
                 if w_next > 0:
                     rearm_dl = jnp.where(rows, table["deadline"] + w_next,
                                          rearm_dl)
+        # emission timestamp = the lane that fired (min armed deadline)
+        d1 = jnp.where(table["deadline"] >= 0, table["deadline"], POS_INF)
+        d2 = jnp.where(table["deadline2"] >= 0, table["deadline2"],
+                       POS_INF)
         out = self._emit(out, table, table["slots"], out_rows,
-                         table["deadline"], table["seq"])
+                         jnp.minimum(d1, d2), table["seq"])
         table = {**table, "state": new_state, "valid": new_valid,
-                 "deadline": deadline}
+                 "deadline": deadline, "deadline2": deadline2}
         if self._absent_rearms:
             do_rearm = rearm_target >= 0
             # born = counter-1: the deadline fired BETWEEN events (the
@@ -775,11 +903,15 @@ class NfaEngine:
         return step
 
     def next_due(self, table):
-        """Earliest live absent deadline (POS_INF when none; satisfied
-        markers < 0 never re-arm the scheduler)."""
-        return jnp.min(jnp.where(
+        """Earliest live absent deadline across both lanes (POS_INF when
+        none; satisfied/dead markers < 0 never re-arm the scheduler)."""
+        d1 = jnp.min(jnp.where(
             table["valid"] & (table["deadline"] >= 0),
             table["deadline"], POS_INF))
+        d2 = jnp.min(jnp.where(
+            table["valid"] & (table["deadline2"] >= 0),
+            table["deadline2"], POS_INF))
+        return jnp.minimum(d1, d2)
 
     # -- helpers ---------------------------------------------------------
     def _append_rows(self, table, appends, counter, deadline_src=None):
@@ -823,7 +955,10 @@ class NfaEngine:
         dl_vals = jnp.asarray(POS_INF) if deadline_src is None \
             else deadline_src
         deadline = table["deadline"].at[d].set(dl_vals, mode="drop")
-        table = {**table, "min_at": min_at, "deadline": deadline}
+        deadline2 = table["deadline2"].at[d].set(jnp.asarray(POS_INF),
+                                                 mode="drop")
+        table = {**table, "min_at": min_at, "deadline": deadline,
+                 "deadline2": deadline2}
         seq = table["seq"].at[d].set(
             table["next_seq"] + cumsum_fast(ok.astype(jnp.int64)) - 1,
             mode="drop")

@@ -68,17 +68,21 @@ def const_int(expr, what: str) -> Optional[int]:
 
 
 def compile_order_by(selector: A.Selector, schema: StreamSchema):
+    """-> (device_order, host_order): STRING keys order at the HOST
+    boundary (dictionary codes are not lexicographic; rows are decoded
+    there anyway), so any order-by containing a STRING key moves the
+    WHOLE ordering + offset/limit to the host row path. Device-only
+    orderings stay in the jitted step."""
     order_by = []
+    host = False
     for ob in selector.order_by:
         idx = schema.index_of(ob.variable.attribute)
         if ob.order.lower() not in ("asc", "desc"):
             raise CompileError(f"unknown order '{ob.order}'")
         if schema.types[idx] is AttrType.STRING:
-            raise CompileError(
-                "order by on STRING attributes is not supported on device "
-                "(dictionary codes are not lexicographic)")
+            host = True
         order_by.append((idx, ob.order.lower()))
-    return order_by
+    return ([], order_by) if host else (order_by, [])
 
 
 def shape_output(out: EventBatch, order_by, offset: Optional[int],
@@ -156,9 +160,16 @@ class ProjectOp(Operator):
                                              functions)
             if self.having.type is not AttrType.BOOL:
                 raise CompileError("HAVING must be BOOL")
-        self.order_by = compile_order_by(selector, self._schema)
+        self.order_by, self.host_order_by = compile_order_by(
+            selector, self._schema)
         self.limit = const_int(selector.limit, "limit")
         self.offset = const_int(selector.offset, "offset")
+        if self.host_order_by:
+            # host applies ordering AND offset/limit on decoded rows
+            self.host_shape = (self.host_order_by, self.offset, self.limit)
+            self.limit = self.offset = None
+        else:
+            self.host_shape = None
         self.sort_heavy = bool(self.order_by)
 
     def step(self, state, batch: EventBatch, now):

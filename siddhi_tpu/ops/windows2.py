@@ -1374,3 +1374,95 @@ class EmptyWindowOp(WindowOp):
 
     def findable_buffer(self, state):
         return empty_buffer(self.schema, 1)
+
+
+class HoppingWindowOp(WindowOp):
+    """#window.hopping(windowTime, hopTime): overlapping tumbling windows.
+    Every hopTime the retained last-windowTime of events flushes as one
+    CURRENT batch (events re-emit in every hop whose span covers them).
+
+    Reference note: HopingWindowProcessor.java:48 is an ABSTRACT extension
+    base (no concrete in-core subclass, no tests) that stamps a
+    `_hopingTimestamp` group key per hop; this op is the concrete
+    columnar equivalent — the hop boundary plays the group-key role, and
+    one flush per step carries all events of the closing hop span.
+    """
+
+    kind_name = "hopping"
+    is_batch = True
+
+    def __init__(self, schema, window_ms: int, hop_ms: int,
+                 cap: int = 4096, expired_enabled: bool = True):
+        super().__init__(schema, expired_enabled)
+        if hop_ms <= 0 or window_ms <= 0:
+            raise CompileError("hopping window needs positive durations")
+        self.W_ms = int(window_ms)
+        self.H_ms = int(hop_ms)
+        self.cap = int(cap)
+
+    def init_state(self):
+        return {"buf": empty_buffer(self.schema, self.cap),
+                "exp": empty_buffer(self.schema, self.cap),
+                "next_seq": jnp.int64(0),
+                "next_hop": jnp.int64(-1),
+                "overflow": jnp.int64(0)}
+
+    def step(self, state, batch: EventBatch, now):
+        B = batch.capacity
+        W = self.cap
+        now = jnp.asarray(now, dtype=jnp.int64)
+        cur, seq, next_seq = arrival_seqs(batch, state["next_seq"])
+        pool = make_pool(state["buf"], batch, seq, cur)
+        P = W + B
+        EB = W
+
+        next_hop = jnp.where(state["next_hop"] == -1, now + self.H_ms,
+                             state["next_hop"])
+        send = now >= next_hop
+        hop_at = next_hop
+        next_hop = jnp.where(send, next_hop + self.H_ms, next_hop)
+
+        # the closing hop covers (hop_at - windowTime, hop_at]
+        in_span = pool["valid"] & (pool["ts"] > hop_at - self.W_ms) & \
+            (pool["ts"] <= hop_at)
+        flushed = in_span & send
+
+        now_exp = jnp.broadcast_to(now, (EB,)).astype(jnp.int64)
+        out = {
+            "ts": jnp.concatenate([now_exp, pool["ts"]]),
+            "cols": tuple(jnp.concatenate([ec, pc]) for ec, pc in
+                          zip(state["exp"]["cols"], pool["cols"])),
+            "nulls": tuple(jnp.concatenate([en, pn]) for en, pn in
+                           zip(state["exp"]["nulls"], pool["nulls"])),
+            "kind": jnp.concatenate([
+                jnp.full((EB,), EXPIRED, jnp.int32),
+                jnp.full((P,), CURRENT, jnp.int32)]),
+        }
+        emit_row = jnp.zeros((EB + P,), jnp.int64)
+        phase = jnp.concatenate([jnp.zeros((EB,), jnp.int64),
+                                 jnp.full((P,), 2, jnp.int64)])
+        oseq = jnp.concatenate([state["exp"]["seq"], pool["seq"]])
+        exp_valid = (state["exp"]["valid"] & send) if self.expired_enabled \
+            else jnp.zeros((EB,), jnp.bool_)
+        valid = jnp.concatenate([exp_valid, flushed])
+        result = emission_sort(out, emit_row, phase, oseq, valid, EB + P)
+
+        # retain rows still inside ANY future hop (ts > next closing
+        # span's low edge); on send the flushed batch becomes the next
+        # expired set
+        keep = pool["valid"] & (pool["ts"] > next_hop - self.W_ms)
+        new_buf, overflow = keep_newest(
+            pool, jnp.where(send, keep, pool["valid"]), W)
+        new_exp_f, _ = keep_newest(pool, flushed, W)
+        new_exp = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(send, a, b), new_exp_f, state["exp"])
+        return ({"buf": new_buf, "exp": new_exp, "next_seq": next_seq,
+                 "next_hop": next_hop,
+                 "overflow": state["overflow"] + overflow}, result)
+
+    def next_due(self, state):
+        nh = state["next_hop"]
+        return jnp.where(nh == -1, POS_INF, nh)
+
+    def findable_buffer(self, state):
+        return state["exp"]

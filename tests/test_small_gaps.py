@@ -1,0 +1,183 @@
+"""Completeness gaps closed in round 4: uuid(), e[last] select refs,
+STRING order-by (host shaping), or-with-absent logical patterns.
+
+References: executor/function/UUIDFunctionExecutor.java,
+query/input/stream/state/AbsentLogicalPreStateProcessor.java:35,
+QuerySelector.orderEventChunk (STRING comparator).
+"""
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+
+
+def _run(ql, sends, target="O"):
+    rt = SiddhiManager().create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(target, StreamCallback(lambda e: got.extend(e)))
+    rt.start()
+    for sid, ts, data in sends:
+        rt.get_input_handler(sid).send(Event(ts, data))
+    return rt, got
+
+
+class TestUuid:
+    def test_unique_per_row(self):
+        rt, got = _run("""
+            @app:playback
+            define stream S (v int);
+            from S select v, uuid() as id insert into O;
+        """, [("S", 1000, (1,)), ("S", 1001, (2,))])
+        rt.shutdown()
+        ids = [e.data[1] for e in got]
+        assert len(ids) == 2 and ids[0] != ids[1]
+        assert all(len(i) == 36 and i.count("-") == 4 for i in ids)
+
+
+class TestLastRefs:
+    def test_last_and_indexed(self):
+        rt, got = _run("""
+            @app:playback
+            define stream A (sym string, v int);
+            define stream B (v int);
+            @info(name='q')
+            from e1=A[v > 0]<1:4> -> e2=B[v > 100]
+            select e1[0].sym as first_sym, e1[last].sym as last_sym
+            insert into O;
+        """, [("A", 1000, ("X", 1)), ("A", 1001, ("Y", 2)),
+              ("A", 1002, ("Z", 3)), ("B", 1003, (200,))])
+        rt.shutdown()
+        assert ("X", "Z") in [tuple(e.data) for e in got]
+
+    def test_last_minus_one(self):
+        rt, got = _run("""
+            @app:playback
+            define stream A (v int);
+            define stream B (v int);
+            @info(name='q')
+            from e1=A[v > 0]<1:4> -> e2=B[v > 100]
+            select e1[last - 1].v as second_last insert into O;
+        """, [("A", 1000, (1,)), ("A", 1001, (2,)), ("A", 1002, (3,)),
+              ("B", 1003, (200,))])
+        rt.shutdown()
+        assert got and got[0].data[0] == 2
+
+
+class TestStringOrderBy:
+    def test_order_and_limit_on_host(self):
+        rt, got = _run("""
+            @app:playback
+            define stream S (sym string, v int);
+            @info(name='q')
+            from S#window.lengthBatch(4)
+            select sym, v order by sym limit 3 insert into O;
+        """, [("S", 1000 + i, (sym, i))
+              for i, sym in enumerate(["zeta", "alpha", "mike", "beta"])])
+        rt.shutdown()
+        assert [e.data[0] for e in got] == ["alpha", "beta", "mike"]
+
+    def test_desc_with_offset(self):
+        rt, got = _run("""
+            @app:playback
+            define stream S (sym string);
+            @info(name='q')
+            from S#window.lengthBatch(3)
+            select sym order by sym desc offset 1 insert into O;
+        """, [("S", 1000 + i, (s,)) for i, s in
+              enumerate(["a", "c", "b"])])
+        rt.shutdown()
+        assert [e.data[0] for e in got] == ["b", "a"]
+
+
+class TestOrWithAbsent:
+    def test_or_fires_on_present_side(self):
+        rt, got = _run("""
+            @app:playback
+            define stream A (v int);
+            define stream B (v int);
+            define stream C (v int);
+            @info(name='q')
+            from e1=C[v > 0] -> e2=A[v > 10] or not B[v > 0] for 1 sec
+            select e1.v as c, e2.v as a insert into O;
+        """, [("C", 1000, (1,)), ("A", 1200, (50,))])
+        rt.shutdown()
+        assert [tuple(e.data) for e in got] == [(1, 50)]
+
+    def test_or_fires_on_deadline_when_absent_held(self):
+        rt, got = _run("""
+            @app:playback
+            define stream A (v int);
+            define stream B (v int);
+            define stream C (v int);
+            @info(name='q')
+            from e1=C[v > 0] -> e2=A[v > 10] or not B[v > 0] for 1 sec
+            select e1.v as c, e2.v as a insert into O;
+        """, [("C", 1000, (1,))])
+        with rt.barrier:
+            rt.on_ingest_ts(2500)     # deadline 2000 passes
+        rt.shutdown()
+        assert len(got) == 1 and got[0].data[0] == 1
+        assert got[0].data[1] is None  # e2 slot never filled
+
+    def test_or_absent_side_killed_by_arrival_still_completable(self):
+        rt, got = _run("""
+            @app:playback
+            define stream A (v int);
+            define stream B (v int);
+            define stream C (v int);
+            @info(name='q')
+            from e1=C[v > 0] -> e2=A[v > 10] or not B[v > 0] for 1 sec
+            select e1.v as c, e2.v as a insert into O;
+        """, [("C", 1000, (1,)), ("B", 1200, (5,)),   # kills absent side
+              ("A", 1400, (60,))])                    # A still completes
+        rt.shutdown()
+        assert [tuple(e.data) for e in got] == [(1, 60)]
+
+    def test_both_absent_or_fires_at_first_deadline(self):
+        rt, got = _run("""
+            @app:playback
+            define stream A (v int);
+            define stream B (v int);
+            define stream C (v int);
+            @info(name='q')
+            from e1=C[v > 0] ->
+                 not A[v > 0] for 1 sec or not B[v > 0] for 2 sec
+            select e1.v as c insert into O;
+        """, [("C", 1000, (1,))])
+        with rt.barrier:
+            rt.on_ingest_ts(2300)     # first deadline (2000) passed
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [1]
+
+    def test_both_absent_and_needs_both_deadlines(self):
+        ql = """
+            @app:playback
+            define stream A (v int);
+            define stream B (v int);
+            define stream C (v int);
+            @info(name='q')
+            from e1=C[v > 0] ->
+                 not A[v > 0] for 1 sec and not B[v > 0] for 2 sec
+            select e1.v as c insert into O;
+        """
+        rt, got = _run(ql, [("C", 1000, (1,))])
+        with rt.barrier:
+            rt.on_ingest_ts(2300)     # only the first deadline passed
+        assert got == []
+        with rt.barrier:
+            rt.on_ingest_ts(3300)     # both passed
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [1]
+
+    def test_both_absent_and_killed_by_arrival(self):
+        rt, got = _run("""
+            @app:playback
+            define stream A (v int);
+            define stream B (v int);
+            define stream C (v int);
+            @info(name='q')
+            from e1=C[v > 0] ->
+                 not A[v > 0] for 1 sec and not B[v > 0] for 2 sec
+            select e1.v as c insert into O;
+        """, [("C", 1000, (1,)), ("B", 2500, (3,))])  # B within its wait
+        with rt.barrier:
+            rt.on_ingest_ts(4000)
+        rt.shutdown()
+        assert got == []

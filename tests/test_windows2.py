@@ -434,3 +434,31 @@ class TestCronWindow:
         d2 = dt.datetime.fromtimestamp(s.next_fire(nf) / 1000,
                                        tz=dt.timezone.utc)
         assert (d2.day, d2.hour, d2.minute) == (2, 9, 30)
+
+
+class TestHoppingWindow:
+    def test_overlapping_hops(self):
+        # window 2s, hop 1s: each flush carries the last 2s of events, so
+        # events re-emit across overlapping hops
+        from siddhi_tpu import Event, SiddhiManager, QueryCallback
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+            @app:playback
+            define stream S (v int);
+            @info(name = 'q')
+            from S#window.hopping(2 sec, 1 sec)
+            select v insert into O;
+        """)
+        flushes = []
+        rt.add_callback("q", QueryCallback(
+            fn=lambda ts, ins, rms: flushes.append(
+                [e.data[0] for e in (ins or [])])))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(Event(1000, (1,)))     # arms hop at 2000
+        h.send(Event(1500, (2,)))
+        h.send(Event(2500, (3,)))     # crosses hop 2000: flush {1,2}
+        h.send(Event(3500, (4,)))     # crosses hop 3000: flush {2,3}
+        rt.shutdown()
+        assert flushes[0] == [1, 2]
+        assert flushes[1] == [2, 3]   # 2 re-emitted (overlap), 1 aged out
