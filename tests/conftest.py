@@ -15,7 +15,9 @@ import os
 os.environ.setdefault(
     "SIDDHI_TPU_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
-                 ".jax_cache"))
+                 ".jax_cache", "cpu"))  # separate from the TPU bench cache:
+# sharing one dir makes XLA load AOT results whose machine-feature sets
+# differ (SIGILL risk warning)
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
