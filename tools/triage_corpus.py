@@ -3,8 +3,23 @@ engine divergence (with counts). Dev tool for burning down
 tests/ref_corpus/known_failures.txt."""
 import collections
 import json
+import os
 import pathlib
 import sys
+
+# force the CPU platform BEFORE jax loads: the axon sitecustomize
+# overrides JAX_PLATFORMS, so the env var alone is not enough
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8"
+                           ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault(
+    "SIDDHI_TPU_CACHE_DIR",
+    str(pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
+        / "cpu"))
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
