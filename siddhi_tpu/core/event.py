@@ -17,6 +17,7 @@ Java null semantics through arithmetic (see ops/expr.py).
 from __future__ import annotations
 
 import dataclasses
+import itertools
 from typing import Any, Sequence
 
 import jax
@@ -205,9 +206,18 @@ def batch_from_columns(
                       valid=valid)
 
 
+_UUID_BATCH_NONCE = itertools.count()
+
+
 def rows_from_batch(schema_types: Sequence[AttrType], batch) -> list:
     """Host-side: decode a device EventBatch into
-    (timestamp, kind, tuple(values)) rows, in row order, skipping padding."""
+    (timestamp, kind, tuple(values)) rows, in row order, skipping padding.
+
+    uuid() sentinel cells materialize here with a per-decode nonce in the
+    key: unique across batches, stable within one decode. Callers that
+    deliver one emission to several consumers decode once and share the
+    rows (QueryRuntime._dispatch_output.rows_once)."""
+    nonce = next(_UUID_BATCH_NONCE)
     ts = np.asarray(batch.ts)
     kind = np.asarray(batch.kind)
     valid = np.asarray(batch.valid)
@@ -222,7 +232,8 @@ def rows_from_batch(schema_types: Sequence[AttrType], batch) -> list:
             if nulls[i][r]:
                 vals.append(None)
             elif t is AttrType.STRING:
-                vals.append(GLOBAL_STRINGS.decode(cols[i][r]))
+                vals.append(GLOBAL_STRINGS.decode(
+                    cols[i][r], uuid_key=(nonce, int(ts[r]), r, i)))
             elif t is AttrType.BOOL:
                 vals.append(bool(cols[i][r]))
             elif t in (AttrType.FLOAT, AttrType.DOUBLE):

@@ -68,13 +68,15 @@ def _batch_of_buffer(buf: dict) -> EventBatch:
     )
 
 
-def _decode(values, nulls, typ):
+def _decode(values, nulls, typ, key_tag="od", row_ids=None):
     out = []
-    for v, nl in zip(values, nulls):
+    for r, (v, nl) in enumerate(zip(values, nulls)):
         if nl:
             out.append(None)
         elif typ is AttrType.STRING:
-            out.append(GLOBAL_STRINGS.decode(int(v)))
+            rid = int(row_ids[r]) if row_ids is not None else r
+            out.append(GLOBAL_STRINGS.decode(
+                int(v), uuid_key=("od", key_tag, rid)))
         elif typ is AttrType.BOOL:
             out.append(bool(v))
         elif typ in (AttrType.FLOAT, AttrType.DOUBLE):
@@ -99,7 +101,9 @@ def rows_of_table(table) -> list:
             if nl:
                 vals.append(None)
             elif t is AttrType.STRING:
-                vals.append(GLOBAL_STRINGS.decode(int(v)))
+                vals.append(GLOBAL_STRINGS.decode(
+                    int(v), uuid_key=("row", table.table_id,
+                                      int(st["seq"][i]), c)))
             elif t is AttrType.BOOL:
                 vals.append(bool(v))
             elif t in (AttrType.FLOAT, AttrType.DOUBLE):
@@ -209,7 +213,7 @@ class OnDemandExecutor:
             c = cond.fn(env)
             mask = mask & c.values & ~c.nulls
         if out is None or isinstance(out, A.ReturnStream):
-            return self._select(q, schema, scope, env, mask)
+            return self._select(q, schema, scope, env, mask, buf)
         if table is None:
             raise CompileError(
                 "on-demand writes target tables, not windows")
@@ -224,21 +228,32 @@ class OnDemandExecutor:
             f"unsupported on-demand output {type(out).__name__}")
 
     # -- SELECT ----------------------------------------------------------
-    def _select(self, q, schema, scope, env, mask):
+    def _select(self, q, schema, scope, env, mask, buf=None):
         sel = q.selector
         mask_h = np.asarray(jax.device_get(mask))
         idx = np.nonzero(mask_h)[0]
 
-        def eval_rows(expr):
+        row_ids = None
+        if isinstance(buf, dict) and "seq" in buf:
+            # stable per-row identity: uuid() cells survive re-reads of
+            # the same stored row and never collide across rows
+            row_ids = np.asarray(jax.device_get(buf["seq"]))[idx]
+
+        def eval_rows(expr, pos=0):
             ce = compile_expression(expr, scope)
             c = ce.fn(env)
             vals = np.asarray(jax.device_get(c.values))[idx]
             nulls = np.asarray(jax.device_get(c.nulls))[idx]
-            return _decode(vals, nulls, ce.type)
+            # column-identity tag (position + expression): uuid() cells
+            # stay distinct per column and stable across repeated queries
+            return _decode(vals, nulls, ce.type,
+                           key_tag=(q.input_id, pos, repr(expr)),
+                           row_ids=row_ids)
 
         if sel.select_all or not sel.attributes:
             names = [a.name for a in schema.attributes]
-            cols = [eval_rows(A.Variable(attribute=n)) for n in names]
+            cols = [eval_rows(A.Variable(attribute=n), p)
+                    for p, n in enumerate(names)]
             rows = [tuple(col[i] for col in cols)
                     for i in range(len(idx))]
             return self._order_limit(q, rows, names)
@@ -248,7 +263,8 @@ class OnDemandExecutor:
         names = [output_attribute_name(oa, i)
                  for i, oa in enumerate(sel.attributes)]
         if not has_agg:
-            cols = [eval_rows(oa.expression) for oa in sel.attributes]
+            cols = [eval_rows(oa.expression, p)
+                for p, oa in enumerate(sel.attributes)]
             rows = [tuple(col[i] for col in cols)
                     for i in range(len(idx))]
             return self._order_limit(q, rows, names)
@@ -261,15 +277,15 @@ class OnDemandExecutor:
             k = tuple(col[i] for col in gb_cols) if gb_cols else ()
             groups.setdefault(k, []).append(i)
         attr_plans = []
-        for oa in sel.attributes:
+        for p, oa in enumerate(sel.attributes):
             agg = _find_agg(oa.expression)
             if agg is not None:
                 name, arg = agg
-                vals = eval_rows(arg) if arg is not None else [1] * n
+                vals = eval_rows(arg, p) if arg is not None else [1] * n
                 attr_plans.append(("agg", name, vals))
             else:
                 attr_plans.append(("plain", None,
-                                   eval_rows(oa.expression)))
+                                   eval_rows(oa.expression, p)))
         rows = []
         for k, members in groups.items():
             row = []

@@ -600,12 +600,22 @@ class QueryRuntime(Receiver):
         handler/callback delivery."""
         for cb in self.batch_callbacks:
             cb(out)
+        # decode at most ONCE per emission: every delivery path (debugger,
+        # rate limiter, handlers, callbacks) shares the same rows, so
+        # per-row uuid() cells agree across paths
+        _decoded: list = []
+
+        def rows_once(host_batch):
+            if not _decoded:
+                _decoded.append(rows_from_batch(self.out_schema.types,
+                                                host_batch))
+            return _decoded[0]
+
         dbg = self.app.debugger
         if dbg is not None:
             from .debugger import QueryTerminal
             if (self.name, QueryTerminal.OUT) in dbg._breakpoints:
-                rows = rows_from_batch(self.out_schema.types,
-                                       jax.device_get(out))
+                rows = rows_once(jax.device_get(out))
                 dbg.check_break_point(
                     self.name, QueryTerminal.OUT,
                     [Event(ts, vals, is_expired=(k == EXPIRED))
@@ -616,7 +626,7 @@ class QueryRuntime(Receiver):
                 self._schedule(int(due_host))
             else:
                 out_host = jax.device_get(out)
-            rows = rows_from_batch(self.out_schema.types, out_host)
+            rows = rows_once(out_host)
             if rows:
                 self.rate_limiter.process(timestamp, rows)
             return
@@ -636,7 +646,7 @@ class QueryRuntime(Receiver):
                 # (app._resolve_dues) — by then the copy has landed
                 self.app.defer_due(self, due)
             return
-        out_rows = rows_from_batch(self.out_schema.types, out_host)
+        out_rows = rows_once(out_host)
         if not out_rows:
             return
         out_rows = self._host_shape_rows(out_rows)
@@ -750,6 +760,7 @@ class PatternQueryRuntime(QueryRuntime):
         self._stream_steps: dict = {}
         self._timer_step: Optional[Callable] = None
         self._due_fn: Optional[Callable] = None
+        self._arm_start_fn: Optional[Callable] = None
 
     def receive(self, events: list[Event]) -> None:
         raise RuntimeError(
@@ -774,6 +785,16 @@ class PatternQueryRuntime(QueryRuntime):
             self._sched_due = None
 
     def reschedule(self) -> None:
+        self._schedule_absent()
+
+    def arm_start_deadlines(self, ts: int) -> None:
+        """Base start-state absent deadlines at app start time
+        (AbsentStreamPreStateProcessor.partitionCreated:291-308)."""
+        with self._lock:
+            if self._arm_start_fn is None:
+                self._arm_start_fn = jax.jit(self.engine.arm_start)
+            self.nfa_state = self._arm_start_fn(self.nfa_state,
+                                                np.int64(ts))
         self._schedule_absent()
 
     # -- absent-pattern timers -------------------------------------------
@@ -1214,6 +1235,21 @@ class SiddhiAppRuntime:
         self.scheduler.resolve_hook = self._resolve_dues
         Planner(self).plan()
         self.scheduler.playback = self._playback
+        # start-state absent deadlines are based at app start, not the
+        # first event (AbsentStreamPreStateProcessor.partitionCreated);
+        # under playback the base is the first observed virtual tick
+        self._unarmed_patterns = [
+            q for q in self.queries.values()
+            if getattr(getattr(q, "engine", None),
+                       "needs_start_arm", False)]
+        # record which queries compiled device reads against a @Cache
+        # table — losing cache completeness must be surfaced to them
+        # (the device join cannot fall back to the store mid-jit)
+        for q in self.queries.values():
+            for t in getattr(q, "table_deps", ()):
+                rt = self.record_tables.get(t)
+                if rt is not None and hasattr(rt, "compiled_readers"):
+                    rt.compiled_readers.add(q.name)
 
     # -- time ------------------------------------------------------------
     def current_time(self) -> int:
@@ -1249,6 +1285,11 @@ class SiddhiAppRuntime:
         timestamp — shared by the row and columnar ingest paths."""
         self._resolve_dues()
         if self._playback:
+            if self._unarmed_patterns:
+                base = first_ts if first_ts is not None else last_ts
+                pats, self._unarmed_patterns = self._unarmed_patterns, []
+                for q in pats:
+                    q.arm_start_deadlines(base)
             if not self._cron_armed:
                 # playback cron schedules anchor at the first event time
                 self._cron_armed = True
@@ -1337,6 +1378,13 @@ class SiddhiAppRuntime:
                 entry["state_bytes"] = pytree_nbytes(
                     jax.device_get(q.states))
             report[n] = entry
+        for tid, rt in self.record_tables.items():
+            if hasattr(rt, "cache_complete"):
+                report[f"store:{tid}"] = {
+                    "cache_complete": bool(rt.cache_complete),
+                    "completeness_losses": rt.completeness_losses,
+                    "compiled_readers": sorted(rt.compiled_readers),
+                }
         return report
 
     def debug(self):
@@ -1355,6 +1403,11 @@ class SiddhiAppRuntime:
             s.connect()
         if not self._playback:
             self._arm_cron(self.current_time())
+            if self._unarmed_patterns:
+                now = self.current_time()
+                pats, self._unarmed_patterns = self._unarmed_patterns, []
+                for q in pats:
+                    q.arm_start_deadlines(now)
 
     def _start_record_tables(self) -> None:
         from .store import CacheTableRuntime
@@ -2445,16 +2498,18 @@ class Planner:
         slots, states = compiler.compile(sin.state)
         # e[last] / e[last - k] select refs -> ifThenElse chains over the
         # slot's copy columns (nfa.rewrite_last_refs)
-        from ..ops.nfa import rewrite_last_refs
+        from ..ops.nfa import rewrite_last_refs, rewrite_oob_refs
         sel = q.selector
         if sel.attributes:
             sel.attributes = [
                 dataclasses.replace(
-                    oa, expression=rewrite_last_refs(oa.expression, slots))
+                    oa, expression=rewrite_oob_refs(
+                        rewrite_last_refs(oa.expression, slots), slots))
                 for oa in sel.attributes]
         if sel.having is not None:
-            sel.having = rewrite_last_refs(sel.having, slots)
-        if parallel_supported(slots, states):
+            sel.having = rewrite_oob_refs(
+                rewrite_last_refs(sel.having, slots), slots)
+        if parallel_supported(slots, states, sin.state_type):
             # the TPU-shaped round-parallel engine (larger pending table —
             # its grids are cheap; the scan engine stays small)
             engine = ParallelNfaEngine(slots, states, sin.state_type,
@@ -2474,7 +2529,8 @@ class Planner:
         else:
             sel_ops.append(ProjectOp(
                 q.selector, engine.match_schema, target, scope,
-                current_on=current_on, expired_on=expired_on))
+                current_on=current_on, expired_on=expired_on,
+                having_in_scope=scope))
 
         if name in app.queries:
             raise CompileError(f"duplicate query name '{name}'")

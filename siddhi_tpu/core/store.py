@@ -502,9 +502,29 @@ class CacheTableRuntime(RecordTableRuntime):
         # incomplete results (CacheTable serves reads from cache only
         # when the table fits; otherwise queries go to the store)
         self.cache_complete = False
+        # queries whose jitted joins/filters read the device cache table
+        # directly (registered by the planner). The host find_rows path
+        # falls back to the store when incomplete; the device path CANNOT
+        # — so losing completeness with compiled readers is surfaced
+        # loudly (once per loss) and counted for statistics()
+        self.compiled_readers: set = set()
+        self.completeness_losses = 0
         # clock for retention/recency: wired to the app's current_time by
         # the planner so playback apps expire on event time
         self.now_fn = lambda: int(time.time() * 1000)
+
+    def _lose_completeness(self, reason: str) -> None:
+        if self.cache_complete:
+            self.completeness_losses += 1
+            if self.compiled_readers:
+                import logging
+                logging.getLogger("siddhi_tpu.store").warning(
+                    "store table '%s': cache lost completeness (%s); "
+                    "device-compiled reads in %s now see a PARTIAL "
+                    "snapshot until the cache is reloaded",
+                    self.table_id, reason,
+                    sorted(self.compiled_readers))
+        self.cache_complete = False
 
     # -- policy bookkeeping ----------------------------------------------
     def _touch(self, rows: Iterable[tuple], now_ms: int) -> None:
@@ -558,13 +578,13 @@ class CacheTableRuntime(RecordTableRuntime):
         # the store, so completeness is void
         if len(fresh) > self.max_size:
             fresh = fresh[: self.max_size]
-            self.cache_complete = False
+            self._lose_completeness("admission truncated at cache size")
         if not fresh:
             return
         overflow = len(current) + len(fresh) - self.max_size
         if overflow > 0:
             self._cache_delete(self._evict_candidates(overflow))
-            self.cache_complete = False
+            self._lose_completeness("eviction (cache over max_size)")
         from .ondemand import insert_rows_of_table
         insert_rows_of_table(self.cache, fresh, now_ms)
         self._note_add(fresh, now_ms)
@@ -587,7 +607,7 @@ class CacheTableRuntime(RecordTableRuntime):
                      if now_ms - t > self.retention_ms]
         if stale:
             self._cache_delete(stale)
-            self.cache_complete = False
+            self._lose_completeness("retention purge")
 
     # -- reads: cache only when provably complete ------------------------
     def find_rows(self, cond, event_rows):
@@ -642,7 +662,7 @@ class CacheTableRuntime(RecordTableRuntime):
             params = cond.bind(ev)
             stale.extend(r for r in cached if cond.matches(r, params))
         self._cache_delete(stale)
-        self.cache_complete = False
+        self._lose_completeness("write invalidation")
 
 
 # ---------------------------------------------------------------------------

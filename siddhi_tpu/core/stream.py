@@ -288,6 +288,11 @@ class InputHandler:
         with self.app.barrier:
             self.app.on_ingest(self.stream_id, events)
             self.junction.publish(events)
+            # timers armed DURING processing (e.g. hop boundaries the
+            # chunk's own event-time jump crossed) fire now, not at the
+            # next external tick
+            if self.app._playback and self.app._playback_time is not None:
+                self.app.scheduler.advance_to(self.app._playback_time)
 
     def send_arrays(self, ts, cols) -> None:
         """Columnar ingest: numpy timestamp + data column arrays
@@ -335,7 +340,7 @@ class InputHandler:
             c = [col[start:start + max_cap] for col in cols]
             last_ts = int(t[-1])
             with self.app.barrier:
-                self.app.on_ingest_ts(last_ts)
+                self.app.on_ingest_ts(last_ts, int(t[0]))
                 if packed_ok:
                     if self._encoder is None:
                         self._encoder = PackedEncoder(self.junction.schema)
@@ -349,6 +354,9 @@ class InputHandler:
                         self.junction.schema, t, c,
                         capacity=bucket_capacity(len(t)))
                     self.junction.publish_batch(batch, last_ts)
+                if self.app._playback:
+                    # fire timers the chunk's own event-time jump armed
+                    self.app.scheduler.advance_to(last_ts)
 
 
 class StreamCallback(Receiver):

@@ -77,6 +77,11 @@ def promote(a: AttrType, b: AttrType) -> AttrType:
 
 # interned marker object for uuid() sentinel codes (identity-compared)
 UUID_MARKER = "\x00uuid\x00"
+# per-process namespace: uuid() values are unique across processes and
+# stable across repeated decodes of the same row within one process
+import uuid as _uuid_mod  # noqa: E402
+
+_UUID_SALT = _uuid_mod.uuid4()
 
 
 class StringTable:
@@ -112,14 +117,19 @@ class StringTable:
                     self._to_code[s] = code
         return code
 
-    def decode(self, code: int):
+    def decode(self, code: int, uuid_key=None):
         s = self._to_str[int(code)]
         if s == UUID_MARKER:
-            # uuid() columns carry a sentinel code on device; each decoded
-            # row materializes a fresh UUID at the host boundary
-            # (UUIDFunctionExecutor.java generates per-event UUIDs)
+            # uuid() columns carry a sentinel code on device; the host
+            # boundary materializes the UUID (UUIDFunctionExecutor.java
+            # generates per-event UUIDs). With a uuid_key (timestamp/row/
+            # column coordinates) the value is a salted deterministic
+            # uuid5 so REPEATED decodes of the same emitted/stored row
+            # agree across delivery paths; without one it is random.
             import uuid as _uuid
-            return str(_uuid.uuid4())
+            if uuid_key is None:
+                return str(_uuid.uuid4())
+            return str(_uuid.uuid5(_UUID_SALT, repr(uuid_key)))
         return s
 
     def __len__(self):

@@ -133,9 +133,12 @@ def compile_expression(expr: A.Expression, scope: Scope,
         if isinstance(e, A.Constant):
             t = e.type
             if e.value is None:
-                # untyped NULL literal: treated as an always-null DOUBLE
-                cv = Col.const(None, AttrType.DOUBLE)
-                return CompiledExpr(AttrType.DOUBLE, lambda env: cv,
+                # NULL literal: typed when the AST says so (e.g. an
+                # out-of-range e[i].attr rewritten to the attribute's
+                # type), DOUBLE otherwise
+                nt = t if isinstance(t, AttrType) else AttrType.DOUBLE
+                cv = Col.const(None, nt)
+                return CompiledExpr(nt, lambda env, c=cv: c,
                                     const_value=None, is_const=True)
             cv = Col.const(e.value, t)
             return CompiledExpr(t, lambda env, c=cv: c,

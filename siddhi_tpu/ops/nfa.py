@@ -74,6 +74,15 @@ class NfaStateSpec:
     is_start: bool = False
     always_armed: bool = False  # implicit empty pending at every event
     armed_once: bool = False    # explicit initial pending at t=0
+    # sequence start refinements (StreamPreStateProcessor.init():178-194,
+    # resetState():288-305 — see compile() for the per-shape mapping)
+    rearm_each_round: bool = False   # every-scoped seq start: respawn an
+    # empty pending at each event round when none is live
+    suppress_when_next_busy: bool = False  # plain seq start before an
+    # absent state: no new attempt while the wait is pending
+    viol_push: bool = False     # absent start: a violating event re-arms
+    # the deadline to ev_ts + waiting_ms instead of killing the row
+    # (AbsentStreamPostStateProcessor.process:55 updateLastArrivalTime)
     min_count: int = 1
     max_count: int = 1          # -1 == unbounded
     # logical and/or groups (LogicalPreStateProcessor.java:33): both sides
@@ -116,12 +125,13 @@ class NfaCompiler:
                 st.anchor = st.idx
         start = self.states[entry]
         start.is_start = True
+        if start.partner >= 0:
+            self.states[start.partner].is_start = True
         plain_start = start.partner < 0 and not start.is_absent
+        # is the start state re-armed by an `every` scope?
+        every_start = any(s.every_arm == entry for s in self.states)
         if self.state_type == "sequence":
-            if not plain_start:
-                raise CompileError(
-                    "logical/absent states cannot start a sequence")
-            start.always_armed = True
+            self._compile_sequence_start(start, plain_start, every_start)
         elif plain_start and (start.every_arm == start.idx or (
                 start.idx in [self.states[e].every_arm
                               for e in range(len(self.states))]
@@ -129,14 +139,81 @@ class NfaCompiler:
             start.always_armed = True
         else:
             start.armed_once = True
+            # pattern-start absents: a violating event pushes the deadline
+            # (the scheduler re-creates the pending and fires at the pushed
+            # lastScheduledTime — AbsentStreamPreStateProcessor.process:
+            # 163-179 initialize, :216-223 reschedule); exception: absent
+            # sides paired with a PRESENT partner die on violation
+            # (AbsentLogicalPreStateProcessor.partnerCanProceed:352-386)
+            group = [start] + ([self.states[start.partner]]
+                               if start.partner >= 0 else [])
+            for st in group:
+                if st.is_absent and st.waiting_ms > 0:
+                    p = self.states[st.partner] if st.partner >= 0 else None
+                    if p is None or p.is_absent or st.logical_op == "or":
+                        st.viol_push = True
         # single-state every scopes collapse re-arm into always_armed
         for st in self.states:
             if st.is_start and any(
                     s.every_arm == st.idx and s.idx == st.idx
                     for s in self.states):
-                st.always_armed = True
-                st.armed_once = False
+                if self.state_type != "sequence" and st.partner < 0 \
+                        and not st.is_absent:
+                    st.always_armed = True
+                    st.armed_once = False
         return self.slots, self.states
+
+    def _compile_sequence_start(self, start, plain_start: bool,
+                                every_start: bool):
+        """Sequence start arming (StreamPreStateProcessor.init():178-194):
+        - plain non-every start: ONE initial pending, never re-armed
+          (`initialized` latches; SequenceTestCase testQuery29/31)
+        - plain start whose next state is absent: re-initialized each round
+          unless the wait is pending (init() nextState-instanceof-Absent
+          clause + resetState early return)
+        - every-scoped starts: re-initialized at every event round
+        - absent/logical starts: initial pending; violations push the
+          deadline for every-scoped (and pattern-like) shapes, kill
+          permanently for non-every sequences"""
+        nxt = self.states[start.next_idx] \
+            if 0 <= start.next_idx < len(self.states) else None
+        if plain_start:
+            if start.is_counting:
+                if every_start:
+                    # every-scoped counting starts re-init per round
+                    # (CountPreStateProcessor.startStateReset:168) —
+                    # always-armed keeps the parallel-engine fast path
+                    start.always_armed = True
+                else:
+                    # ONE absorbing pending for the whole run
+                    start.armed_once = True
+            elif every_start:
+                start.armed_once = True
+                start.rearm_each_round = True
+            elif nxt is not None and (
+                    nxt.is_absent or (nxt.partner >= 0 and (
+                        nxt.is_absent
+                        or self.states[nxt.partner].is_absent))):
+                start.always_armed = True
+                start.suppress_when_next_busy = not every_start
+            else:
+                start.armed_once = True   # one-shot
+        else:
+            start.armed_once = True
+            if every_start:
+                start.rearm_each_round = True
+            group = [start] + ([self.states[start.partner]]
+                               if start.partner >= 0 else [])
+            for st in group:
+                if st.is_absent and st.waiting_ms > 0:
+                    p = self.states[st.partner] if st.partner >= 0 else None
+                    partner_present = p is not None and not p.is_absent \
+                        and st.logical_op == "and"
+                    # sequence non-every: violation latches lastArrivalTime
+                    # and initialize is suppressed -> permanent kill
+                    # (AbsentStreamPreStateProcessor.process:166-170);
+                    # every-scoped starts push instead
+                    st.viol_push = every_start and not partner_present
 
     def _single_state_scope(self, start) -> bool:
         return any(s.every_arm == start.idx and s.idx == start.idx
@@ -310,6 +387,29 @@ class PatternScope(Scope):
         return key, spec.schema.types[a]
 
 
+def _slot_for(stream_ref, slots):
+    """The SlotSpec a variable's stream reference binds to (or None)."""
+    for sp in slots:
+        if sp.ref == stream_ref or (
+                sp.ref is None and sp.stream_id == stream_ref):
+            return sp
+    return None
+
+
+def _map_children(expr, fn):
+    """Rebuild a dataclass AST node with fn applied to every Expression
+    child (single fields and lists)."""
+    for f in getattr(expr, "__dataclass_fields__", {}):
+        v = getattr(expr, f)
+        if hasattr(v, "__dataclass_fields__") and isinstance(
+                v, A.Expression):
+            expr = dataclasses.replace(expr, **{f: fn(v)})
+        elif isinstance(v, list) and v and isinstance(
+                v[0], A.Expression):
+            expr = dataclasses.replace(expr, **{f: [fn(x) for x in v]})
+    return expr
+
+
 def rewrite_last_refs(expr, slots):
     """Replace `e[last]` / `e[last - k]` select references with an
     ifThenElse chain over the slot's copy columns (highest non-null copy
@@ -326,12 +426,7 @@ def rewrite_last_refs(expr, slots):
             k = int(idx[1])
         else:
             return expr
-        slot = None
-        for sp in slots:
-            if sp.ref == expr.stream_ref or (
-                    sp.ref is None and sp.stream_id == expr.stream_ref):
-                slot = sp
-                break
+        slot = _slot_for(expr.stream_ref, slots)
         if slot is None or slot.cap <= 1:
             return dataclasses.replace(expr, index=0)
 
@@ -346,17 +441,23 @@ def rewrite_last_refs(expr, slots):
                 parameters=[A.Not(A.IsNull(expr=ref(j))),
                             ref(j - k), out])
         return out
-    for f in getattr(expr, "__dataclass_fields__", {}):
-        v = getattr(expr, f)
-        if hasattr(v, "__dataclass_fields__") and isinstance(
-                v, A.Expression):
-            expr = dataclasses.replace(
-                expr, **{f: rewrite_last_refs(v, slots)})
-        elif isinstance(v, list) and v and isinstance(
-                v[0], A.Expression):
-            expr = dataclasses.replace(
-                expr, **{f: [rewrite_last_refs(x, slots) for x in v]})
-    return expr
+    return _map_children(expr, lambda v: rewrite_last_refs(v, slots))
+
+
+def rewrite_oob_refs(expr, slots):
+    """Replace e[i] references whose copy index exceeds the slot's count
+    capacity with a typed NULL literal — the reference returns null there
+    (StateMetaStreamEvent default-null beyond captured copies)."""
+    if isinstance(expr, A.Variable) and isinstance(expr.index, int):
+        sp = _slot_for(expr.stream_ref, slots)
+        if sp is not None and expr.index >= sp.cap:
+            try:
+                t = sp.schema.types[sp.schema.index_of(expr.attribute)]
+            except KeyError:
+                t = AttrType.DOUBLE
+            return A.Constant(value=None, type=t)
+        return expr
+    return _map_children(expr, lambda v: rewrite_oob_refs(v, slots))
 
 
 class MatchScope(PatternScope):
@@ -518,6 +619,10 @@ class NfaEngine:
                      if cs.is_counting and cs.next_idx == st.idx]
             for st in consuming}
 
+        seq = self.state_type == "sequence"
+        rearm_starts = [st for st in self.states
+                        if st.rearm_each_round] if seq else []
+
         def event_body(carry, ev):
             table, out = carry
             (ev_ts, ev_kind, ev_valid, ev_cols, ev_nulls) = ev
@@ -531,6 +636,37 @@ class NfaEngine:
 
             counter = table["counter"]
             live = table["valid"]
+
+            if seq:
+                # sequence stabilize (SequenceMultiProcessStreamReceiver
+                # .stabilizeStates -> resetState): a pending forwarded at
+                # round r is promoted at r+1 and cleared at r+2 — kill
+                # rows that survived one full promoted round. Exempt:
+                # half-filled logical AND groups (LogicalPreStateProcessor
+                # .resetState skips clearing when pending sizes differ)
+                # and counting states (their own absorb lifecycle).
+                stale = live & (table["born"] <= counter - 2) & ev_valid
+                exempt = jnp.zeros((M,), jnp.bool_)
+                for st in self.states:
+                    if st.partner >= 0 and st.anchor == st.idx and \
+                            st.logical_op == "and":
+                        p = self.states[st.partner]
+                        nl = table["slots"][st.slot]["n"] > 0
+                        nr = table["slots"][p.slot]["n"] > 0
+                        exempt = exempt | (
+                            (table["state"] == st.anchor) & (nl ^ nr))
+                    if st.is_counting:
+                        exempt = exempt | (table["state"] == st.idx)
+                live = live & ~(stale & ~exempt)
+                table = {**table, "valid": live}
+                # every-scoped sequence starts re-initialize an empty
+                # pending at each round (resetState -> init() with
+                # nextEveryStatePreProcessor set)
+                for st in rearm_starts:
+                    table = self._spawn_empty(table, st.anchor, counter,
+                                              ev_valid)
+                live = table["valid"]
+
             mature = live & (table["born"] < counter)
 
             # within expiry (any valid event advances observed time)
@@ -585,13 +721,32 @@ class NfaEngine:
                     # groups (and standalone absents) that kills the
                     # pending row; for 'or' groups only THIS side dies —
                     # the group remains completable via the partner
-                    # (AbsentLogicalPreStateProcessor)
+                    # (AbsentLogicalPreStateProcessor). Start-state
+                    # absents with viol_push re-arm the deadline to
+                    # ev_ts + waiting instead (updateLastArrivalTime:
+                    # the scheduler re-creates the pending and fires at
+                    # the pushed time).
                     my_dl = dl2 if st.dl_field else dl1
                     if st.waiting_ms > 0:
-                        kill = hit & (ev_ts <= my_dl) & (my_dl >= 0)
+                        # only ARMED lanes are violable: once the deadline
+                        # passed (lane -1 satisfied) the reference removed
+                        # the event from the absent side's pending list —
+                        # late matching events can no longer kill it
+                        # (AbsentLogicalPreStateProcessor.process
+                        # iterator.remove() on waitingTimePassed)
+                        viol = hit & (my_dl >= 0)
                     else:
-                        kill = hit
-                    if st.logical_op == "or":
+                        viol = hit
+                    if st.viol_push and st.waiting_ms > 0:
+                        kill = jnp.zeros_like(viol)
+                        pushed = ev_ts + np.int64(st.waiting_ms)
+                        if st.dl_field:
+                            dl2 = jnp.where(viol, pushed, dl2)
+                        else:
+                            dl1 = jnp.where(viol, pushed, dl1)
+                    else:
+                        kill = viol
+                    if st.logical_op == "or" and not seq:
                         p = self.states[st.partner]
                         if st.dl_field:
                             dl2 = jnp.where(kill, DEAD, dl2)
@@ -603,7 +758,17 @@ class NfaEngine:
                             new_valid = jnp.where(both_dead, False,
                                                   new_valid)
                     else:
+                        # sequence: a violation removes the event from
+                        # BOTH sides' pending lists — the whole group
+                        # dies (AbsentLogicalPreStateProcessor
+                        # .processAndReturn SEQUENCE partner remove)
                         new_valid = jnp.where(kill, False, new_valid)
+                    if seq and st.partner >= 0:
+                        # AbsentLogicalPreStateProcessor.processAndReturn
+                        # SEQUENCE branch: any same-stream event that does
+                        # NOT violate still consumes the pending
+                        seq_kill = seq_kill | (normal & is_current &
+                                               ~cond_ok)
                     continue
 
                 # fill own slot at position n (persona rows have n=0 there)
@@ -644,6 +809,20 @@ class NfaEngine:
                     new_state = jnp.where(can_fill,
                                           jnp.int32(st.idx), new_state)
                     new_min_at = jnp.where(just_min, counter, new_min_at)
+                    if 0 <= st.next_idx < len(self.states):
+                        nxt = self.states[self.states[st.next_idx].anchor]
+                        if nxt.is_absent and nxt.waiting_ms > 0:
+                            # counting state feeding an absent wait: each
+                            # absorb at/after min re-forwards — the wait
+                            # clock restarts at the latest absorb
+                            # (AbsentStreamPreStateProcessor.addState
+                            # SEQUENCE clear+add)
+                            arm_abs = can_fill & (nn >= st.min_count)
+                            pushed = ev_ts + np.int64(nxt.waiting_ms)
+                            if nxt.dl_field:
+                                dl2 = jnp.where(arm_abs, pushed, dl2)
+                            else:
+                                dl1 = jnp.where(arm_abs, pushed, dl1)
                     if st.next_idx == -1:
                         out_rows = out_rows | just_min
                         new_valid = jnp.where(maxed, False, new_valid)
@@ -678,6 +857,11 @@ class NfaEngine:
                         new_state = jnp.where(
                             complete, jnp.int32(anchor.next_idx),
                             new_state)
+                    # completing rows leave the group: any armed absent
+                    # lane deadline dies with the wait (else next_due
+                    # re-offers a stale instant forever — timer livelock)
+                    dl1 = jnp.where(complete, POS_INF, dl1)
+                    dl2 = jnp.where(complete, POS_INF, dl2)
                     fwd = complete
                 arm = st.every_arm if st.every_arm >= 0 \
                     else self.states[st.anchor].every_arm
@@ -689,7 +873,14 @@ class NfaEngine:
                     rearm_clear = jnp.where(fwd, jnp.int32(clear),
                                             rearm_clear)
                 if self.state_type == "sequence" and not st.is_counting:
-                    seq_kill = seq_kill | (normal & is_current & ~cond_ok)
+                    k = normal & is_current & ~cond_ok
+                    if st.partner >= 0:
+                        # a filled logical side no longer holds the
+                        # pending — its stream's events don't test it
+                        # (LogicalPreStateProcessor.processAndReturn
+                        # iterates the side's own pending list)
+                        k = k & (table["slots"][st.slot]["n"] == 0)
+                    seq_kill = seq_kill | k
 
             # ts0 bookkeeping (first captured event)
             got_first = matched_any & ~table["has_ts0"]
@@ -698,10 +889,18 @@ class NfaEngine:
 
             new_valid = new_valid & ~seq_kill
 
+            born = table["born"]
+            if seq:
+                # any fill / state change re-forwards the pending: it is
+                # promoted fresh at the next round (the reference moves
+                # the object into the next list; stabilize clears only
+                # entries promoted a full round ago)
+                born = jnp.where(matched_any & is_current, counter, born)
+
             table2 = {**table, "state": new_state, "valid": new_valid,
                       "ts0": ts0, "has_ts0": has_ts0, "slots": slots_upd,
                       "min_at": new_min_at, "deadline": dl1,
-                      "deadline2": dl2}
+                      "deadline2": dl2, "born": born}
 
             # every re-arms (cleared clones, born=now)
             do_rearm = (rearm_target >= 0) & is_current
@@ -735,7 +934,11 @@ class NfaEngine:
                     table2 = {**table2, "deadline2": jnp.where(
                         needs2, ev_ts + w2, table2["deadline2"])}
 
-            table2 = {**table2, "counter": counter + 1}
+            # event rounds advance only on real events — batch padding
+            # slots must not age pendings (sequence staleness counts
+            # rounds, not scan iterations)
+            table2 = {**table2,
+                      "counter": counter + ev_valid.astype(jnp.int64)}
             return (table2, out), None
 
         def step(table, batch: EventBatch, now):
@@ -777,6 +980,7 @@ class NfaEngine:
         deadline = table["deadline"]
         deadline2 = table["deadline2"]
         out_rows = jnp.zeros((M,), jnp.bool_)
+        adv_rows = jnp.zeros((M,), jnp.bool_)
         rearm_target = jnp.full((M,), -1, jnp.int32)
         rearm_clear = jnp.zeros((M,), jnp.int32)
         rearm_dl = jnp.full((M,), POS_INF, jnp.int64)
@@ -791,8 +995,16 @@ class NfaEngine:
                 continue
             anchor = self.states[st.anchor]
             my_dl = deadline2 if st.dl_field else deadline
-            rows = live & active & lane_passed(my_dl) & \
-                (table["state"] == st.anchor)
+            at_anchor = table["state"] == st.anchor
+            for cs in self.states:
+                # counting rows whose forwarded persona waits at this
+                # absent anchor fire with their captured count slots
+                if cs.is_counting and 0 <= cs.next_idx < len(self.states) \
+                        and self.states[cs.next_idx].anchor == st.anchor:
+                    at_anchor = at_anchor | (
+                        (table["state"] == cs.idx) &
+                        (table["slots"][cs.slot]["n"] >= cs.min_count))
+            rows = live & active & lane_passed(my_dl) & at_anchor
             if st.partner >= 0:
                 p_state = self.states[st.partner]
                 if p_state.is_absent and st.logical_op == "and":
@@ -837,8 +1049,20 @@ class NfaEngine:
                 out_rows = out_rows | rows
                 new_valid = jnp.where(rows, False, new_valid)
             else:
+                if self.state_type == "sequence":
+                    # sequence addState adds only when the next state's
+                    # new list is empty — a second timer fire between
+                    # events is consumed, not forwarded (first wins)
+                    nxt_a = self.states[anchor.next_idx].anchor
+                    occupied = jnp.any(
+                        new_valid & (new_state == nxt_a) &
+                        (table["born"] == table["counter"] - 1))
+                    blocked = rows & occupied
+                    new_valid = jnp.where(blocked, False, new_valid)
+                    rows = rows & ~blocked
                 new_state = jnp.where(rows, jnp.int32(anchor.next_idx),
                                       new_state)
+                adv_rows = adv_rows | rows
             deadline = jnp.where(rows, POS_INF, deadline)
             deadline2 = jnp.where(rows, POS_INF, deadline2)
             # `every`-scoped absents re-arm on the deadline fire
@@ -864,8 +1088,14 @@ class NfaEngine:
                        POS_INF)
         out = self._emit(out, table, table["slots"], out_rows,
                          jnp.minimum(d1, d2), table["seq"])
+        born = table["born"]
+        if self.state_type == "sequence":
+            # a deadline fire forwards the pending to the next state's
+            # list — it must survive exactly the next event round
+            born = jnp.where(adv_rows, table["counter"] - 1, born)
         table = {**table, "state": new_state, "valid": new_valid,
-                 "deadline": deadline, "deadline2": deadline2}
+                 "deadline": deadline, "deadline2": deadline2,
+                 "born": born}
         if self._absent_rearms:
             do_rearm = rearm_target >= 0
             # born = counter-1: the deadline fired BETWEEN events (the
@@ -1041,6 +1271,15 @@ class NfaEngine:
             else:
                 ok = jnp.bool_(True)
             hit = ok & ev_valid & (ev_kind == CURRENT)
+            if st.suppress_when_next_busy and st.next_idx >= 0:
+                # sequence start before an absent wait: no new attempt
+                # while the wait is pending (StreamPreStateProcessor
+                # .resetState early return when the next state's pending
+                # list is non-empty)
+                nxt_anchor = self.states[st.next_idx].anchor
+                busy = jnp.any(table["valid"] &
+                               (table["state"] == nxt_anchor))
+                hit = hit & ~busy
             if st.is_counting:
                 reached_min = st.min_count <= 1
                 if st.next_idx == -1 and reached_min:
@@ -1142,6 +1381,74 @@ class NfaEngine:
                 "seq": seq, "next_seq": next_seq, "overflow": overflow,
                 "slots": tuple(slots), "ts0": ts0, "has_ts0": has_ts0,
                 "min_at": min_at, "deadline": deadline}
+
+    def _spawn_empty(self, table, anchor: int, counter, ev_valid):
+        """Respawn an empty start pending when none is live (sequence
+        every-start re-initialization: resetState -> init()). born is
+        counter-1 so the spawned row is tested by THIS event."""
+        M = self.M
+        has = jnp.any(table["valid"] & (table["state"] == anchor))
+        free = ~table["valid"]
+        first_free = jnp.argmax(free)
+        ok = ev_valid & ~has & jnp.any(free)
+        d = jnp.where(ok, first_free, M)
+        state = table["state"].at[d].set(jnp.int32(anchor), mode="drop")
+        valid = table["valid"].at[d].set(True, mode="drop")
+        born = table["born"].at[d].set(counter - 1, mode="drop")
+        seq_col = table["seq"].at[d].set(table["next_seq"], mode="drop")
+        next_seq = table["next_seq"] + ok.astype(jnp.int64)
+        min_at = table["min_at"].at[d].set(jnp.int64(-1), mode="drop")
+        deadline = table["deadline"].at[d].set(POS_INF, mode="drop")
+        deadline2 = table["deadline2"].at[d].set(POS_INF, mode="drop")
+        ts0 = table["ts0"].at[d].set(jnp.int64(0), mode="drop")
+        has_ts0 = table["has_ts0"].at[d].set(False, mode="drop")
+        slots = []
+        for j, spec in enumerate(self.slots):
+            buf = table["slots"][j]
+            m_row = (jnp.arange(M) == d)[:, None]
+            cols = tuple(jnp.where(m_row, jnp.zeros_like(c), c)
+                         for c in buf["cols"])
+            nulls = tuple(jnp.where(m_row, True, nl)
+                          for nl in buf["nulls"])
+            ts = jnp.where(m_row, 0, buf["ts"])
+            n = jnp.where(jnp.arange(M) == d, 0, buf["n"])
+            slots.append({"cols": cols, "nulls": nulls, "ts": ts, "n": n})
+        return {**table, "state": state, "valid": valid, "born": born,
+                "seq": seq_col, "next_seq": next_seq, "min_at": min_at,
+                "deadline": deadline, "deadline2": deadline2, "ts0": ts0,
+                "has_ts0": has_ts0, "slots": tuple(slots)}
+
+    def arm_start(self, table, now):
+        """Arm start-state absent deadlines at app-start time (the
+        reference schedules them in partitionCreated with the startup
+        clock, NOT the first event's timestamp)."""
+        if not self.has_absent:
+            return table
+        st_clip = jnp.clip(table["state"], 0, len(self.states))
+        w = jnp.asarray(self._wait_of)[st_clip]
+        needs = table["valid"] & (w > 0) & \
+            (table["deadline"] >= POS_INF)
+        table = {**table, "deadline": jnp.where(
+            needs, now + w, table["deadline"])}
+        if self._has_dl2:
+            w2 = jnp.asarray(self._wait2_of)[st_clip]
+            needs2 = table["valid"] & (w2 > 0) & \
+                (table["deadline2"] >= POS_INF)
+            table = {**table, "deadline2": jnp.where(
+                needs2, now + w2, table["deadline2"])}
+        return table
+
+    @property
+    def needs_start_arm(self) -> bool:
+        """True when an armed-once start row waits on an absent deadline
+        that must be based at app-start time."""
+        return self.has_absent and any(
+            st.armed_once and (
+                (st.is_absent and st.waiting_ms > 0) or
+                (st.partner >= 0 and
+                 self.states[st.partner].is_absent and
+                 self.states[st.partner].waiting_ms > 0))
+            for st in self.states)
 
     def _emit_virtual(self, out, st, ev_cols, ev_nulls, ev_ts, hit):
         OUT = self.OUT

@@ -79,10 +79,18 @@ def _cond_refs_own_indexed(st: NfaStateSpec, slots: list[SlotSpec]) -> bool:
 
 
 def parallel_supported(slots: list[SlotSpec],
-                       states: list[NfaStateSpec]) -> bool:
+                       states: list[NfaStateSpec],
+                       state_type: str = "pattern") -> bool:
     """Can the batch-parallel engine run this compiled chain?"""
     # logical groups and absent states run on the scan engine
     if any(st.partner >= 0 or st.is_absent for st in states):
+        return False
+    # sequences with armed-once starts need the scan engine's per-round
+    # pending lifecycle (one-shot starts, cross-stream staleness —
+    # SequenceMultiProcessStreamReceiver.stabilizeStates); counting-start
+    # sequences keep the parallel path (their absorb lifecycle is exempt)
+    if state_type == "sequence" and any(
+            st.armed_once or st.rearm_each_round for st in states):
         return False
     # rows-at-state reachability (which states ever hold table rows)
     reach = set()
@@ -618,14 +626,20 @@ class ParallelNfaEngine(NfaEngine):
             counter = table["counter"]
             M = self.M
 
-            # P1: the persistent table as a population
+            # P1: the persistent table as a population. min<0:n> counting
+            # states reach their minimum at birth — their rows answer the
+            # next state without any absorbed event (min_at stays -1)
+            min_prev = table["min_at"] >= 0
+            for cs in self.states:
+                if cs.is_counting and cs.min_count == 0:
+                    min_prev = min_prev | (table["state"] == cs.idx)
             pop1 = {
                 "state": table["state"],
                 "valid": table["valid"],
                 "last": jnp.full((M,), -1, jnp.int32),
                 "ts0": table["ts0"],
                 "has_ts0": table["has_ts0"],
-                "min_prev": table["min_at"] >= 0,
+                "min_prev": min_prev,
                 "minrel": jnp.full((M,), BIG, jnp.int32),
                 "seq": table["seq"],
                 "emit_at": jnp.full((M,), -1, jnp.int32),

@@ -134,7 +134,8 @@ class ProjectOp(Operator):
 
     def __init__(self, selector: A.Selector, in_schema: StreamSchema,
                  out_stream_id: str, scope: Scope, functions=None,
-                 current_on: bool = True, expired_on: bool = False):
+                 current_on: bool = True, expired_on: bool = False,
+                 having_in_scope: Scope = None):
         self.in_schema = in_schema
         self.current_on = current_on
         self.expired_on = expired_on
@@ -154,9 +155,16 @@ class ProjectOp(Operator):
                                                  self.compiled)))
             self._schema = StreamSchema(out_stream_id, attrs)
         self.having = None
+        self._having_in = having_in_scope is not None
         if selector.having is not None:
-            self.having = compile_expression(selector.having,
-                                             OutputScope(self._schema),
+            hscope = OutputScope(self._schema)
+            if having_in_scope is not None:
+                # pattern/sequence HAVING may also reference match slots
+                # (e1[1].price) — reference compiles having over the state
+                # meta plus output attrs (SelectorParser)
+                hscope = ChainScope(hscope,
+                                    _HavingInputScope(having_in_scope))
+            self.having = compile_expression(selector.having, hscope,
                                              functions)
             if self.having.type is not AttrType.BOOL:
                 raise CompileError("HAVING must be BOOL")
@@ -192,6 +200,10 @@ class ProjectOp(Operator):
         if self.having is not None:
             henv = env_from_batch(out)
             henv["__now__"] = now
+            if self._having_in:
+                for k, v in env_from_batch(batch).items():
+                    if isinstance(k, tuple) and k[0] == "attr":
+                        henv[("in_attr", k[1])] = v
             hc = self.having.fn(henv)
             out = out.mask(hc.values & ~hc.nulls)
         return state, shape_output(out, self.order_by, self.offset,
@@ -210,5 +222,39 @@ class OutputScope(Scope):
         self.schema = schema
 
     def resolve(self, var: A.Variable):
+        if var.index is not None:
+            # e1[i].attr can never be an output attribute — let chained
+            # scopes (pattern match slots) resolve it
+            raise CompileError(
+                f"indexed reference '{var.attribute}' is not an output "
+                "attribute")
         idx = self.schema.index_of(var.attribute)
         return ("attr", idx), self.schema.types[idx]
+
+
+class ChainScope(Scope):
+    """Try the primary scope, fall back to the secondary on failure."""
+
+    def __init__(self, first: Scope, second: Scope):
+        self.first = first
+        self.second = second
+
+    def resolve(self, var: A.Variable):
+        try:
+            return self.first.resolve(var)
+        except (CompileError, KeyError):
+            return self.second.resolve(var)
+
+
+class _HavingInputScope(Scope):
+    """Remap an input scope's batch-column keys so they coexist with the
+    output env inside one HAVING expression evaluation."""
+
+    def __init__(self, inner: Scope):
+        self.inner = inner
+
+    def resolve(self, var: A.Variable):
+        key, t = self.inner.resolve(var)
+        if isinstance(key, tuple) and key[0] == "attr":
+            return ("in_attr", key[1]), t
+        return key, t

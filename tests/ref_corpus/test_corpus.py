@@ -29,10 +29,23 @@ T0 = 1_500_000_000_000
 # the live parity worklist (each fix prunes lines). Listed cases still
 # REPLAY every run; a mismatch xfails, an unexpected pass XPASSes so
 # stale entries surface.
-KNOWN_FAILURES = frozenset(
-    ln.strip()
-    for ln in (DIR / "known_failures.txt").read_text().splitlines()
-    if ln.strip() and not ln.startswith("#"))
+def _id_set(fname):
+    p = DIR / fname
+    if not p.exists():
+        return frozenset()
+    return frozenset(
+        ln.strip().split("|")[0].strip()
+        for ln in p.read_text().splitlines()
+        if ln.strip() and not ln.startswith("#"))
+
+
+KNOWN_FAILURES = _id_set("known_failures.txt")
+# Cases this framework rejects at compile time, tracked explicitly: a
+# CompileError on any case NOT in this list is a REGRESSION (it fails
+# the run instead of silently joining the xfail bucket), and a listed
+# case that now compiles surfaces as an xpass-style failure so the
+# stale entry gets pruned.
+COMPILE_GATED = _id_set("compile_gated.txt")
 
 
 def _cases():
@@ -70,12 +83,21 @@ def _is_ordered_subset(got_rows, exp_rows):
 
 
 @pytest.mark.parametrize("case", _cases())
-def test_ref_case(case):
+def test_ref_case(case, request):
+    cid = request.node.callspec.id
     mgr = SiddhiManager()
     try:
         rt = mgr.create_siddhi_app_runtime("@app:playback " + case["app"])
     except CompileError as e:
-        pytest.xfail(f"unsupported construct: {e}")
+        if cid in COMPILE_GATED:
+            pytest.xfail(f"unsupported construct: {e}")
+        raise AssertionError(
+            f"COMPILE REGRESSION: case not in compile_gated.txt now "
+            f"fails to compile: {e}") from e
+    if cid in COMPILE_GATED:
+        raise AssertionError(
+            "STALE compile_gated.txt entry: case now compiles — run it "
+            "and prune the entry")
     state = {"in": 0, "rm": 0, "in_rows": [], "rm_rows": []}
 
     def on_query(_ts, in_events, rm_events):
@@ -99,6 +121,11 @@ def test_ref_case(case):
         for t in targets:
             rt.add_callback(t, StreamCallback(fn=on_stream))
     rt.start()
+    # the reference starts the runtime immediately before the first
+    # action — anchor the virtual app-start clock at T0 so start-state
+    # absent deadlines (partitionCreated) base correctly
+    with rt.barrier:
+        rt.on_ingest_ts(T0)
 
     clock = T0
     for act in case["actions"]:
@@ -132,6 +159,10 @@ def test_ref_case(case):
         arrived = state["in"] > 0 or state["rm"] > 0
         assert arrived == case["event_arrived"]
     exp_rows = case["expected_in_rows"]
+    if case["expected_in"] == 0 or case["event_arrived"] is False:
+        # TestUtil.addQueryCallback row expectations assert INSIDE the
+        # callback — with zero expected events they are unreachable
+        exp_rows = None
     if exp_rows:
         got = state["in_rows"]
         if case["row_mode"] == "exact":

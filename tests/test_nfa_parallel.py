@@ -16,7 +16,7 @@ APP = "@app:playback\ndefine stream A (v int, w int);\n" \
       "define stream B (v int, w int);\n@info(name='q')\n"
 
 
-def run(ql, sends, force_scan=False):
+def run(ql, sends, force_scan=False, expect_parallel=True):
     """sends: list of (stream_id, ts_array, [cols]). Returns output rows."""
     import siddhi_tpu.core.runtime as R
     orig = R.parallel_supported
@@ -26,8 +26,9 @@ def run(ql, sends, force_scan=False):
         mgr = SiddhiManager()
         rt = mgr.create_siddhi_app_runtime(APP + ql)
         q = rt.queries["q"]
-        want = NfaEngine if force_scan else ParallelNfaEngine
-        assert isinstance(q.engine, want), type(q.engine)
+        want = NfaEngine if (force_scan or not expect_parallel) \
+            else ParallelNfaEngine
+        assert type(q.engine) is want, type(q.engine)
         got = []
         from siddhi_tpu import StreamCallback
         rt.add_callback("O", StreamCallback(
@@ -65,6 +66,9 @@ QLS = [
     "select e1.v as a, e2.v as b, e1.w as w insert into O;",
     "from every e1=A[v > 5] -> e2=A[v > e1.v] -> e3=A[w == e1.w] "
     "select e1.v as a, e3.w as w insert into O;",
+    # non-every plain sequence: armed-once one-shot starts route to the
+    # scan engine (per-round pending lifecycle), so this entry compares
+    # scan-vs-scan — kept for replay coverage of the shape
     "from e1=A, e2=A[v > e1.v], e3=A[v > e2.v] "
     "select e1.v as a, e3.v as c insert into O;",
     "from every e1=A[v > 6]<1:3> -> e2=B[v > 8] "
@@ -76,12 +80,15 @@ QLS = [
 ]
 
 
+SCAN_ONLY = {3}   # armed-once sequence starts (see QLS comment)
+
+
 @pytest.mark.parametrize("qi", range(len(QLS)))
 @pytest.mark.parametrize("seed", [0, 1])
 def test_parallel_matches_scan(qi, seed):
     ql = QLS[qi]
     sends = gen_sends(seed)
-    got_par = run(ql, sends)
+    got_par = run(ql, sends, expect_parallel=qi not in SCAN_ONLY)
     got_scan = run(ql, sends, force_scan=True)
     assert got_par == got_scan, (
         f"q{qi} seed{seed}: parallel {len(got_par)} rows "

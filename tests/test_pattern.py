@@ -120,10 +120,14 @@ class TestSequence:
         """)
         h = rt.get_input_handler("S")
         h.send(Event(1000, ("A", 30.0)))
-        h.send(Event(1100, ("B", 25.0)))   # kills [A] (25 < 30); arms [B]
-        h.send(Event(1200, ("C", 45.0)))   # completes (25, 45)
+        # B kills the [A] attempt (25 < 30), and a non-every sequence is
+        # ONE-SHOT: the start never re-arms after the in-flight attempt
+        # dies (StreamPreStateProcessor.init() `initialized` latch;
+        # reference corpus SequenceTestCase testQuery29/31 pin this)
+        h.send(Event(1100, ("B", 25.0)))
+        h.send(Event(1200, ("C", 45.0)))   # no restart: one-shot
         rt.shutdown()
-        assert [e.data for e in got] == [(25.0, 45.0)]
+        assert [e.data for e in got] == []
 
 
 class TestCountPattern:

@@ -31,6 +31,12 @@ from siddhi_tpu.ops.expr import CompileError  # noqa: E402
 from _pytest.outcomes import XFailed  # noqa: E402
 
 
+class _Req:
+    """Duck-typed pytest `request` (test_ref_case reads callspec.id)."""
+    def __init__(self, cid):
+        self.node = type("N", (), {"callspec": type("C", (), {"id": cid})()})()
+
+
 def main():
     only = sys.argv[1] if len(sys.argv) > 1 else ""
     out = collections.defaultdict(list)
@@ -39,8 +45,9 @@ def main():
         cid = p.id
         if only and only not in cid:
             continue
+        print(f"... {cid}", file=sys.stderr, flush=True)
         try:
-            tc.test_ref_case(case)
+            tc.test_ref_case(case, _Req(cid))
             out["pass"].append(cid)
         except XFailed as e:
             out["compile"].append((cid, str(e)[:90]))
