@@ -248,6 +248,106 @@ class InMemorySink(Sink):
         InMemoryBroker.publish(topic, payload)
 
 
+def _java_string_hash(s: str) -> int:
+    """Java String.hashCode — the reference's partitioned strategy keys
+    destinations by partitionKeyValue.hashCode() % destinationCount
+    (PartitionedDistributionStrategy.java:100-110)."""
+    h = 0
+    for ch in s:
+        h = (31 * h + ord(ch)) & 0xFFFFFFFF
+    if h >= 0x80000000:
+        h -= 0x100000000
+    return h
+
+
+class DistributionStrategy:
+    """Destination chooser SPI (stream/output/sink/distributed/
+    DistributionStrategy.java): returns the destination ids an event
+    is published to."""
+
+    def init(self, schema, dist_opts: dict, dest_opts: list[dict]) -> None:
+        self.n = len(dest_opts)
+
+    def destinations(self, event: Event) -> list[int]:
+        raise NotImplementedError
+
+
+class RoundRobinDistributionStrategy(DistributionStrategy):
+    """RoundRobinDistributionStrategy.java:49 — cycle destinations per
+    published event."""
+
+    def init(self, schema, dist_opts, dest_opts):
+        super().init(schema, dist_opts, dest_opts)
+        self._i = 0
+
+    def destinations(self, event):
+        d = self._i % self.n
+        self._i += 1
+        return [d]
+
+
+class PartitionedDistributionStrategy(DistributionStrategy):
+    """PartitionedDistributionStrategy.java:52 — hash of the partitionKey
+    attribute value picks the destination."""
+
+    def init(self, schema, dist_opts, dest_opts):
+        super().init(schema, dist_opts, dest_opts)
+        key = dist_opts.get("partitionkey")
+        if not key:
+            raise ValueError(
+                "PartitionKey is required for partitioned distribution "
+                "strategy.")
+        try:
+            self._pos = schema.index_of(key)
+        except KeyError:
+            raise ValueError(
+                f"Could not find partition key attribute '{key}'")
+
+    def destinations(self, event):
+        v = event.data[self._pos]
+        return [abs(_java_string_hash(str(v))) % self.n]
+
+
+class BroadcastDistributionStrategy(DistributionStrategy):
+    """BroadcastDistributionStrategy.java — every destination."""
+
+    def destinations(self, event):
+        return list(range(self.n))
+
+
+DISTRIBUTION_STRATEGIES = {
+    "roundrobin": RoundRobinDistributionStrategy,
+    "partitioned": PartitionedDistributionStrategy,
+    "broadcast": BroadcastDistributionStrategy,
+}
+
+
+class DistributedSink(StreamCallback):
+    """@sink(..., @distribution(strategy=..., @destination(...), ...)):
+    one child sink per @destination, events routed by the strategy
+    (DistributedTransport.java:47 + MultiClientDistributedSink — each
+    destination holds its own client/connection)."""
+
+    def __init__(self, children: list[Sink],
+                 strategy: DistributionStrategy):
+        super().__init__()
+        self.children = children
+        self.strategy = strategy
+
+    def connect(self) -> None:
+        for c in self.children:
+            c.connect()
+
+    def disconnect(self) -> None:
+        for c in self.children:
+            c.disconnect()
+
+    def receive(self, events: list[Event]) -> None:
+        for e in events:
+            for d in self.strategy.destinations(e):
+                self.children[d].receive([e])
+
+
 SOURCE_TYPES = {"inmemory": InMemorySource}
 SINK_TYPES = {"inmemory": InMemorySink}
 
@@ -279,10 +379,50 @@ def build_io(app, exts: dict) -> None:
                 cls = SINK_TYPES.get(typ) or exts.get(f"sink:{typ}")
                 if cls is None:
                     raise CompileError(f"unknown sink type '{typ}'")
+                # nested @map(type=...) wins over a flat map= element
+                dist = None
+                for sub in ann.nested:
+                    sname = sub.name.lower()
+                    if sname == "map":
+                        mname = (sub.element("type") or mname).lower()
+                    elif sname == "distribution":
+                        dist = sub
                 mcls = SINK_MAPPERS.get(mname)
                 if mcls is None:
                     raise CompileError(f"unknown sink map '{mname}'")
                 from .runtime import StreamCallbackReceiver
-                snk = cls(opts, mcls(schema))
+                if dist is not None:
+                    strategy_name = (dist.element("strategy")
+                                     or "").lower()
+                    scls = DISTRIBUTION_STRATEGIES.get(strategy_name) \
+                        or exts.get(f"distributionstrategy:{strategy_name}")
+                    if scls is None:
+                        raise CompileError(
+                            f"unknown distribution strategy "
+                            f"'{strategy_name}'")
+                    dests = [d for d in dist.nested
+                             if d.name.lower() == "destination"]
+                    if not dests:
+                        raise CompileError(
+                            "@distribution needs at least one "
+                            "@destination")
+                    dist_opts = {k.lower(): v
+                                 for k, v in dist.elements.items()}
+                    dest_opts = []
+                    children = []
+                    for d in dests:
+                        merged = dict(opts)
+                        merged.update(
+                            {k.lower(): v for k, v in d.elements.items()})
+                        dest_opts.append(merged)
+                        children.append(cls(merged, mcls(schema)))
+                    strat = scls()
+                    try:
+                        strat.init(schema, dist_opts, dest_opts)
+                    except ValueError as e:
+                        raise CompileError(str(e)) from e
+                    snk = DistributedSink(children, strat)
+                else:
+                    snk = cls(opts, mcls(schema))
                 app.junctions[sid].subscribe(StreamCallbackReceiver(snk))
                 app.sinks.append(snk)

@@ -600,33 +600,41 @@ class QueryRuntime(Receiver):
         handler/callback delivery."""
         for cb in self.batch_callbacks:
             cb(out)
-        # decode at most ONCE per emission: every delivery path (debugger,
-        # rate limiter, handlers, callbacks) shares the same rows, so
-        # per-row uuid() cells agree across paths
+        # transfer + decode at most ONCE per emission: every delivery path
+        # (debugger, rate limiter, handlers, callbacks) shares one host
+        # copy and one decoded row list, so uuid() cells agree across
+        # paths and no extra tunnel round-trips happen
+        _host: list = []
         _decoded: list = []
 
-        def rows_once(host_batch):
+        def host_once():
+            if not _host:
+                _host.append(jax.device_get(out))
+            return _host[0]
+
+        def rows_once():
             if not _decoded:
                 _decoded.append(rows_from_batch(self.out_schema.types,
-                                                host_batch))
+                                                host_once()))
             return _decoded[0]
 
         dbg = self.app.debugger
         if dbg is not None:
             from .debugger import QueryTerminal
             if (self.name, QueryTerminal.OUT) in dbg._breakpoints:
-                rows = rows_once(jax.device_get(out))
                 dbg.check_break_point(
                     self.name, QueryTerminal.OUT,
                     [Event(ts, vals, is_expired=(k == EXPIRED))
-                     for ts, k, vals in rows])
+                     for ts, k, vals in rows_once()])
         if self.rate_limiter is not None:
             if due is not None:
-                out_host, due_host = jax.device_get((out, due))
+                if _host:
+                    due_host = jax.device_get(due)
+                else:
+                    out_host, due_host = jax.device_get((out, due))
+                    _host.append(out_host)
                 self._schedule(int(due_host))
-            else:
-                out_host = jax.device_get(out)
-            rows = rows_once(out_host)
+            rows = rows_once()
             if rows:
                 self.rate_limiter.process(timestamp, rows)
             return
@@ -634,10 +642,14 @@ class QueryRuntime(Receiver):
                         if not h.handle_device_batch(out, timestamp)]
         decode = bool(row_handlers or self.callback_handler.callbacks)
         if decode and due is not None:
-            out_host, due_host = jax.device_get((out, due))
+            if _host:
+                due_host = jax.device_get(due)
+            else:
+                out_host, due_host = jax.device_get((out, due))
+                _host.append(out_host)
             self._schedule(int(due_host))
         elif decode:
-            out_host = jax.device_get(out)
+            pass
         else:
             if due is not None:
                 # NO sync here: device->host readback over the TPU tunnel
@@ -646,7 +658,7 @@ class QueryRuntime(Receiver):
                 # (app._resolve_dues) — by then the copy has landed
                 self.app.defer_due(self, due)
             return
-        out_rows = rows_once(out_host)
+        out_rows = rows_once()
         if not out_rows:
             return
         out_rows = self._host_shape_rows(out_rows)
@@ -1938,12 +1950,12 @@ class Planner:
         if key == "lengthbatch":
             if len(params) not in (1, 2):
                 raise CompileError(f"{name} takes 1-2 parameters")
-            if len(params) == 2 and bool(const_of(params[1], 'mode')):
-                raise CompileError(
-                    "lengthBatch stream.current.event mode not yet supported")
+            stream_cur = bool(const_of(params[1], 'mode')) \
+                if len(params) == 2 else False
             return LengthBatchWindowOp(schema,
                                        int(const_of(params[0], 'length')),
-                                       expired_enabled=expired_enabled)
+                                       expired_enabled=expired_enabled,
+                                       stream_current=stream_cur)
         if key in ("hopping", "hoping"):
             _expect(params, 2, name)
             return HoppingWindowOp(schema, _ms(params[0], name),
@@ -1951,26 +1963,57 @@ class Planner:
                                    cap=time_cap,
                                    expired_enabled=expired_enabled)
         if key == "timebatch":
-            if len(params) not in (1, 2):
-                raise CompileError(f"{name} takes 1-2 parameters")
-            start = int(const_of(params[1], 'start time')) \
-                if len(params) == 2 else None
+            if len(params) not in (1, 2, 3):
+                raise CompileError(f"{name} takes 1-3 parameters")
+            start = None
+            stream_cur = False
+            if len(params) >= 2:
+                p1 = const_of(params[1], 'start time / mode')
+                if isinstance(p1, bool):
+                    stream_cur = p1
+                    if len(params) == 3:
+                        raise CompileError(
+                            f"{name}: bool mode must be the last "
+                            "parameter")
+                elif isinstance(p1, int):
+                    start = int(p1)
+                else:
+                    raise CompileError(
+                        f"window '{name}' start time must be int/long")
+            if len(params) == 3:
+                mode = const_of(params[2], 'mode')
+                if not isinstance(mode, bool):
+                    raise CompileError(
+                        f"window '{name}' stream.current.event mode must "
+                        "be a bool constant")
+                stream_cur = mode
             return TimeBatchWindowOp(schema, _ms(params[0], name),
                                      start_time=start,
                                      cap=time_cap,
-                                     expired_enabled=expired_enabled)
+                                     expired_enabled=expired_enabled,
+                                     stream_current=stream_cur)
         if key == "externaltimebatch":
-            if len(params) not in (2, 3):
-                raise CompileError(f"{name} takes 2-3 parameters")
+            if len(params) not in (2, 3, 4, 5):
+                raise CompileError(f"{name} takes 2-5 parameters")
             ti = attr_idx(params[0], "timestamp parameter")
             if schema.attributes[ti].type is not AttrType.LONG:
                 raise CompileError(
                     f"window '{name}' timestamp attribute must be LONG")
-            start = int(const_of(params[2], 'start time')) \
-                if len(params) == 3 else None
+            start = None
+            start_attr = None
+            if len(params) >= 3:
+                if isinstance(params[2], A.Variable):
+                    start_attr = attr_idx(params[2], "start time")
+                else:
+                    start = int(const_of(params[2], 'start time'))
+            timeout = _ms(params[3], name) if len(params) >= 4 else None
+            replace = bool(const_of(params[4], 'replace flag')) \
+                if len(params) == 5 else False
             return ExternalTimeBatchWindowOp(
                 schema, ti, _ms(params[1], name), start_time=start,
-                cap=time_cap, expired_enabled=expired_enabled)
+                cap=time_cap, expired_enabled=expired_enabled,
+                start_attr=start_attr, timeout_ms=timeout,
+                replace_ts=replace)
         if key == "externaltime":
             _expect(params, 2, name)
             ti = attr_idx(params[0], "timestamp parameter")
@@ -2431,6 +2474,14 @@ class Planner:
             else side_chain(jin.right, "R")
         side_scope = JoinSideScope(l_schema, jin.left.alias,
                                    r_schema, jin.right.alias)
+        if q.selector.select_all:
+            dup = set(l_schema.names) & set(r_schema.names)
+            if dup:
+                raise CompileError(
+                    f"query '{name}': select * over a join with "
+                    f"duplicate attribute(s) {sorted(dup)} — alias the "
+                    "outputs (the reference rejects duplicate output "
+                    "attributes)")
         jschema = combined_schema(target, l_schema, r_schema)
         crosses = {"L": None, "R": None}
         join_cap = cap_pairs or 1024

@@ -37,10 +37,15 @@ class JoinSideScope(Scope):
 
     def __init__(self, left_schema: StreamSchema, left_alias,
                  right_schema: StreamSchema, right_alias):
+        # an alias REPLACES the stream name (the reference rejects
+        # references to the original id once `as x` is used —
+        # JoinTestCase joinTest7)
         self.sides = {
-            "L": (left_schema, {left_schema.stream_id, left_alias} - {None}),
+            "L": (left_schema,
+                  {left_alias} if left_alias else {left_schema.stream_id}),
             "R": (right_schema,
-                  {right_schema.stream_id, right_alias} - {None}),
+                  {right_alias} if right_alias
+                  else {right_schema.stream_id}),
         }
 
     def resolve(self, var: A.Variable):
@@ -48,7 +53,12 @@ class JoinSideScope(Scope):
         if ref is not None:
             for tag, (schema, names) in self.sides.items():
                 if ref in names:
-                    idx = schema.index_of(var.attribute)
+                    try:
+                        idx = schema.index_of(var.attribute)
+                    except KeyError:
+                        raise CompileError(
+                            f"'{ref}' has no attribute "
+                            f"'{var.attribute}'")
                     return (tag, idx), schema.types[idx]
             raise CompileError(f"unknown stream reference '{ref}' in join")
         hits = []

@@ -383,11 +383,16 @@ class LengthBatchWindowOp(WindowOp):
     kind_name = "lengthBatch"
     is_batch = True
 
-    def __init__(self, schema, length: int, expired_enabled: bool = True):
+    def __init__(self, schema, length: int, expired_enabled: bool = True,
+                 stream_current: bool = False):
         super().__init__(schema, expired_enabled)
         if length <= 0:
             raise CompileError("lengthBatch window requires length > 0")
         self.L = int(length)
+        # 2nd bool param (stream.current.event): currents stream out on
+        # arrival; only the batch EXPIRY happens at the flush
+        # (LengthBatchWindowProcessor streamCurrentEvents mode)
+        self.stream_current = bool(stream_current)
 
     def init_state(self):
         return {"cur": empty_buffer(self.schema, self.L),
@@ -437,10 +442,17 @@ class LengthBatchWindowOp(WindowOp):
                 jnp.full((P,), CURRENT, jnp.int32),
                 jnp.full((P,), RESET, jnp.int32)]),
         }
+        arr_row = jnp.clip(pool["seq"] - state["next_seq"], 0, B - 1)
+        arr_row = cur_rows[arr_row].astype(jnp.int64)
+        cur_row_src = arr_row if self.stream_current \
+            else jnp.where(flushed, flush_row, 0)
+        exp_row_src = jnp.where(flushed, flush_row, 0) \
+            if self.stream_current \
+            else jnp.where(pool_expires, exp_next_row, 0)
         emit_row = jnp.concatenate([
             jnp.broadcast_to(first_flush_row, (EB,)),
-            jnp.where(pool_expires, exp_next_row, 0),
-            jnp.where(flushed, flush_row, 0),
+            exp_row_src,
+            cur_row_src,
             jnp.where(is_batch_tail, flush_row, 0)])
         phase = jnp.concatenate([
             jnp.zeros((EB,), jnp.int64),
@@ -455,7 +467,15 @@ class LengthBatchWindowOp(WindowOp):
         else:
             exp_carry_valid = jnp.zeros((EB,), jnp.bool_)
             exp_pool_valid = jnp.zeros((P,), jnp.bool_)
-        valid = jnp.concatenate([exp_carry_valid, exp_pool_valid, flushed,
+        arrivals = pool["valid"] & (pool["seq"] >= state["next_seq"])
+        cur_valid = arrivals if self.stream_current else flushed
+        if self.stream_current:
+            # streamed currents already went out; the completed batch
+            # expires AT its own flush (not one flush later)
+            exp_carry_valid = jnp.zeros((EB,), jnp.bool_)
+            exp_pool_valid = flushed if self.expired_enabled \
+                else jnp.zeros((P,), jnp.bool_)
+        valid = jnp.concatenate([exp_carry_valid, exp_pool_valid, cur_valid,
                                  is_batch_tail])
         result = emission_sort(out, emit_row, phase, oseq, valid,
                                EB + 3 * P)
@@ -471,7 +491,7 @@ class LengthBatchWindowOp(WindowOp):
                 result)
 
     def findable_buffer(self, state):
-        return state["exp"]
+        return state["cur"] if self.stream_current else state["exp"]
 
 
 class TimeBatchWindowOp(WindowOp):
@@ -484,11 +504,15 @@ class TimeBatchWindowOp(WindowOp):
     is_batch = True
 
     def __init__(self, schema, duration_ms: int, start_time: Optional[int] = None,
-                 cap: int = 4096, expired_enabled: bool = True):
+                 cap: int = 4096, expired_enabled: bool = True,
+                 stream_current: bool = False):
         super().__init__(schema, expired_enabled)
         self.T = int(duration_ms)
         self.start_time = start_time
         self.cap = int(cap)
+        # 2nd/3rd bool param: stream currents out on arrival, expire in
+        # batches (TimeBatchWindowProcessor isStreamCurrentEvents)
+        self.stream_current = bool(stream_current)
 
     def init_state(self):
         return {"cur": empty_buffer(self.schema, self.cap),
@@ -517,6 +541,7 @@ class TimeBatchWindowOp(WindowOp):
         EB = W
 
         now_exp = jnp.broadcast_to(now, (EB,)).astype(jnp.int64)
+        now_pool2 = jnp.broadcast_to(now, (P,)).astype(jnp.int64)
         out = {
             "ts": jnp.concatenate([now_exp, pool["ts"],
                                    jnp.broadcast_to(now, (1,)).astype(jnp.int64)]),
@@ -540,11 +565,40 @@ class TimeBatchWindowOp(WindowOp):
         had_pending = jnp.any(pool["valid"])
         exp_valid = (state["exp"]["valid"] & send) if self.expired_enabled \
             else jnp.zeros((EB,), jnp.bool_)
+        arrivals = pool["valid"] & (pool["seq"] >= state["next_seq"])
+        cur_valid = arrivals if self.stream_current \
+            else (pool["valid"] & send)
         valid = jnp.concatenate([
             exp_valid,
-            pool["valid"] & send,
+            cur_valid,
             (send & had_pending)[None]])
-        result = emission_sort(out, emit_row, phase, oseq, valid, EB + P + 1)
+        if self.stream_current:
+            # streamed currents already went out on arrival; the batch
+            # expires AT its own boundary (not one flush later)
+            exp_now = pool["valid"] & send
+            if not self.expired_enabled:
+                exp_now = jnp.zeros_like(exp_now)
+            out = {
+                "ts": jnp.concatenate([out["ts"], now_pool2]),
+                "cols": tuple(jnp.concatenate([oc, pc])
+                              for oc, pc in zip(out["cols"],
+                                                pool["cols"])),
+                "nulls": tuple(jnp.concatenate([on, pn])
+                               for on, pn in zip(out["nulls"],
+                                                 pool["nulls"])),
+                "kind": jnp.concatenate([
+                    out["kind"], jnp.full((P,), EXPIRED, jnp.int32)]),
+            }
+            emit_row = jnp.concatenate([emit_row,
+                                        jnp.zeros((P,), jnp.int64)])
+            phase = jnp.concatenate([phase, jnp.zeros((P,), jnp.int64)])
+            oseq = jnp.concatenate([oseq, pool["seq"]])
+            valid = jnp.concatenate([
+                jnp.zeros((EB,), jnp.bool_),     # no carried expiry
+                valid[EB:],
+                exp_now])
+        cap_out = EB + P + 1 + (P if self.stream_current else 0)
+        result = emission_sort(out, emit_row, phase, oseq, valid, cap_out)
 
         # buffers: on send, cur batch -> exp, cur empties; else cur keeps all
         new_cur_flush, _ = keep_newest(pool, jnp.zeros_like(pool["valid"]), W)
@@ -563,4 +617,4 @@ class TimeBatchWindowOp(WindowOp):
         return jnp.where(ne == -1, POS_INF, ne)
 
     def findable_buffer(self, state):
-        return state["exp"]
+        return state["cur"] if self.stream_current else state["exp"]

@@ -859,11 +859,17 @@ class ExternalTimeBatchWindowOp(WindowOp):
 
     def __init__(self, schema, ts_idx: int, duration_ms: int,
                  start_time: Optional[int] = None, cap: int = 4096,
-                 expired_enabled: bool = True):
+                 expired_enabled: bool = True,
+                 start_attr: Optional[int] = None,
+                 timeout_ms: Optional[int] = None,
+                 replace_ts: bool = False):
         super().__init__(schema, expired_enabled)
         self.ts_idx = int(ts_idx)
         self.T = int(duration_ms)
         self.start_time = start_time
+        self.start_attr = start_attr      # 3rd param as a variable
+        self.timeout_ms = timeout_ms      # 4th param: early-flush timer
+        self.replace_ts = bool(replace_ts)  # 5th param
         self.cap = int(cap)
 
     def init_state(self):
@@ -872,7 +878,15 @@ class ExternalTimeBatchWindowOp(WindowOp):
                 "start": jnp.int64(self.start_time
                                    if self.start_time is not None else -1),
                 "next_seq": jnp.int64(0),
+                "flushed": jnp.bool_(False),
+                "sched": jnp.int64(POS_INF),
+                "last_ext": jnp.int64(0),
                 "overflow": jnp.int64(0)}
+
+    def next_due(self, state):
+        if self.timeout_ms is None:
+            return None
+        return state["sched"]
 
     def step(self, state, batch: EventBatch, now):
         B = batch.capacity
@@ -883,8 +897,24 @@ class ExternalTimeBatchWindowOp(WindowOp):
         n_cur = jnp.sum(cur.astype(jnp.int64))
         cur_rows = current_row_positions(cur, B)
         first_ext = ext[cur_rows[0]]
+        if self.start_attr is not None:
+            # 3rd param as a variable: the FIRST event's value of that
+            # attribute anchors the batch boundaries
+            # (ExternalTimeBatchWindowProcessor.initTiming startTime
+            # AsVariable)
+            first_start = batch.cols[self.start_attr].astype(
+                jnp.int64)[cur_rows[0]]
+        else:
+            first_start = first_ext
         start = jnp.where(state["start"] >= 0, state["start"],
-                          jnp.where(n_cur > 0, first_ext, jnp.int64(-1)))
+                          jnp.where(n_cur > 0, first_start, jnp.int64(-1)))
+        last_ext = jnp.maximum(
+            state["last_ext"],
+            jnp.max(jnp.where(cur, ext, jnp.int64(0))))
+        is_timer = jnp.any(batch.valid & (batch.kind == TIMER))
+        timer_ts = jnp.max(jnp.where(batch.valid &
+                                     (batch.kind == TIMER),
+                                     batch.ts, jnp.int64(0)))
 
         pool = make_pool(state["cur"], batch, seq, cur)
         P = W + B
@@ -892,6 +922,18 @@ class ExternalTimeBatchWindowOp(WindowOp):
         pool_ext = pool["cols"][self.ts_idx].astype(jnp.int64)
         w_of = jnp.where(pool["valid"],
                          (pool_ext - start) // T, jnp.int64(-1))
+        emit_cols = pool["cols"]
+        if self.replace_ts:
+            # 5th param: EMITTED events carry the batch END boundary in
+            # the timestamp attribute (cloneAppend
+            # replaceTimestampWithBatchEndTime). Emission-only: the
+            # pending buffer keeps the original values — the window id
+            # must keep deriving from the real event clock
+            end_of = start + (w_of + 1) * T
+            emit_cols = tuple(
+                jnp.where(pool["valid"], end_of, c).astype(c.dtype)
+                if a == self.ts_idx else c
+                for a, c in enumerate(pool["cols"]))
         # arrival window ids in arrival order (non-decreasing)
         warr = jnp.where(cur, (ext - start) // T, jnp.int64(2 ** 62))
         warr_sorted = warr[cur_rows]  # arrival order; padding sorts last
@@ -937,17 +979,44 @@ class ExternalTimeBatchWindowOp(WindowOp):
                            jnp.int64(-2 ** 62))
         grp_first = pool["valid"] & (w_of != prev_w)
 
-        now_exp = jnp.broadcast_to(first_flush_ext, (EB,))
+        # timeout early-flush (4th param): a timer at/after the scheduled
+        # deadline flushes the pending batch without closing its window
+        # (ExternalTimeBatchWindowProcessor.process TIMER branch :258-276)
+        has_timeout = self.timeout_ms is not None
+        early = jnp.bool_(False)
+        if has_timeout:
+            early = is_timer & (state["sched"] < POS_INF) & \
+                (timer_ts >= state["sched"])
+        flushed0 = state["flushed"]
+        any_pool = jnp.any(pool["valid"])
+
+        exp_exp_valid = state["exp"]["valid"] & (
+            any_flush | (early & (~flushed0 | any_pool)))
+        if not self.expired_enabled:
+            exp_exp_valid = jnp.zeros((EB,), jnp.bool_)
+        # after an early flush, the batch close RE-EMITS the flushed
+        # events as CURRENT ahead of the new ones (appendToOutputChunk
+        # sentEventChunk)
+        re_cur_valid = state["exp"]["valid"] & flushed0 & (
+            any_flush | (early & any_pool))
+        pool_cur_valid = cur_emits | (pool["valid"] & early)
+        reset_valid = (cur_emits & grp_first) | (early & grp_first)
+        flush_ts = jnp.where(early, last_ext, first_flush_ext)
+
+        now_exp = jnp.broadcast_to(flush_ts, (EB,))
         out = {
-            "ts": jnp.concatenate([now_exp, pool["ts"], flush_ext1]),
-            "cols": tuple(jnp.concatenate([ec, pc, pc])
+            "ts": jnp.concatenate([
+                now_exp, now_exp, pool["ts"],
+                jnp.where(early, last_ext, flush_ext1)]),
+            "cols": tuple(jnp.concatenate([ec, ec, pc, pc])
                           for ec, pc in zip(state["exp"]["cols"],
-                                            pool["cols"])),
-            "nulls": tuple(jnp.concatenate([en, pn, pn])
+                                            emit_cols)),
+            "nulls": tuple(jnp.concatenate([en, en, pn, pn])
                            for en, pn in zip(state["exp"]["nulls"],
                                              pool["nulls"])),
             "kind": jnp.concatenate([
                 jnp.full((EB,), EXPIRED, jnp.int32),
+                jnp.full((EB,), CURRENT, jnp.int32),
                 jnp.full((P,), CURRENT, jnp.int32),
                 jnp.full((P,), RESET, jnp.int32)]),
         }
@@ -955,7 +1024,7 @@ class ExternalTimeBatchWindowOp(WindowOp):
         out = {
             "ts": jnp.concatenate([out["ts"], flush_ext2]),
             "cols": tuple(jnp.concatenate([oc, pc])
-                          for oc, pc in zip(out["cols"], pool["cols"])),
+                          for oc, pc in zip(out["cols"], emit_cols)),
             "nulls": tuple(jnp.concatenate([on, pn])
                            for on, pn in zip(out["nulls"], pool["nulls"])),
             "kind": jnp.concatenate([out["kind"],
@@ -963,42 +1032,72 @@ class ExternalTimeBatchWindowOp(WindowOp):
         }
         emit_row = jnp.concatenate([
             jnp.broadcast_to(first_flush_row, (EB,)),
+            jnp.broadcast_to(first_flush_row, (EB,)),
             jnp.where(cur_emits, row1, 0),
             jnp.where(cur_emits & grp_first, row1, 0),
             jnp.where(exp_emits, row2, 0)])
         phase = jnp.concatenate([
             jnp.zeros((EB,), jnp.int64),
+            jnp.full((EB,), 2, jnp.int64),
             jnp.full((P,), 2, jnp.int64),
             jnp.ones((P,), jnp.int64),
             jnp.zeros((P,), jnp.int64)])
-        oseq = jnp.concatenate([state["exp"]["seq"], pool["seq"],
-                                pool["seq"], pool["seq"]])
+        oseq = jnp.concatenate([state["exp"]["seq"], state["exp"]["seq"],
+                                pool["seq"], pool["seq"], pool["seq"]])
         if self.expired_enabled:
-            exp_carry_valid = state["exp"]["valid"] & any_flush
             exp_pool_valid = exp_emits
         else:
-            exp_carry_valid = jnp.zeros((EB,), jnp.bool_)
             exp_pool_valid = jnp.zeros((P,), jnp.bool_)
-        valid = jnp.concatenate([exp_carry_valid, cur_emits,
-                                 cur_emits & grp_first, exp_pool_valid])
+        valid = jnp.concatenate([exp_exp_valid, re_cur_valid,
+                                 pool_cur_valid, reset_valid,
+                                 exp_pool_valid])
         result = emission_sort(out, emit_row, phase, oseq, valid,
-                               EB + 3 * P)
+                               2 * EB + 3 * P)
 
         # next buffers: pending = newest un-flushed window; exp = the last
-        # flushed window's rows
-        last_w = jnp.max(jnp.where(pool["valid"], w_of,
-                                   jnp.int64(-2 ** 62)))
-        pending = pool["valid"] & ~cur_emits
+        # flushed window's rows (merged with the earlier early-flushed set
+        # while the same batch window stays open)
+        pending = pool["valid"] & ~cur_emits & ~early
         new_cur, overflow = keep_newest(pool, pending, W)
         last_flushed = pool["valid"] & cur_emits & (
             w_of == jnp.max(jnp.where(cur_emits, w_of,
                                       jnp.int64(-2 ** 62))))
-        new_exp_pool, _ = keep_newest(pool, last_flushed, W)
+        flush_set = jnp.where(early, pool["valid"], last_flushed)
+        big = {
+            "cols": tuple(jnp.concatenate([ec, pc])
+                          for ec, pc in zip(state["exp"]["cols"],
+                                            emit_cols)),
+            "nulls": tuple(jnp.concatenate([en, pn])
+                           for en, pn in zip(state["exp"]["nulls"],
+                                             pool["nulls"])),
+            "ts": jnp.concatenate([state["exp"]["ts"], pool["ts"]]),
+            "seq": jnp.concatenate([state["exp"]["seq"], pool["seq"]]),
+            "valid": jnp.concatenate([state["exp"]["valid"],
+                                      pool["valid"]]),
+        }
+        # early-flushed rows stay in exp until a real boundary flush of a
+        # LATER batch replaces them (append semantics keep accumulating)
+        keep_exp_old = jnp.broadcast_to(flushed0, (EB,)) & \
+            state["exp"]["valid"]
+        big_mask = jnp.concatenate([keep_exp_old, flush_set])
+        new_exp_m, _ = keep_newest(big, big_mask, W)
+        did_flush = any_flush | (early & (~flushed0 | any_pool))
         new_exp = jax.tree_util.tree_map(
-            lambda a_, b_: jnp.where(any_flush, a_, b_), new_exp_pool,
+            lambda a_, b_: jnp.where(did_flush, a_, b_), new_exp_m,
             state["exp"])
+
+        flushed1 = jnp.where(early, True,
+                             jnp.where(any_flush, False, flushed0))
+        sched = state["sched"]
+        if has_timeout:
+            trigger = early | any_flush | (
+                (state["sched"] >= POS_INF) & (n_cur > 0))
+            sched = jnp.where(
+                trigger,
+                jnp.asarray(now, jnp.int64) + self.timeout_ms, sched)
         return ({"cur": new_cur, "exp": new_exp, "start": start,
-                 "next_seq": next_seq,
+                 "next_seq": next_seq, "flushed": flushed1,
+                 "sched": sched, "last_ext": last_ext,
                  "overflow": state["overflow"] + overflow}, result)
 
     def findable_buffer(self, state):

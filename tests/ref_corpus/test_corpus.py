@@ -20,6 +20,7 @@ import pathlib
 import pytest
 
 from siddhi_tpu import Event, QueryCallback, SiddhiManager, StreamCallback
+from siddhi_tpu.lang.tokens import SiddhiParserException
 from siddhi_tpu.ops.expr import CompileError
 
 DIR = pathlib.Path(__file__).parent
@@ -86,6 +87,12 @@ def _is_ordered_subset(got_rows, exp_rows):
 def test_ref_case(case, request):
     cid = request.node.callspec.id
     mgr = SiddhiManager()
+    if case.get("expect_error"):
+        # reference @Test(expectedExceptions=SiddhiAppCreationException):
+        # app creation must be REJECTED
+        with pytest.raises((CompileError, SiddhiParserException)):
+            mgr.create_siddhi_app_runtime("@app:playback " + case["app"])
+        return
     try:
         rt = mgr.create_siddhi_app_runtime("@app:playback " + case["app"])
     except CompileError as e:
@@ -147,6 +154,16 @@ def test_ref_case(case, request):
                     rt.on_ingest_ts(clock)
                 if state["in"] == 1:
                     break
+        elif act[0] == "wait_count":
+            # SiddhiTestHelper.waitForEvents(sleep, expected, counter,
+            # timeout): poll until the counter reaches `expected`
+            _, sleep_ms, want, which, timeout_ms = act
+            for _ in range(max(timeout_ms // max(sleep_ms, 1), 1)):
+                if state["in" if which == "in" else "rm"] >= want:
+                    break
+                clock += sleep_ms
+                with rt.barrier:
+                    rt.on_ingest_ts(clock)
     rt.shutdown()
 
     if case["expected_in"] is not None:
@@ -154,7 +171,9 @@ def test_ref_case(case, request):
             f"in-events {state['in']} != {case['expected_in']} " \
             f"(rows={state['in_rows']})"
     if case["expected_removed"] is not None:
-        assert state["rm"] == case["expected_removed"]
+        assert state["rm"] == case["expected_removed"], \
+            f"rm-events {state['rm']} != {case['expected_removed']} " \
+            f"(rows={state['rm_rows']})"
     if case["event_arrived"] is not None:
         arrived = state["in"] > 0 or state["rm"] > 0
         assert arrived == case["event_arrived"]

@@ -1,0 +1,91 @@
+"""Distributed sinks: @sink(@distribution(strategy=..., @destination...)).
+
+Reference: stream/output/sink/distributed/DistributedTransport.java:47,
+RoundRobinDistributionStrategy.java, PartitionedDistributionStrategy.java,
+BroadcastDistributionStrategy.java — multi-destination publishing over the
+sink SPI, here exercised with inMemory destinations.
+"""
+from siddhi_tpu import Event, SiddhiManager
+from siddhi_tpu.core.io import InMemoryBroker, _java_string_hash
+
+
+def _collect(topics):
+    got = {t: [] for t in topics}
+    subs = []
+    for t in topics:
+        subs.append(InMemoryBroker.subscribe(
+            t, lambda p, t=t: got[t].append(p)))
+    return got, subs
+
+
+def _run(app_text, rows):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(app_text)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i, r in enumerate(rows):
+        h.send(Event(1000 + i, r))
+    rt.shutdown()
+
+
+def test_round_robin():
+    got, _ = _collect(["rr.t1", "rr.t2"])
+    _run("""
+        @sink(type='inMemory', @map(type='passThrough'),
+              @distribution(strategy='roundRobin',
+                            @destination(topic='rr.t1'),
+                            @destination(topic='rr.t2')))
+        define stream S (sym string, v int);
+        """, [("a", 1), ("b", 2), ("c", 3), ("d", 4)])
+    assert [e.data for e in got["rr.t1"]] == [("a", 1), ("c", 3)]
+    assert [e.data for e in got["rr.t2"]] == [("b", 2), ("d", 4)]
+
+
+def test_broadcast():
+    got, _ = _collect(["bc.t1", "bc.t2", "bc.t3"])
+    _run("""
+        @sink(type='inMemory', @map(type='passThrough'),
+              @distribution(strategy='broadcast',
+                            @destination(topic='bc.t1'),
+                            @destination(topic='bc.t2'),
+                            @destination(topic='bc.t3')))
+        define stream S (sym string, v int);
+        """, [("a", 1), ("b", 2)])
+    for t in ("bc.t1", "bc.t2", "bc.t3"):
+        assert [e.data for e in got[t]] == [("a", 1), ("b", 2)]
+
+
+def test_partitioned():
+    got, _ = _collect(["pt.t1", "pt.t2"])
+    _run("""
+        @sink(type='inMemory', @map(type='passThrough'),
+              @distribution(strategy='partitioned', partitionKey='sym',
+                            @destination(topic='pt.t1'),
+                            @destination(topic='pt.t2')))
+        define stream S (sym string, v int);
+        """, [("a", 1), ("b", 2), ("a", 3), ("b", 4)])
+    # same key -> same destination, split by Java String.hashCode % 2
+    d_a = abs(_java_string_hash("a")) % 2
+    d_b = abs(_java_string_hash("b")) % 2
+    t_a = ["pt.t1", "pt.t2"][d_a]
+    t_b = ["pt.t1", "pt.t2"][d_b]
+    assert [e.data for e in got[t_a] if e.data[0] == "a"] == \
+        [("a", 1), ("a", 3)]
+    assert [e.data for e in got[t_b] if e.data[0] == "b"] == \
+        [("b", 2), ("b", 4)]
+    # and nothing leaked to the other topic
+    assert all(e.data[0] == "a" for e in got[t_a]) or t_a == t_b
+    assert all(e.data[0] == "b" for e in got[t_b]) or t_a == t_b
+
+
+def test_partitioned_missing_key_rejected():
+    import pytest
+    from siddhi_tpu.ops.expr import CompileError
+    mgr = SiddhiManager()
+    with pytest.raises(CompileError):
+        mgr.create_siddhi_app_runtime("""
+            @sink(type='inMemory',
+                  @distribution(strategy='partitioned',
+                                @destination(topic='x.t1')))
+            define stream S (sym string, v int);
+            """)

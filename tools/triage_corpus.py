@@ -57,7 +57,9 @@ def main():
             out["compile"].append((cid, str(e)[:90]))
         except AssertionError as e:
             out["diverge"].append((cid, str(e).split("\n")[0][:110]))
-        except Exception as e:  # noqa: BLE001
+        except BaseException as e:  # noqa: BLE001 — incl. pytest Failed
+            if e.__class__.__name__ in ("KeyboardInterrupt", "SystemExit"):
+                raise
             out["crash"].append((cid, f"{type(e).__name__}: {e}"[:110]))
     for k in ("pass", "parse", "compile", "diverge", "crash"):
         print(f"== {k}: {len(out[k])}")
