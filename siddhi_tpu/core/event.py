@@ -100,9 +100,8 @@ class EventBatch:
 
     @classmethod
     def empty(cls, schema: StreamSchema, capacity: int) -> "EventBatch":
-        cols = tuple(
-            jnp.zeros((capacity,), dtype=np_dtype(t)) for t in schema.types
-        )
+        from .types import col_zeros
+        cols = tuple(col_zeros(t, capacity) for t in schema.types)
         nulls = tuple(jnp.zeros((capacity,), dtype=jnp.bool_) for _ in schema.types)
         return cls(
             ts=jnp.zeros((capacity,), dtype=jnp.int64),
@@ -231,6 +230,9 @@ def rows_from_batch(schema_types: Sequence[AttrType], batch) -> list:
         for i, t in enumerate(schema_types):
             if nulls[i][r]:
                 vals.append(None)
+            elif t is AttrType.OBJECT:
+                from .types import decode_set
+                vals.append(decode_set(cols[i][r]))
             elif t is AttrType.STRING:
                 vals.append(GLOBAL_STRINGS.decode(
                     cols[i][r], uuid_key=(nonce, int(ts[r]), r, i)))

@@ -477,11 +477,23 @@ class QueryRuntime(Receiver):
 
     def overflow_total(self) -> int:
         """Sum of overflow counters across operator states (windows etc.;
-        the 'counted, never silent' contract)."""
+        the 'counted, never silent' contract). Walks nested state dicts
+        — aggregator tables carry their own counters."""
         total = 0
-        for st in jax.device_get(self.states):
-            if isinstance(st, dict) and "overflow" in st:
-                total += int(st["overflow"])
+
+        def walk(st):
+            nonlocal total
+            if isinstance(st, dict):
+                for k, v in st.items():
+                    if k == "overflow":
+                        total += int(v)
+                    else:
+                        walk(v)
+            elif isinstance(st, (tuple, list)):
+                for v in st:
+                    walk(v)
+
+        walk(jax.device_get(self.states))
         return total
 
     # -- runtime ---------------------------------------------------------

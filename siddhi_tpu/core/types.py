@@ -141,6 +141,56 @@ class StringTable:
 GLOBAL_STRINGS = StringTable()
 
 
+# ---------------------------------------------------------------------------
+# SET values (createSet/unionSet/sizeOfSet): a set is a fixed-width int64
+# vector [1 + SET_LANES] — lane 0 a type tag, lanes 1.. the encoded
+# elements, empty lanes SET_EMPTY. Columns of AttrType.OBJECT carrying
+# sets are 2D [rows, 1 + SET_LANES] on device and decode to frozensets.
+# ---------------------------------------------------------------------------
+SET_LANES = 32
+SET_EMPTY = -(2 ** 62)
+_SET_TAGS = {}
+_SET_TAG_OF = {}
+
+
+def set_tag_of(t: AttrType) -> int:
+    order = [AttrType.INT, AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE,
+             AttrType.BOOL, AttrType.STRING]
+    if t not in order:
+        raise ValueError(f"createSet() not supported for type {t}")
+    return order.index(t) + 1
+
+
+def decode_set(arr) -> frozenset:
+    """Host boundary: [1 + SET_LANES] int64 -> frozenset."""
+    import struct
+
+    tag = int(arr[0])
+    out = []
+    for v in arr[1:]:
+        v = int(v)
+        if v == SET_EMPTY:
+            continue
+        if tag in (3, 4):        # FLOAT / DOUBLE bit patterns
+            out.append(struct.unpack("<d", struct.pack("<q", v))[0])
+        elif tag == 5:
+            out.append(bool(v))
+        elif tag == 6:
+            out.append(GLOBAL_STRINGS.decode(v))
+        else:
+            out.append(v)
+    return frozenset(out)
+
+
+def col_zeros(t: AttrType, cap: int):
+    """Zero column of device shape for one attribute: [cap] for
+    primitives, [cap, 1 + SET_LANES] int64 for SET-carrying OBJECT."""
+    import jax.numpy as jnp
+    if t is AttrType.OBJECT:
+        return jnp.full((cap, 1 + SET_LANES), jnp.int64(SET_EMPTY))
+    return jnp.zeros((cap,), dtype=np_dtype(t))
+
+
 def null_value(t: AttrType):
     """The in-band placeholder stored in the data column where null; the
     actual null signal is the per-column null mask."""

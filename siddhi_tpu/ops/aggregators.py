@@ -373,6 +373,100 @@ class DistinctCountAgg(AggSpec):
         return Col(d, jnp.zeros_like(d, dtype=jnp.bool_))
 
 
+class UnionSetAgg(AggSpec):
+    """unionSet(): union of aggregated sets with removal support
+    (UnionSetAttributeAggregatorExecutor.java:43 keeps a Set plus a
+    value->count map for expired-decrement).
+
+    Device design: a bounded [S] value/multiplicity table (SET_LANES
+    slots). Per chunk: existing entries and all incoming rows' set lanes
+    merge through one sort + segmented count; entries whose multiplicity
+    stays positive re-pack into the table, overflow counted. Rows of one
+    chunk observe the END-OF-CHUNK union (exact for batch windows, where
+    one flush chunk produces one emission; documented chunk-granular for
+    sliding windows). Ungrouped only — group by + unionSet rejects."""
+
+    stateful = True
+
+    def __init__(self, arg_type: AttrType, grouped: bool):
+        from ..core.types import SET_LANES
+        if arg_type is not AttrType.OBJECT:
+            raise CompileError(
+                "Parameter passed to unionSet aggregator should be a set "
+                "object (createSet() result)")
+        if grouped:
+            raise CompileError(
+                "unionSet() with group by is not supported yet")
+        self.name = "unionSet"
+        self.out_type = AttrType.OBJECT
+        self.S = SET_LANES
+        self.lanes = (Lane("sum", jnp.int64),)
+
+    def init_table(self, K: int):
+        from ..core.types import SET_EMPTY
+        return {"vals": jnp.full((self.S,), SET_EMPTY, jnp.int64),
+                "counts": jnp.zeros((self.S,), jnp.int64),
+                "tag": jnp.int64(0),
+                "overflow": jnp.int64(0)}
+
+    def run(self, arg, ctx, tab):
+        from ..core.types import SET_EMPTY
+        B, S = ctx["B"], self.S
+        is_add, is_remove = ctx["is_add"], ctx["is_remove"]
+        agg_row = ctx["agg_row"]
+        n_resets = ctx["n_resets"]
+        reset_seg = ctx["reset_seg"]
+
+        elems = arg.values[:, 1:]                       # [B, S]
+        tag_col = arg.values[:, 0]
+        eff = agg_row & ~arg.nulls & (reset_seg == n_resets)
+        sgn_row = jnp.where(eff & is_add, jnp.int64(1),
+                            jnp.where(eff & is_remove, jnp.int64(-1),
+                                      jnp.int64(0)))
+        flat_vals = elems.reshape(-1)
+        flat_sgn = jnp.repeat(sgn_row, S)
+        flat_sgn = jnp.where(flat_vals == SET_EMPTY, 0, flat_sgn)
+
+        # existing table participates only when no reset wiped it
+        keep_tab = n_resets == 0
+        tab_vals = jnp.where(keep_tab, tab["vals"], SET_EMPTY)
+        tab_cnt = jnp.where(keep_tab, tab["counts"], 0)
+
+        all_vals = jnp.concatenate([tab_vals, flat_vals])
+        all_sgn = jnp.concatenate([tab_cnt, flat_sgn])
+        # distinct totals: sort by value, segment-sum the multiplicities
+        order = jnp.argsort(all_vals)
+        v_s = all_vals[order]
+        c_s = all_sgn[order]
+        seg_start = jnp.concatenate([jnp.ones((1,), jnp.bool_),
+                                     v_s[1:] != v_s[:-1]])
+        seg_id = jnp.cumsum(seg_start.astype(jnp.int64)) - 1
+        totals = segmented_cumsum(c_s, seg_id)
+        is_last = jnp.concatenate([seg_id[:-1] != seg_id[1:],
+                                   jnp.ones((1,), jnp.bool_)])
+        live = is_last & (totals > 0) & (v_s != SET_EMPTY)
+        rank = jnp.cumsum(live.astype(jnp.int64)) - 1
+        n_live = jnp.sum(live.astype(jnp.int64))
+        dest = jnp.where(live & (rank < S), rank, jnp.int64(S))
+        new_vals = jnp.full((S,), jnp.int64(SET_EMPTY)).at[dest].set(
+            jnp.where(live, v_s, SET_EMPTY), mode="drop")
+        new_cnt = jnp.zeros((S,), jnp.int64).at[dest].set(
+            jnp.where(live, totals, 0), mode="drop")
+        tag = jnp.maximum(tab["tag"], jnp.max(jnp.where(
+            eff, tag_col, jnp.int64(0))))
+        new_tab = {"vals": new_vals, "counts": new_cnt, "tag": tag,
+                   "overflow": tab["overflow"] +
+                   jnp.maximum(n_live - S, 0)}
+        # every row observes the end-of-chunk union
+        set_vec = jnp.concatenate([tag[None], new_vals])
+        running = jnp.broadcast_to(set_vec[None, :], (B, S + 1))
+        return (running,), new_tab
+
+    def value(self, lane_vals):
+        (v,) = lane_vals
+        return Col(v, jnp.zeros(v.shape[:1], jnp.bool_))
+
+
 def _tree_levels(w: int) -> int:
     return int(w).bit_length() - 1
 
@@ -548,6 +642,8 @@ def make_agg_spec(name: str, arg_type: Optional[AttrType],
         return BoolAgg(arg_type, key == "and")
     if key == "distinctcount":
         return DistinctCountAgg(arg_type)
+    if key == "unionset":
+        return UnionSetAgg(arg_type, grouped)
     raise CompileError(f"unknown aggregator '{name}'")
 
 
@@ -820,7 +916,11 @@ class AggregateOp(Operator):
         out_cols, out_nulls = [], []
         for ce in self.compiled:
             c = ce.fn(env)
-            out_cols.append(jnp.broadcast_to(c.values, (B,)))
+            if c.values.ndim == 2:   # SET columns: [rows, lanes]
+                out_cols.append(jnp.broadcast_to(
+                    c.values, (B,) + c.values.shape[-1:]))
+            else:
+                out_cols.append(jnp.broadcast_to(c.values, (B,)))
             out_nulls.append(jnp.broadcast_to(c.nulls, (B,)))
 
         qualifying = ((is_add & self.current_on) |

@@ -98,6 +98,15 @@ class SingleStreamScope(Scope):
         return ("attr", idx), self.schema.types[idx]
 
 
+def _set_encode_elem(values, t: AttrType):
+    """Encode a primitive column to the int64 set-lane representation."""
+    if t in (AttrType.FLOAT, AttrType.DOUBLE):
+        import jax
+        return jax.lax.bitcast_convert_type(
+            values.astype(jnp.float64), jnp.int64)
+    return values.astype(jnp.int64)
+
+
 def env_from_batch(batch) -> dict:
     """Standard env for a single-stream batch."""
     env = {("attr", i): Col(batch.cols[i], batch.nulls[i])
@@ -430,6 +439,51 @@ def _compile_function(e: A.AttributeFunction, comp, scope, functions) -> Compile
             return Col(jnp.full(shape, code, jnp.int32),
                        jnp.zeros(shape, jnp.bool_))
         return CompiledExpr(AttrType.STRING, fn)
+
+    if key == "createset":
+        # CreateSetFunctionExecutor.java: singleton java.util.Set. Device
+        # design: a SET value is a fixed [1 + SET_LANES] int64 vector —
+        # lane 0 a type tag, lanes 1.. the encoded elements (numerics
+        # promoted/bit-cast, strings as dictionary codes), empty lanes
+        # SET_EMPTY. Set columns are 2D [rows, 1+S] and decode to python
+        # frozensets at the host boundary.
+        from ..core.types import SET_EMPTY, SET_LANES, set_tag_of
+        if len(params) != 1:
+            raise CompileError(
+                "createSet() function has to have exactly 1 parameter")
+        src = params[0]
+        tag = set_tag_of(src.type)
+
+        def fn(env, src=src, tag=tag):
+            c = src.fn(env)
+            v = _set_encode_elem(c.values, src.type)
+            v = jnp.where(c.nulls, jnp.int64(SET_EMPTY), v)
+            shape = jnp.shape(v)
+            lanes = [jnp.broadcast_to(jnp.int64(tag), shape)[..., None],
+                     v[..., None]]
+            lanes.append(jnp.broadcast_to(
+                jnp.int64(SET_EMPTY), shape + (SET_LANES - 1,)))
+            return Col(jnp.concatenate(lanes, axis=-1),
+                       jnp.zeros(shape, jnp.bool_))
+        return CompiledExpr(AttrType.OBJECT, fn)
+
+    if key == "sizeofset":
+        from ..core.types import SET_EMPTY
+        if len(params) != 1:
+            raise CompileError(
+                "sizeOfSet() function has to have exactly 1 parameter")
+        src = params[0]
+        if src.type is not AttrType.OBJECT:
+            raise CompileError(
+                "sizeOfSet() parameter should be a set object "
+                "(createSet()/unionSet() result)")
+
+        def fn(env, src=src):
+            c = src.fn(env)
+            n = jnp.sum((c.values[..., 1:] != SET_EMPTY)
+                        .astype(jnp.int32), axis=-1)
+            return Col(n, c.nulls)
+        return CompiledExpr(AttrType.INT, fn)
 
     if key == "eventtimestamp":
         def fn(env):

@@ -192,7 +192,12 @@ class ProjectOp(Operator):
             cols, nulls = [], []
             for ce in self.compiled:
                 c = ce.fn(env)
-                cols.append(jnp.broadcast_to(c.values, batch.ts.shape))
+                if c.values.ndim == 2:   # SET columns: [rows, lanes]
+                    cols.append(jnp.broadcast_to(
+                        c.values,
+                        batch.ts.shape + c.values.shape[-1:]))
+                else:
+                    cols.append(jnp.broadcast_to(c.values, batch.ts.shape))
                 nulls.append(jnp.broadcast_to(c.nulls, batch.ts.shape))
             out = EventBatch(ts=batch.ts, cols=tuple(cols),
                              nulls=tuple(nulls), kind=batch.kind,

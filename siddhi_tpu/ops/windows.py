@@ -53,11 +53,11 @@ from .sentinels import I32_LO, I32_MAX, NEG_INF, POS_INF  # noqa: F401
 
 
 def empty_buffer(schema: StreamSchema, cap: int) -> dict:
+    from ..core.types import col_zeros
     return {
         "ts": jnp.zeros((cap,), dtype=jnp.int64),
         "seq": jnp.zeros((cap,), dtype=jnp.int64),
-        "cols": tuple(jnp.zeros((cap,), dtype=np_dtype(t))
-                      for t in schema.types),
+        "cols": tuple(col_zeros(t, cap) for t in schema.types),
         "nulls": tuple(jnp.zeros((cap,), dtype=jnp.bool_)
                        for _ in schema.types),
         "valid": jnp.zeros((cap,), dtype=jnp.bool_),

@@ -1,0 +1,106 @@
+"""createSet / unionSet / sizeOfSet — the set-object family.
+
+Reference: executor/function/CreateSetFunctionExecutor.java,
+query/selector/attribute/aggregator/UnionSetAttributeAggregatorExecutor
+.java:43, SizeOfSetFunctionExecutor. Device design: a set value is a
+fixed [1 + SET_LANES] int64 vector (tag + encoded elements); unionSet
+keeps a bounded value/multiplicity table with overflow counting.
+"""
+import pytest
+
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+from siddhi_tpu.core.types import SET_LANES
+from siddhi_tpu.ops.expr import CompileError
+
+PLAYBACK = "@app:playback "
+
+
+def run_app(app, sends):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(PLAYBACK + app)
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        fn=lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i, row in enumerate(sends):
+        h.send(Event(1000 + i, row))
+    rt.shutdown()
+    return got
+
+
+def test_create_size_roundtrip():
+    got = run_app("""
+        define stream S (symbol string, price double);
+        from S select createSet(symbol) as s,
+                      sizeOfSet(createSet(symbol)) as n
+        insert into Out;""", [("WSO2", 1.0), ("IBM", 2.0)])
+    assert got == [(frozenset({"WSO2"}), 1), (frozenset({"IBM"}), 1)]
+
+
+def test_union_over_length_batch():
+    got = run_app("""
+        define stream S (symbol string, price double);
+        from S select createSet(symbol) as initialSet
+        insert into InitStream;
+        from InitStream#window.lengthBatch(3)
+        select unionSet(initialSet) as symbols,
+               sizeOfSet(unionSet(initialSet)) as n
+        insert into Out;""",
+        [("WSO2", 1.0), ("IBM", 2.0), ("WSO2", 3.0),
+         ("GOOG", 4.0), ("GOOG", 5.0), ("IBM", 6.0)])
+    assert got == [(frozenset({"WSO2", "IBM"}), 2),
+                   (frozenset({"GOOG", "IBM"}), 2)]
+
+
+def test_union_numeric_elements():
+    got = run_app("""
+        define stream S (symbol string, price double);
+        from S select createSet(price) as ps insert into P;
+        from P#window.lengthBatch(4)
+        select unionSet(ps) as prices insert into Out;""",
+        [("a", 1.5), ("b", 2.5), ("c", 1.5), ("d", 4.0)])
+    assert got == [(frozenset({1.5, 2.5, 4.0}),)]
+
+
+def test_union_overflow_counted():
+    # more distinct elements than SET_LANES: drop + count, no silent loss
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(PLAYBACK + """
+        define stream S (v long);
+        from S select createSet(v) as vs insert into P;
+        from P#window.lengthBatch(50)
+        select unionSet(vs) as union insert into Out;""")
+    got = []
+    rt.add_callback("Out", StreamCallback(
+        fn=lambda evs: got.extend(e.data for e in evs)))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(50):
+        h.send(Event(1000 + i, (i,)))
+    union_q = list(rt.queries.values())[-1]     # the unionSet query
+    overflow = union_q.overflow_total()
+    rt.shutdown()
+    assert len(got) == 1
+    assert len(got[0][0]) == SET_LANES          # capacity-bounded
+    assert overflow >= 50 - SET_LANES            # drops counted
+
+
+def test_create_set_two_params_rejected():
+    # FunctionTestCase.testFunctionQuery9
+    mgr = SiddhiManager()
+    with pytest.raises(CompileError):
+        mgr.create_siddhi_app_runtime("""
+            define stream S (symbol string, deviceId long);
+            from S select createSet(symbol, deviceId) as s
+            insert into Out;""")
+
+
+def test_union_group_by_rejected():
+    mgr = SiddhiManager()
+    with pytest.raises(CompileError):
+        mgr.create_siddhi_app_runtime("""
+            define stream S (symbol string, price double);
+            from S select createSet(symbol) as s insert into P;
+            from P#window.lengthBatch(2)
+            select unionSet(s) as u group by s insert into Out;""")
