@@ -1829,11 +1829,23 @@ class Planner:
             if name in app.queries or name in block_names:
                 raise CompileError(f"duplicate query name '{name}'")
             block_names.add(name)
+            if isinstance(q.input, A.StateInputStream):
+                plan = self._plan_partition_pattern(q, name, key_specs)
+                if plan.inner_target:
+                    prev = inner_schemas.get(plan.target)
+                    if prev is not None and \
+                            prev.types != plan.out_schema.types:
+                        raise CompileError(
+                            f"inner stream '{plan.target}' schema "
+                            "mismatch between producers")
+                    inner_schemas[plan.target] = plan.out_schema
+                plans.append(plan)
+                continue
             if not isinstance(q.input, A.SingleInputStream):
                 raise CompileError(
-                    f"query '{name}': only single-stream queries are "
-                    "supported inside partitions (joins/patterns in "
-                    "partitions are a later stage)")
+                    f"query '{name}': only single-stream and pattern/"
+                    "sequence queries are supported inside partitions "
+                    "(joins in partitions are a later stage)")
             sin = q.input
             if sin.is_inner:
                 input_id = "#" + sin.stream_id
@@ -1888,8 +1900,10 @@ class Planner:
         app.partitions[block.name] = block
 
         # 3. wiring: subscribe consumed outer streams; wire outer outputs
-        consumed = sorted({p.input_id for p in plans
-                           if not p.input_id.startswith("#")})
+        consumed = sorted(
+            {sid for p in plans
+             for sid in getattr(p, "input_ids", {p.input_id})
+             if not sid.startswith("#")})
         for sid in consumed:
             app.junctions[sid].subscribe(BlockStreamReceiver(block, sid))
         for q, plan in zip(part.queries, plans):
@@ -2290,6 +2304,64 @@ class Planner:
                 functions=self.functions,
                 current_on=current_on, expired_on=expired_on))
         return operators
+
+    def _plan_partition_pattern(self, q, name: str, key_specs: dict):
+        """A pattern/sequence query inside a partition: the scan-engine
+        NFA runs per key slot under the block vmap
+        (PartitionRuntimeImpl.java:75 clones state runtimes per key)."""
+        import dataclasses
+        from ..ops.nfa import (MatchScope, NfaCompiler, NfaEngine,
+                               rewrite_last_refs, rewrite_oob_refs)
+        from ..parallel.partition import BlockPatternPlan
+        app = self.app
+        sin = q.input
+        out = q.output
+        if not isinstance(out, (A.InsertIntoStream, A.ReturnStream)):
+            raise CompileError(
+                f"query '{name}': table output inside partitions not "
+                "yet supported")
+        out_type = out.output_event_type
+        inner_target = bool(getattr(out, "is_inner", False))
+        raw_target = getattr(out, "target", None) or name
+        target = ("#" + raw_target) if inner_target else raw_target
+
+        compiler = NfaCompiler(app.schemas, sin.state_type)
+        slots, states = compiler.compile(sin.state)
+        sel = q.selector
+        if sel.attributes:
+            sel.attributes = [
+                dataclasses.replace(
+                    oa, expression=rewrite_oob_refs(
+                        rewrite_last_refs(oa.expression, slots), slots))
+                for oa in sel.attributes]
+        if sel.having is not None:
+            sel.having = rewrite_oob_refs(
+                rewrite_last_refs(sel.having, slots), slots)
+        # per-slot pending tables stay modest: K instances multiply
+        engine = NfaEngine(slots, states, sin.state_type, sin.within_ms,
+                           capacity=32, out_capacity=64)
+        scope = MatchScope(slots, engine.col_index)
+        input_ids = {s.stream_id for s in slots}
+        for sid in sorted(input_ids):
+            if sid not in key_specs:
+                raise CompileError(
+                    f"query '{name}': pattern stream '{sid}' is not "
+                    "partitioned (no 'partition with' clause names it)")
+        current_on = out_type in ("current", "all")
+        expired_on = out_type in ("expired", "all")
+        if selector_needs_aggregation(q.selector):
+            sel_ops: list[Operator] = [AggregateOp(
+                q.selector, engine.match_schema, target, scope,
+                batch_mode=False, expired_possible=False,
+                current_on=current_on, expired_on=expired_on)]
+        else:
+            sel_ops = [ProjectOp(
+                q.selector, engine.match_schema, target, scope,
+                current_on=current_on, expired_on=expired_on,
+                having_in_scope=scope)]
+        in_schema = app.schemas[sorted(input_ids)[0]]
+        return BlockPatternPlan(name, engine, sel_ops, input_ids,
+                                in_schema, target, inner_target, out_type)
 
     def append_table_output(self, operators: list, out, name: str) -> None:
         """Insert/delete/update/update-or-insert into a table becomes a

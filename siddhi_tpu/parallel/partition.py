@@ -102,6 +102,42 @@ class BlockQueryPlan:
                    for op in self.operators)
 
 
+class BlockPatternPlan:
+    """A pattern/sequence query inside a partition block: the NFA pending
+    table gains a leading [K] slot axis under the block's vmap — each key
+    instance owns an independent pending table (the reference clones
+    whole query runtimes per key: PartitionRuntimeImpl.java:75,
+    PartitionStreamReceiver.java:82-146)."""
+
+    is_pattern = True
+
+    def __init__(self, name: str, engine, sel_ops: list,
+                 input_ids: set, in_schema: StreamSchema, target: str,
+                 inner_target: bool, out_type: str):
+        self.name = name
+        self.engine = engine
+        self.sel_ops = sel_ops
+        self.input_ids = input_ids        # outer stream ids consumed
+        self.input_id = next(iter(sorted(input_ids)))
+        self.in_schema = in_schema
+        self.operators = sel_ops          # for sort-heavy/overflow scans
+        self.target = target
+        self.inner_target = inner_target
+        self.out_type = out_type
+
+    @property
+    def out_schema(self) -> StreamSchema:
+        return self.sel_ops[-1].out_schema if self.sel_ops \
+            else self.engine.match_schema
+
+    def init_state(self):
+        return (self.engine.init_state(),
+                tuple(op.init_state() for op in self.sel_ops))
+
+    def has_timers(self) -> bool:
+        return self.engine.has_absent
+
+
 class PartitionQueryPort:
     """Output surface of one partitioned query: handlers + callbacks
     (what `app.queries[name]` exposes for queries inside a partition)."""
@@ -329,6 +365,16 @@ class PartitionBlockRuntime:
         K = self.K
         key_specs = self.key_specs
 
+        # pattern plans: the engine's per-stream/timer step fns are
+        # trigger-specific — built once per compiled step
+        nfa_steps = {}
+        for p in plans:
+            if getattr(p, "is_pattern", False):
+                if kind == "stream" and tid in p.input_ids:
+                    nfa_steps[p.name] = p.engine.make_stream_step(tid)
+                elif kind == "timer" and p.name == tid:
+                    nfa_steps[p.name] = p.engine.make_timer_step()
+
         def step(slot_tbl, qstates, emitted, lost, batch, now):
             if kind == "stream":
                 slots, slot_tbl = self._slots_for(
@@ -343,6 +389,26 @@ class PartitionBlockRuntime:
                 dues_k: dict = {}
                 new_k: dict = {}
                 for p in plans:
+                    if getattr(p, "is_pattern", False):
+                        nstep = nfa_steps.get(p.name)
+                        if nstep is None:
+                            new_k[p.name] = per_slot[p.name]
+                            continue
+                        nfa_state, sel_states = per_slot[p.name]
+                        if kind == "timer":
+                            nfa_state, b = nstep(nfa_state, now)
+                        else:
+                            bk = batch.mask((slots == k) | is_timer_row)
+                            nfa_state, b = nstep(nfa_state, bk, now)
+                        sts = []
+                        for op, st in zip(p.sel_ops, sel_states):
+                            st, b = op.step(st, b, now)
+                            sts.append(st)
+                        new_k[p.name] = (nfa_state, tuple(sts))
+                        if p.engine.has_absent:
+                            dues_k[p.name] = p.engine.next_due(nfa_state)
+                        outs_k[p.name] = b
+                        continue
                     if kind == "timer" and p.name == tid:
                         b = batch
                     elif kind == "stream" and p.input_id == tid:
