@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import bisect
 import dataclasses
+import re
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -1246,6 +1247,11 @@ class SiddhiAppRuntime:
         self.running = False
         self._playback = False
         self._playback_time: Optional[int] = None
+        # @app:playback(idle.time, increment): auto-advance parameters
+        self._playback_idle_ms: Optional[int] = None
+        self._playback_increment_ms: Optional[int] = None
+        self._last_ingest_wall = 0.0
+        self._idle_thread: Optional[threading.Thread] = None
         self._local_store = None  # fallback store when manager is None
         self._cron_armed = False
         self._due_pending: list = []
@@ -1320,6 +1326,7 @@ class SiddhiAppRuntime:
                 base = (first_ts if first_ts is not None else last_ts) - 1
                 self._arm_cron(base)
             self._playback_time = last_ts
+            self._last_ingest_wall = time.monotonic()
             self.scheduler.advance_to(last_ts)
 
     def _arm_cron(self, base_ms: int) -> None:
@@ -1432,6 +1439,31 @@ class SiddhiAppRuntime:
                 pats, self._unarmed_patterns = self._unarmed_patterns, []
                 for q in pats:
                     q.arm_start_deadlines(now)
+        elif self._playback_idle_ms is not None:
+            # @app:playback(idle.time, increment): a wall-clock watcher
+            # advances the virtual clock by `increment` whenever no
+            # events arrive for `idle.time`
+            # (EventTimeBasedMillisTimestampGenerator's scheduled task)
+            def idle_advance():
+                idle_s = self._playback_idle_ms / 1000.0
+                while self.running:
+                    time.sleep(idle_s)
+                    if not self.running:
+                        return
+                    with self.barrier:
+                        if self._playback_time is None:
+                            continue
+                        idle_for = time.monotonic() - self._last_ingest_wall
+                        if idle_for < idle_s:
+                            continue
+                        nxt = self._playback_time + \
+                            self._playback_increment_ms
+                        self._playback_time = nxt
+                        self.scheduler.advance_to(nxt)
+
+            self._idle_thread = threading.Thread(
+                target=idle_advance, name="playback-idle", daemon=True)
+            self._idle_thread.start()
 
     def _start_record_tables(self) -> None:
         from .store import CacheTableRuntime
@@ -1745,10 +1777,22 @@ class Planner:
             from .stats import parse_level
             lvl = sa.element() or sa.element("level") or "BASIC"
             app.stats_level = parse_level(lvl)
-        # playback mode
+        # playback mode (+ optional idle-advance: SiddhiAppParser.java
+        # :171-210 wires EventTimeBasedMillisTimestampGenerator so the
+        # virtual clock advances by `increment` whenever sources stay
+        # idle for `idle.time` of wall time)
         pb = A.find_annotation(ast.annotations, "playback")
         if pb is not None:
             app._playback = True
+            idle = pb.element("idle.time")
+            inc = pb.element("increment")
+            if (idle is None) != (inc is None):
+                raise CompileError(
+                    "@app:playback needs BOTH idle.time and increment "
+                    "(or neither)")
+            if idle is not None:
+                app._playback_idle_ms = _time_str_ms(idle, "idle.time")
+                app._playback_increment_ms = _time_str_ms(inc, "increment")
         # 2. queries in order; inferred output streams defined as we go
         qcount = 0
         pcount = 0
@@ -2686,6 +2730,24 @@ def _expect(params, n, name):
     if len(params) != n:
         raise CompileError(f"window '{name}' takes {n} parameter(s), got "
                            f"{len(params)}")
+
+
+def _time_str_ms(s, role: str) -> int:
+    """'100 millisecond' / '2 sec' / bare ms int -> milliseconds."""
+    s = str(s).strip()
+    m = re.fullmatch(
+        r"(\d+)\s*(millisecond|milliseconds|ms|sec|second|seconds|s|"
+        r"min|minute|minutes|hour|hours|h)?", s)
+    if not m:
+        raise CompileError(
+            f"@app:playback {role}: cannot parse time '{s}'")
+    n = int(m.group(1))
+    unit = m.group(2) or "ms"
+    mult = {"millisecond": 1, "milliseconds": 1, "ms": 1,
+            "sec": 1000, "second": 1000, "seconds": 1000, "s": 1000,
+            "min": 60_000, "minute": 60_000, "minutes": 60_000,
+            "hour": 3_600_000, "hours": 3_600_000, "h": 3_600_000}[unit]
+    return n * mult
 
 
 def _ms(v, name) -> int:

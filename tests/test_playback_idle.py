@@ -1,0 +1,43 @@
+"""@app:playback(idle.time, increment): the virtual clock auto-advances
+by `increment` whenever sources stay idle for `idle.time` of WALL time
+(SiddhiAppParser.java:171-210 wiring
+EventTimeBasedMillisTimestampGenerator; PlaybackTestCase playbackTest3).
+"""
+import time
+
+import pytest
+
+from siddhi_tpu import Event, QueryCallback, SiddhiManager
+from siddhi_tpu.ops.expr import CompileError
+
+
+def test_idle_advance_fires_time_batch():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:playback(idle.time = '100 millisecond', increment = '2 sec')
+        define stream S (symbol string, price float);
+        @info(name='q') from S#window.timeBatch(2 sec, 0)
+        select symbol, sum(price) as total insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", QueryCallback(
+        fn=lambda ts, i, r: got.extend(tuple(e.data) for e in (i or []))))
+    rt.start()
+    rt.get_input_handler("S").send(Event(0, ("IBM", 700.0)))
+    # no further events: the idle watcher must advance the clock past the
+    # 2s boundary and flush the batch
+    deadline = time.time() + 5
+    while not got and time.time() < deadline:
+        time.sleep(0.05)
+    rt.shutdown()
+    assert got == [("IBM", 700.0)]
+
+
+def test_idle_time_without_increment_rejected():
+    mgr = SiddhiManager()
+    with pytest.raises(CompileError):
+        mgr.create_siddhi_app_runtime("""
+            @app:playback(idle.time = '100 millisecond')
+            define stream S (a int);
+            from S select a insert into Out;
+        """)
