@@ -83,6 +83,10 @@ class NfaStateSpec:
     viol_push: bool = False     # absent start: a violating event re-arms
     # the deadline to ev_ts + waiting_ms instead of killing the row
     # (AbsentStreamPostStateProcessor.process:55 updateLastArrivalTime)
+    viol_latch: bool = False    # no-`for` absent in an every-start group:
+    # a violation latches the lane DEAD; the partner's next fill fails
+    # and re-initializes a fresh group (partnerCanProceed every-branch:
+    # lastArrivalTime reset + init())
     min_count: int = 1
     max_count: int = 1          # -1 == unbounded
     # logical and/or groups (LogicalPreStateProcessor.java:33): both sides
@@ -139,19 +143,39 @@ class NfaCompiler:
             start.always_armed = True
         else:
             start.armed_once = True
-            # pattern-start absents: a violating event pushes the deadline
-            # (the scheduler re-creates the pending and fires at the pushed
-            # lastScheduledTime — AbsentStreamPreStateProcessor.process:
-            # 163-179 initialize, :216-223 reschedule); exception: absent
-            # sides paired with a PRESENT partner die on violation
-            # (AbsentLogicalPreStateProcessor.partnerCanProceed:352-386)
-            group = [start] + ([self.states[start.partner]]
-                               if start.partner >= 0 else [])
-            for st in group:
+            # pattern-start standalone absents: a violating event pushes
+            # the deadline (the scheduler re-creates the pending and fires
+            # at the pushed lastScheduledTime —
+            # AbsentStreamPreStateProcessor.process:163-179 initialize,
+            # :216-223 reschedule)
+            if start.is_absent and start.waiting_ms > 0 \
+                    and start.partner < 0:
+                start.viol_push = True
+        if self.state_type != "sequence":
+            # `X and not Y for t` absent sides in patterns never die on a
+            # violation — it only pushes lastArrivalTime, delaying the
+            # satisfied-marker fire (AbsentLogicalPreStateProcessor
+            # .processAndReturn has no remove-on-stateChanged branch;
+            # LogicalAbsent testQueryAbsent10 pins the late completion).
+            # OR lanes and double-absent lanes DIE on violation instead
+            # (testQueryAbsent30/32/46 pin the killed lane).
+            for st in self.states:
+                if st.is_absent and st.partner < 0:
+                    continue
                 if st.is_absent and st.waiting_ms > 0:
-                    p = self.states[st.partner] if st.partner >= 0 else None
-                    if p is None or p.is_absent or st.logical_op == "or":
+                    p = self.states[st.partner]
+                    # ...but a group in FINAL position removes on
+                    # violation (the absent's post IS thisLastProcessor,
+                    # so isEventReturned triggers the remove —
+                    # EveryAbsent testQueryAbsent46 pins the kill)
+                    if st.logical_op == "and" and not p.is_absent and \
+                            self.states[st.anchor].next_idx != -1:
                         st.viol_push = True
+                elif st.is_absent and st.waiting_ms == 0:
+                    p = self.states[st.partner]
+                    if st.logical_op == "and" and not p.is_absent and \
+                            every_start and st.is_start:
+                        st.viol_latch = True
         # single-state every scopes collapse re-arm into always_armed
         for st in self.states:
             if st.is_start and any(
@@ -206,14 +230,12 @@ class NfaCompiler:
                                if start.partner >= 0 else [])
             for st in group:
                 if st.is_absent and st.waiting_ms > 0:
-                    p = self.states[st.partner] if st.partner >= 0 else None
-                    partner_present = p is not None and not p.is_absent \
-                        and st.logical_op == "and"
-                    # sequence non-every: violation latches lastArrivalTime
-                    # and initialize is suppressed -> permanent kill
-                    # (AbsentStreamPreStateProcessor.process:166-170);
-                    # every-scoped starts push instead
-                    st.viol_push = every_start and not partner_present
+                    # sequence logical-absent violations remove the event
+                    # from BOTH pending lists (processAndReturn SEQUENCE
+                    # branch) -> kill; standalone non-every starts latch
+                    # permanently (initialize suppressed); standalone
+                    # every starts push
+                    st.viol_push = every_start and st.partner < 0
 
     def _single_state_scope(self, start) -> bool:
         return any(s.every_arm == start.idx and s.idx == start.idx
@@ -669,17 +691,51 @@ class NfaEngine:
 
             mature = live & (table["born"] < counter)
 
-            # within expiry (any valid event advances observed time)
+            # within expiry (any valid event advances observed time).
+            # Rows expiring inside an `every` scope RE-ARM it
+            # (StreamPreStateProcessor.expireEvents ->
+            # withinEveryPreStateProcessor.addEveryState), except when
+            # the row's own state is the re-arm target (it would just
+            # recreate the same expired wait)
+            within_rearm = jnp.zeros((M,), jnp.bool_)
+            within_arm_tgt = jnp.full((M,), -1, jnp.int32)
+            within_clear = jnp.zeros((M,), jnp.int32)
             if self.within_ms is not None:
                 expired = (mature & table["has_ts0"] &
                            (jnp.abs(ev_ts - table["ts0"]) > self.within_ms)
                            & ev_valid)
                 live = live & ~expired
                 mature = mature & live
+                if any(st.every_arm >= 0 for st in self.states):
+                    arm_of, clear_of = self._scope_arm_tables()
+                    stc = jnp.clip(table["state"], 0, len(self.states))
+                    r_arm = jnp.asarray(arm_of)[stc]
+                    within_rearm = expired & (r_arm >= 0) & \
+                        (r_arm != table["state"])
+                    within_arm_tgt = r_arm
+                    within_clear = jnp.asarray(clear_of)[stc]
+                    # stabilize order: the re-armed clone is created
+                    # BEFORE the event is processed (expireEvents runs in
+                    # stabilizeStates), so THIS event can start the fresh
+                    # attempt (WithinPatternTestCase testQuery4)
+                    table = {**table, "valid": live}
+                    table = self._append_rows(
+                        table,
+                        [("wrearm", within_rearm, within_arm_tgt,
+                          within_clear)],
+                        counter - 1)
+                    within_rearm = jnp.zeros((M,), jnp.bool_)
+                    live = table["valid"]
+                    mature = live & (table["born"] < counter)
 
             is_current = ev_valid & (ev_kind == CURRENT)
 
             matched_any = jnp.zeros((M,), jnp.bool_)
+            # a row completed through one OR side is consumed: the
+            # partner side must not also fill it on the SAME event
+            # (the reference removes it from both pendings on completion;
+            # LogicalPatternTestCase testQuery3 pins e3 staying null)
+            or_taken = jnp.zeros((M,), jnp.bool_)
             rearm_target = jnp.full((M,), -1, jnp.int32)
             rearm_clear = jnp.zeros((M,), jnp.int32)
             out_rows = jnp.zeros((M,), jnp.bool_)
@@ -715,6 +771,8 @@ class NfaEngine:
                         (table["min_at"] < counter))
                 at_state = (normal | persona) & is_current
                 hit = at_state & cond_ok
+                if st.logical_op == "or":
+                    hit = hit & ~or_taken
 
                 if st.is_absent:
                     # a matching event violates the absence. For 'and'
@@ -737,6 +795,14 @@ class NfaEngine:
                         viol = hit & (my_dl >= 0)
                     else:
                         viol = hit
+                    if st.viol_latch:
+                        # latch the lane DEAD; the partner's next fill
+                        # fails and re-initializes a fresh group
+                        if st.dl_field:
+                            dl2 = jnp.where(viol, DEAD, dl2)
+                        else:
+                            dl1 = jnp.where(viol, DEAD, dl1)
+                        continue
                     if st.viol_push and st.waiting_ms > 0:
                         kill = jnp.zeros_like(viol)
                         pushed = ev_ts + np.int64(st.waiting_ms)
@@ -836,6 +902,7 @@ class NfaEngine:
                         p = self.states[st.partner]
                         if st.logical_op == "or":
                             complete = hit  # either side completes an OR
+                            or_taken = or_taken | complete
                         elif p.is_absent and p.waiting_ms > 0:
                             # 'X and not Y for t': completes only once the
                             # deadline passed (pre-pass handles the fill-
@@ -843,8 +910,31 @@ class NfaEngine:
                             pdl = dl2 if p.dl_field else dl1
                             complete = hit & (pdl < ev_ts)
                         elif p.is_absent:
-                            complete = hit   # 'X and not Y': Y would have
-                            # killed the row already
+                            # 'X and not Y': Y would have killed the row
+                            # already — except latched lanes (DEAD): the
+                            # fill FAILS and a fresh group re-initializes
+                            # (partnerCanProceed every-branch)
+                            pdl = dl2 if p.dl_field else dl1
+                            if p.viol_latch:
+                                blocked_latch = hit & (pdl == DEAD)
+                                complete = hit & (pdl != DEAD)
+                                new_valid = jnp.where(blocked_latch,
+                                                      False, new_valid)
+                                arm0 = st.every_arm if st.every_arm >= 0 \
+                                    else self.states[st.anchor].every_arm
+                                if arm0 >= 0:
+                                    cl0 = st.clear_from \
+                                        if st.every_arm >= 0 \
+                                        else self.states[
+                                            st.anchor].clear_from
+                                    rearm_target = jnp.where(
+                                        blocked_latch, jnp.int32(arm0),
+                                        rearm_target)
+                                    rearm_clear = jnp.where(
+                                        blocked_latch, jnp.int32(cl0),
+                                        rearm_clear)
+                            else:
+                                complete = hit
                         else:  # and, both present: partner slot filled?
                             pf = slots_upd[p.slot]["n"] > 0
                             complete = hit & pf
@@ -902,7 +992,8 @@ class NfaEngine:
                       "min_at": new_min_at, "deadline": dl1,
                       "deadline2": dl2, "born": born}
 
-            # every re-arms (cleared clones, born=now)
+            # every re-arms (cleared clones, born=now); within-expiry
+            # re-arms were already appended during stabilize above
             do_rearm = (rearm_target >= 0) & is_current
             table2 = self._append_rows(
                 table2, [("rearm", do_rearm, rearm_target, rearm_clear)],
@@ -984,6 +1075,28 @@ class NfaEngine:
         rearm_target = jnp.full((M,), -1, jnp.int32)
         rearm_clear = jnp.zeros((M,), jnp.int32)
         rearm_dl = jnp.full((M,), POS_INF, jnp.int64)
+        rearm_dl2 = jnp.full((M,), POS_INF, jnp.int64)
+        orfwd = jnp.zeros((M,), jnp.bool_)
+        orfwd_target = jnp.full((M,), -1, jnp.int32)
+
+        if self.within_ms is not None:
+            # scheduler fires prune within-expired pendings BEFORE
+            # collecting (AbsentStreamPreStateProcessor.process isExpired
+            # loop); re-arm the enclosing every scope unless the row's own
+            # state is the re-arm target (nextEvery != this)
+            wexp = live & active & table["has_ts0"] & \
+                (jnp.abs(now_ts - table["ts0"]) > self.within_ms)
+            live = live & ~wexp
+            new_valid = jnp.where(wexp, False, new_valid)
+            if any(st.every_arm >= 0 for st in self.states):
+                arm_of, clear_of = self._scope_arm_tables()
+                stc = jnp.clip(table["state"], 0, len(self.states))
+                r_arm = jnp.asarray(arm_of)[stc]
+                rearmw = wexp & (r_arm >= 0) & (r_arm != table["state"])
+                rearm_target = jnp.where(rearmw, r_arm, rearm_target)
+                rearm_clear = jnp.where(rearmw,
+                                        jnp.asarray(clear_of)[stc],
+                                        rearm_clear)
 
         def lane_passed(dl):
             armed = dl >= 0   # -1 satisfied / -2 or-side dead never fire
@@ -1026,10 +1139,54 @@ class NfaEngine:
                     deadline2 = jnp.where(
                         base & lane_passed(deadline2) & ~ok1,
                         jnp.int64(-1), deadline2)
-                elif p_state.is_absent:
-                    # 'not A for t OR not B for t': first lane to pass
-                    # completes the group
-                    pass
+                elif p_state.is_absent and st.logical_op == "or":
+                    # 'not A for t OR not B for t': EACH lane's deadline
+                    # completes the group INDEPENDENTLY (each side's
+                    # processor fires its own pending — the corpus pins
+                    # two emissions per cycle, LogicalAbsent testQuery
+                    # Absent47). The row survives until both lanes fired;
+                    # the every re-arm happens once, at the second fire.
+                    fire = rows
+                    if self.state_type == "sequence":
+                        # sequence addState dedup: the second lane's fire
+                        # is consumed when the first already forwarded
+                        # (newAndEveryStateEventList if-empty)
+                        fire = fire & ~orfwd & ~out_rows
+                    other_dl = deadline if st.dl_field else deadline2
+                    if anchor.next_idx == -1:
+                        out_rows = out_rows | fire
+                    else:
+                        orfwd = orfwd | fire
+                        orfwd_target = jnp.where(
+                            fire, jnp.int32(anchor.next_idx),
+                            orfwd_target)
+                    # ALL passing rows mark the lane satisfied — a
+                    # dedup-suppressed fire must not re-offer its
+                    # deadline forever (timer livelock)
+                    if st.dl_field:
+                        deadline2 = jnp.where(rows, jnp.int64(-1),
+                                              deadline2)
+                    else:
+                        deadline = jnp.where(rows, jnp.int64(-1),
+                                             deadline)
+                    both_done = rows & (other_dl < 0)
+                    new_valid = jnp.where(both_done, False, new_valid)
+                    arm = st.every_arm if st.every_arm >= 0 \
+                        else anchor.every_arm
+                    if arm >= 0:
+                        clear = st.clear_from if st.every_arm >= 0 \
+                            else anchor.clear_from
+                        rearm_target = jnp.where(both_done,
+                                                 jnp.int32(arm),
+                                                 rearm_target)
+                        rearm_clear = jnp.where(both_done,
+                                                jnp.int32(clear),
+                                                rearm_clear)
+                        w_next = int(self._wait_of[arm])
+                        if w_next > 0:
+                            rearm_dl = jnp.where(
+                                both_done, my_dl + w_next, rearm_dl)
+                    continue
                 elif st.logical_op == "or":
                     # 'A or not B for t': the deadline side can complete
                     # the group on its own (partner slot left null)
@@ -1080,8 +1237,21 @@ class NfaEngine:
                                         rearm_clear)
                 w_next = int(self._wait_of[arm])
                 if w_next > 0:
-                    rearm_dl = jnp.where(rows, table["deadline"] + w_next,
-                                         rearm_dl)
+                    # cadence base: the lane's own deadline when still
+                    # armed, else the fire instant (satisfied lanes of
+                    # double-absent groups carry -1)
+                    base1 = jnp.where(table["deadline"] >= 0,
+                                      table["deadline"], now_ts)
+                    rearm_dl = jnp.where(rows, base1 + w_next, rearm_dl)
+                w2_next = int(self._wait2_of[arm])
+                if w2_next > 0:
+                    # double-absent groups re-arm BOTH lanes
+                    # (AbsentLogicalPreStateProcessor reschedules each
+                    # side; advisor r4 finding)
+                    base2 = jnp.where(table["deadline2"] >= 0,
+                                      table["deadline2"], now_ts)
+                    rearm_dl2 = jnp.where(rows, base2 + w2_next,
+                                          rearm_dl2)
         # emission timestamp = the lane that fired (min armed deadline)
         d1 = jnp.where(table["deadline"] >= 0, table["deadline"], POS_INF)
         d2 = jnp.where(table["deadline2"] >= 0, table["deadline2"],
@@ -1096,15 +1266,28 @@ class NfaEngine:
         table = {**table, "state": new_state, "valid": new_valid,
                  "deadline": deadline, "deadline2": deadline2,
                  "born": born}
-        if self._absent_rearms:
-            do_rearm = rearm_target >= 0
+        if self._absent_rearms or (
+                self.within_ms is not None
+                and any(st.every_arm >= 0 for st in self.states)):
             # born = counter-1: the deadline fired BETWEEN events (the
             # reference's scheduler), so the re-armed clone must be
             # visible to the very next event — e.g. a Stream3 arrival
             # right after the fire kills the new waiter
             table = self._append_rows(
-                table, [("rearm", do_rearm, rearm_target, rearm_clear)],
-                table["counter"] - 1, deadline_src=rearm_dl)
+                table, [("rearm", rearm_target >= 0, rearm_target,
+                         rearm_clear)],
+                table["counter"] - 1, deadline_src=rearm_dl,
+                deadline2_src=rearm_dl2)
+        if any(st.is_absent and st.logical_op == "or" and st.partner >= 0
+               and self.states[st.partner].is_absent
+               for st in self.states):
+            # or-double-absent lane fires forward CLONES (slots kept,
+            # no absent deadline); the original row waits for its
+            # other lane
+            keep_all = jnp.full((M,), len(self.slots), jnp.int32)
+            table = self._append_rows(
+                table, [("orfwd", orfwd, orfwd_target, keep_all)],
+                table["counter"] - 1)
         return table, out
 
     def make_timer_step(self):
@@ -1143,8 +1326,25 @@ class NfaEngine:
             table["deadline2"], POS_INF))
         return jnp.minimum(d1, d2)
 
+    def _scope_arm_tables(self):
+        """Per-state [len+1] tables: the enclosing every scope's re-arm
+        entry and clear-from slot (the reference wires
+        withinEveryPreStateProcessor into EVERY state of the scope, so a
+        within-expiry ANYWHERE in the scope re-arms its start)."""
+        n = len(self.states)
+        arm_of = np.full((n + 1,), -1, np.int32)
+        clear_of = np.zeros((n + 1,), np.int32)
+        for x in self.states:
+            if x.every_arm >= 0:
+                for s in self.states:
+                    if x.every_arm <= s.idx <= x.idx:
+                        arm_of[s.idx] = x.every_arm
+                        clear_of[s.idx] = x.clear_from
+        return arm_of, clear_of
+
     # -- helpers ---------------------------------------------------------
-    def _append_rows(self, table, appends, counter, deadline_src=None):
+    def _append_rows(self, table, appends, counter, deadline_src=None,
+                     deadline2_src=None):
         """Place append-candidate rows into free table slots."""
         M = self.M
         free = ~table["valid"]
@@ -1166,14 +1366,16 @@ class NfaEngine:
             dest = jnp.where(ok, dest, M)  # M => dropped
             out_table = self._scatter_append(
                 out_table, table, dest, ok, target_state, clear_from,
-                counter, deadline_src=deadline_src)
+                counter, deadline_src=deadline_src,
+                deadline2_src=deadline2_src)
             k = k + jnp.sum(mask.astype(jnp.int32))
         out_table = {**out_table,
                      "overflow": out_table["overflow"] + total_lost}
         return out_table
 
     def _scatter_append(self, table, src_table, dest, ok, target_state,
-                        clear_from, counter, deadline_src=None):
+                        clear_from, counter, deadline_src=None,
+                        deadline2_src=None):
         """Copy source rows (with slots >= clear_from cleared) into dest
         positions as fresh pendings."""
         M = self.M
@@ -1184,9 +1386,10 @@ class NfaEngine:
         min_at = table["min_at"].at[d].set(jnp.int64(-1), mode="drop")
         dl_vals = jnp.asarray(POS_INF) if deadline_src is None \
             else deadline_src
+        dl2_vals = jnp.asarray(POS_INF) if deadline2_src is None \
+            else deadline2_src
         deadline = table["deadline"].at[d].set(dl_vals, mode="drop")
-        deadline2 = table["deadline2"].at[d].set(jnp.asarray(POS_INF),
-                                                 mode="drop")
+        deadline2 = table["deadline2"].at[d].set(dl2_vals, mode="drop")
         table = {**table, "min_at": min_at, "deadline": deadline,
                  "deadline2": deadline2}
         seq = table["seq"].at[d].set(

@@ -116,6 +116,11 @@ def _split_args(s: str) -> list[str]:
 
 def extract_case(name: str, body: str, rel: str, line_no: int):
     reasons = []
+    # disabled tests never run in the reference — their expectations are
+    # not ground truth (e.g. LogicalAbsentPatternTestCase
+    # testQueryAbsent48 `enabled = false`)
+    if re.search(r"@Test\s*\([^)]*enabled\s*=\s*false", body):
+        return None, "test disabled (enabled = false)"
     # validation tests: @Test(expectedExceptions = SiddhiAppCreation...)
     # expect app creation to FAIL — replayed as expect_error cases
     expect_error = bool(re.search(
