@@ -56,10 +56,14 @@ from .types import AttrType
 BATCH_BUCKETS = (16, 128, 1024, 8192, 65536, 262144, 1048576)
 
 # step capacity cap for queries containing sort-heavy operators (windows,
-# aggregations, order-by): XLA TPU sort compile time grows superlinearly
-# with row count (i64 lexsort: ~5s at 8192 rows, ~66s at 65536), so those
-# steps run over split chunks of this size instead of one huge batch
-SORT_HEAVY_CAP = 8192
+# aggregations, order-by). With the int32 sort keys everywhere (see
+# ops/windows.py _rel32 / emission_sort) a 65536-row sort step compiles
+# in ~18 s (vs ~6 s at 8192) and runs at the same events/s — so plain
+# queries take the full bucket (1M events = 16 steps); the K-vmapped
+# partition blocks keep the smaller cap (compile is multiplied by the
+# slot axis there)
+SORT_HEAVY_CAP = 65536
+PARTITION_SORT_HEAVY_CAP = 8192
 
 WINDOW_CLASSES = {
     "time": TimeWindowOp,
@@ -285,6 +289,11 @@ class QueryRuntime(Receiver):
         self._host_sched = [op.host_schedule for op in operators
                             if getattr(op, "host_schedule", None)]
         self._sched_due: Optional[int] = None
+        # clock of the latest EVENT step (timers due at or before it are
+        # subsumed by in-step expiry — see _schedule)
+        self._last_now = -(2 ** 62)
+        self._skip_past_dues = not any(
+            getattr(op, "needs_catchup", False) for op in operators)
         self.rate_limiter = None
         self._qstats = None  # lazily created when statistics enabled
 
@@ -421,6 +430,7 @@ class QueryRuntime(Receiver):
 
     def process_packed(self, chunk: PackedChunk) -> None:
         lat = self._stats_mark(chunk.n)
+        self._last_now = max(self._last_now, chunk.last_ts)
         with self._lock:
             step = self._packed_step_for(chunk.enc, chunk.capacity)
             with self._table_locks():
@@ -570,6 +580,7 @@ class QueryRuntime(Receiver):
         if now is None:
             now = self.app.current_time()
         lat = self._stats_lat()
+        self._last_now = max(self._last_now, int(now))
         now_dev = jnp.asarray(now, dtype=jnp.int64)
         with self._lock:
             step = self._step_for(batch.capacity)
@@ -700,6 +711,14 @@ class QueryRuntime(Receiver):
     # -- timers ----------------------------------------------------------
     def _schedule(self, due: int) -> None:
         if due >= int(POS_INF):
+            return
+        if due <= self._last_now and self._skip_past_dues:
+            # the event step that produced this due already processed
+            # expiry/flush work up to its own clock — firing a timer for
+            # an instant the step covered is a pure no-op dispatch
+            # (windows expire at exact per-row points in-step). Ops that
+            # genuinely need per-boundary catch-up (hopping) opt out via
+            # needs_catchup.
             return
         if self._sched_due is not None and self._sched_due <= due:
             return
@@ -902,6 +921,7 @@ class PatternQueryRuntime(QueryRuntime):
 
     def process_pattern_packed(self, stream_id: str,
                                chunk: PackedChunk) -> None:
+        self._last_now = max(self._last_now, chunk.last_ts)
         with self._lock:
             step = self._step_for_stream(stream_id,
                                          (chunk.enc, chunk.capacity))
@@ -929,7 +949,9 @@ class PatternQueryRuntime(QueryRuntime):
             for sub in self.split_batch(batch, cap):
                 self.process_pattern_batch(stream_id, sub, timestamp)
             return
-        now = jnp.asarray(self.app.current_time(), dtype=jnp.int64)
+        now_host = self.app.current_time()
+        self._last_now = max(self._last_now, int(now_host))
+        now = jnp.asarray(now_host, dtype=jnp.int64)
         with self._lock:
             step = self._step_for_stream(stream_id)
             with self._table_locks():
@@ -1071,6 +1093,15 @@ class JoinQueryRuntime(QueryRuntime):
                             tstates[opp_table.table_id])
                     else:
                         opp_buf = opp_window.findable_buffer(opp_states[-1])
+                        if isinstance(opp_window, TimeWindowOp):
+                            # the opposite side may not have stepped since
+                            # the clock advanced: mask rows its window
+                            # would already have expired (keeps the
+                            # columnar span-skip of intermediate timer
+                            # fires bit-equal on join probes)
+                            fresh = opp_buf["ts"] + opp_window.T > now
+                            opp_buf = {**opp_buf,
+                                       "valid": opp_buf["valid"] & fresh}
                     joined, lost = cross.cross(batch, opp_buf)
                 else:
                     cap = 16
@@ -1128,6 +1159,7 @@ class JoinQueryRuntime(QueryRuntime):
 
     def process_side_packed(self, side: str, chunk: PackedChunk) -> None:
         opp = "R" if side == "L" else "L"
+        self._last_now = max(self._last_now, chunk.last_ts)
         with self._lock:
             step = self._step_for_side(side, (chunk.enc, chunk.capacity))
             with self._table_locks():
@@ -1165,6 +1197,7 @@ class JoinQueryRuntime(QueryRuntime):
                 self.process_side_batch(side, sub, timestamp, now=now,
                                         skip_due=skip_due)
             return
+        self._last_now = max(self._last_now, int(timestamp))
         if now is None:
             now = self.app.current_time()
         now_dev = jnp.asarray(now, dtype=jnp.int64)
@@ -1328,6 +1361,26 @@ class SiddhiAppRuntime:
             self._playback_time = last_ts
             self._last_ingest_wall = time.monotonic()
             self.scheduler.advance_to(last_ts)
+
+    def on_ingest_span(self, first_ts: int, last_ts: int) -> None:
+        """Columnar-chunk variant: fire only timers due STRICTLY BEFORE
+        the chunk's span, then advance the clock to its end. In-span
+        window expiry happens inside the chunk's own jitted step (exact
+        per-row expiry points), so pre-firing intermediate timers would
+        only add tunnel dispatches; the caller runs a catch-up
+        advance_to(last_ts) after publishing."""
+        self._resolve_dues()
+        if self._playback:
+            if self._unarmed_patterns:
+                pats, self._unarmed_patterns = self._unarmed_patterns, []
+                for q in pats:
+                    q.arm_start_deadlines(first_ts)
+            if not self._cron_armed:
+                self._cron_armed = True
+                self._arm_cron(first_ts - 1)
+            self.scheduler.advance_to(first_ts - 1)
+            self._playback_time = last_ts
+            self._last_ingest_wall = time.monotonic()
 
     def _arm_cron(self, base_ms: int) -> None:
         for q in self.queries.values():

@@ -340,7 +340,12 @@ class InputHandler:
             c = [col[start:start + max_cap] for col in cols]
             last_ts = int(t[-1])
             with self.app.barrier:
-                self.app.on_ingest_ts(last_ts, int(t[0]))
+                # columnar fast path: fire only dues STRICTLY BEFORE
+                # the chunk's span now — in-span window expiry happens
+                # inside the chunk's own step at exact per-row points, so
+                # firing intermediate timers first only adds dispatches
+                # (the post-publish advance_to below catches up the rest)
+                self.app.on_ingest_span(int(t[0]), last_ts)
                 if packed_ok:
                     if self._encoder is None:
                         self._encoder = PackedEncoder(self.junction.schema)
@@ -355,7 +360,9 @@ class InputHandler:
                         capacity=bucket_capacity(len(t)))
                     self.junction.publish_batch(batch, last_ts)
                 if self.app._playback:
-                    # fire timers the chunk's own event-time jump armed
+                    # catch up timers the chunk's own steps did not
+                    # subsume (multi-boundary batch flushes, absent
+                    # deadlines past the span)
                     self.app.scheduler.advance_to(last_ts)
 
 

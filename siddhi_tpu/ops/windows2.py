@@ -1489,6 +1489,9 @@ class HoppingWindowOp(WindowOp):
 
     kind_name = "hopping"
     is_batch = True
+    # hop boundaries coalesce if past dues are skipped — this op flushes
+    # one hop per step and relies on timer catch-up (see runtime._schedule)
+    needs_catchup = True
 
     def __init__(self, schema, window_ms: int, hop_ms: int,
                  cap: int = 4096, expired_enabled: bool = True):

@@ -285,7 +285,8 @@ class PartitionBlockRuntime:
         self._has_timers = {p.name: p.has_timers() for p in plans}
         # the slot-vmap multiplies every per-step sort by K — cap harder
         # (see runtime.py SORT_HEAVY_CAP)
-        from ..core.runtime import SORT_HEAVY_CAP
+        from ..core.runtime import PARTITION_SORT_HEAVY_CAP \
+            as SORT_HEAVY_CAP
         self.max_step_capacity = SORT_HEAVY_CAP if any(
             getattr(op, "sort_heavy", False)
             for p in plans for op in p.operators) else None
