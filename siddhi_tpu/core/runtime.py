@@ -45,7 +45,7 @@ from ..ops.windows2 import (BatchWindowOp, CronWindowOp, DelayWindowOp,
                             TimeLengthWindowOp)
 from ..ops.windows import (NEG_INF, POS_INF, LengthBatchWindowOp, LengthWindowOp,
                            TimeBatchWindowOp, TimeWindowOp, WindowOp)
-from .event import (CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema,
+from .event import (CURRENT, EXPIRED, TIMER, Attribute, EventBatch, StreamSchema,
                     batch_from_rows, rows_from_batch)
 from .ingest import PackedChunk, unpack_buffer
 from .scheduler import Scheduler
@@ -712,7 +712,8 @@ class QueryRuntime(Receiver):
     def _schedule(self, due: int) -> None:
         if due >= int(POS_INF):
             return
-        if due <= self._last_now and self._skip_past_dues:
+        if due <= self._last_now and self._skip_past_dues \
+                and self.app._columnar:
             # the event step that produced this due already processed
             # expiry/flush work up to its own clock — firing a timer for
             # an instant the step covered is a pure no-op dispatch
@@ -1080,6 +1081,9 @@ class JoinQueryRuntime(QueryRuntime):
             has_timers = self._has_timers
 
             opp_table = self.side_tables.get(opp)
+            # captured at compile time: columnar apps coalesce timer
+            # fires, so crosses gate pairs by opposite-row liveness
+            gate_alive = self.app._columnar
 
             def step(my_states, opp_states, sel_states, tstates, batch,
                      now):
@@ -1093,16 +1097,8 @@ class JoinQueryRuntime(QueryRuntime):
                             tstates[opp_table.table_id])
                     else:
                         opp_buf = opp_window.findable_buffer(opp_states[-1])
-                        if isinstance(opp_window, TimeWindowOp):
-                            # the opposite side may not have stepped since
-                            # the clock advanced: mask rows its window
-                            # would already have expired (keeps the
-                            # columnar span-skip of intermediate timer
-                            # fires bit-equal on join probes)
-                            fresh = opp_buf["ts"] + opp_window.T > now
-                            opp_buf = {**opp_buf,
-                                       "valid": opp_buf["valid"] & fresh}
-                    joined, lost = cross.cross(batch, opp_buf)
+                    joined, lost = cross.cross(batch, opp_buf,
+                                               gate_alive=gate_alive)
                 else:
                     cap = 16
                     sch = combined_schema("#j", self.in_schemas["L"],
@@ -1197,7 +1193,11 @@ class JoinQueryRuntime(QueryRuntime):
                 self.process_side_batch(side, sub, timestamp, now=now,
                                         skip_due=skip_due)
             return
-        self._last_now = max(self._last_now, int(timestamp))
+        if batch.kind is not None and not bool(np.any(
+                np.asarray(batch.kind) == TIMER)):
+            # only EVENT steps advance the due-subsumption clock — timer
+            # fires must not suppress their own follow-up dues
+            self._last_now = max(self._last_now, int(timestamp))
         if now is None:
             now = self.app.current_time()
         now_dev = jnp.asarray(now, dtype=jnp.int64)
@@ -1280,6 +1280,10 @@ class SiddhiAppRuntime:
         self.running = False
         self._playback = False
         self._playback_time: Optional[int] = None
+        # set once columnar ingest (send_arrays) is used: timer dues
+        # subsumed by event steps are then skipped (_schedule) — the
+        # row path keeps per-boundary timer fidelity
+        self._columnar = False
         # @app:playback(idle.time, increment): auto-advance parameters
         self._playback_idle_ms: Optional[int] = None
         self._playback_increment_ms: Optional[int] = None
@@ -2666,14 +2670,21 @@ class Planner:
         jschema = combined_schema(target, l_schema, r_schema)
         crosses = {"L": None, "R": None}
         join_cap = cap_pairs or 1024
+        def _win_ms(ops):
+            if ops and isinstance(ops[-1], TimeWindowOp):
+                return ops[-1].T
+            return None
+
         if jin.unidirectional != "right" and not l_is_table:
             crosses["L"] = JoinCross(True, l_schema, r_schema, jin.on,
                                      side_scope, jin.join_type,
-                                     join_cap=join_cap)
+                                     join_cap=join_cap,
+                                     opp_window_ms=_win_ms(r_ops))
         if jin.unidirectional != "left" and not r_is_table:
             crosses["R"] = JoinCross(False, l_schema, r_schema, jin.on,
                                      side_scope, jin.join_type,
-                                     join_cap=join_cap)
+                                     join_cap=join_cap,
+                                     opp_window_ms=_win_ms(l_ops))
 
         sel_scope = JoinCombinedScope(side_scope, len(l_schema.types))
         if needs_agg:

@@ -313,6 +313,7 @@ class InputHandler:
         n = len(ts)
         if n == 0:
             return
+        self.app._columnar = True
         packed_ok = all(getattr(r, "supports_packed", False)
                         for r in self.junction.receivers)
         max_cap = BATCH_BUCKETS[-1]

@@ -103,8 +103,15 @@ class JoinCross:
     def __init__(self, trigger_is_left: bool, left_schema: StreamSchema,
                  right_schema: StreamSchema, on: Optional[A.Expression],
                  side_scope: JoinSideScope, join_type: str,
-                 join_cap: int = 1024):
+                 join_cap: int = 1024,
+                 opp_window_ms: Optional[int] = None):
         self.trigger_is_left = trigger_is_left
+        # opposite side is a sliding TIME window: a pair is valid only if
+        # the opposite row was still alive AT THE TRIGGER ROW'S TIME
+        # (coalesced timer steps may leave already-expired rows in the
+        # not-yet-stepped opposite buffer; per-row gating keeps the
+        # rm-pair emission bit-equal with per-boundary timer fires)
+        self.opp_window_ms = opp_window_ms
         self.left_schema = left_schema
         self.right_schema = right_schema
         self.join_type = join_type
@@ -120,7 +127,8 @@ class JoinCross:
             or (join_type == "left_outer" and trigger_is_left)
             or (join_type == "right_outer" and not trigger_is_left))
 
-    def cross(self, trig: EventBatch, opp_buf: dict) -> EventBatch:
+    def cross(self, trig: EventBatch, opp_buf: dict,
+              gate_alive: bool = False) -> EventBatch:
         """trig: trigger window output [B]; opp_buf: opposite window buffer
         dict (ts/seq/cols/nulls/valid, rows in seq order)."""
         B = trig.capacity
@@ -153,6 +161,16 @@ class JoinCross:
         joinable = trig.valid & ((trig.kind == CURRENT) |
                                  (trig.kind == EXPIRED))
         pair = grid & joinable[:, None] & opp_buf["valid"][None, :]
+        if gate_alive and self.opp_window_ms is not None:
+            # columnar mode only: timer fires coalesce, so the opposite
+            # buffer may hold rows its own (skipped) expiry would have
+            # removed — gate pairs on the opposite row being alive at
+            # the trigger's timestamp. The row path fires per boundary
+            # and needs no gate (the reference pairs expiring rows with
+            # the opposite content AT the fire).
+            alive = (opp_buf["ts"][None, :] + self.opp_window_ms
+                     >= trig.ts[:, None])
+            pair = pair & alive
         matched_any = jnp.any(pair, axis=1)
         lone = joinable & ~matched_any if self.outer else \
             jnp.zeros((B,), jnp.bool_)

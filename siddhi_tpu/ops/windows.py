@@ -177,6 +177,11 @@ class WindowOp(Operator):
 
     is_batch = False
     sort_heavy = True  # emission_sort / keep_newest lexsorts
+    # default: timers must catch up boundary-by-boundary (batch windows
+    # flush ONE boundary per step). Sliding windows whose expiry is
+    # computed per-row inside the event step opt out — their past dues
+    # are pure no-op dispatches (runtime._schedule skip).
+    needs_catchup = True
     # expiry order == arrival order (time/length/... windows expire the
     # oldest content first); sliding min/max relies on this. Windows that
     # expel by comparator or frequency set it False.
@@ -221,6 +226,8 @@ class TimeWindowOp(WindowOp):
     """#window.time(T): retain each event T ms; on expiry re-emit as EXPIRED
     with its timestamp rewritten to the expiry-observation time, interleaved
     before the triggering current event (TimeWindowProcessor.java:141-161)."""
+
+    needs_catchup = False  # per-row in-step expiry covers past dues
 
     kind_name = "time"
 

@@ -66,6 +66,8 @@ class ExternalTimeWindowOp(WindowOp):
     value and it is emitted before the triggering event
     (ExternalTimeWindowProcessor.java:129-158). No wall-clock timers."""
 
+    needs_catchup = False  # per-row in-step expiry covers past dues
+
     kind_name = "externalTime"
 
     def __init__(self, schema, ts_idx: int, duration_ms: int,
@@ -130,6 +132,8 @@ class TimeLengthWindowOp(WindowOp):
     count. Buffered rows past T expire at the head of the step (ts=now);
     an arrival finding L live rows evicts the oldest (ts=now), emitted
     before it (TimeLengthWindowProcessor.java:143-189)."""
+
+    needs_catchup = False  # per-row in-step expiry covers past dues
 
     kind_name = "timeLength"
 
