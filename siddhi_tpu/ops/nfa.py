@@ -230,12 +230,18 @@ class NfaCompiler:
                                if start.partner >= 0 else [])
             for st in group:
                 if st.is_absent and st.waiting_ms > 0:
-                    # sequence logical-absent violations remove the event
-                    # from BOTH pending lists (processAndReturn SEQUENCE
-                    # branch) -> kill; standalone non-every starts latch
+                    # standalone non-every sequence starts latch
                     # permanently (initialize suppressed); standalone
-                    # every starts push
-                    st.viol_push = every_start and st.partner < 0
+                    # every starts push; `X and not Y for t` lanes in
+                    # NON-final position push exactly like patterns (no
+                    # remove-on-stateChanged)
+                    if st.partner < 0:
+                        st.viol_push = every_start
+                    else:
+                        p = self.states[st.partner]
+                        st.viol_push = (
+                            st.logical_op == "and" and not p.is_absent
+                            and self.states[st.anchor].next_idx != -1)
 
     def _single_state_scope(self, start) -> bool:
         return any(s.every_arm == start.idx and s.idx == start.idx
@@ -677,8 +683,24 @@ class NfaEngine:
                         nr = table["slots"][p.slot]["n"] > 0
                         exempt = exempt | (
                             (table["state"] == st.anchor) & (nl ^ nr))
+                        if st.is_absent or p.is_absent:
+                            # a satisfied absent lane (-1 marker) means
+                            # the fire already removed the event from the
+                            # absent side's list — sizes differ, reset
+                            # skips (the present partner may still fill)
+                            lane = table["deadline2"]                                 if (st.dl_field or
+                                    (p.is_absent and p.dl_field))                                 else table["deadline"]
+                            exempt = exempt | (
+                                (table["state"] == st.anchor) &
+                                (lane == -1))
                     if st.is_counting:
                         exempt = exempt | (table["state"] == st.idx)
+                    if st.rearm_each_round:
+                        # every-start groups re-initialize per round:
+                        # keeping the (empty) pending preserves the
+                        # processor-level deadline cadence the respawn
+                        # would lose
+                        exempt = exempt | (table["state"] == st.anchor)
                 live = live & ~(stale & ~exempt)
                 table = {**table, "valid": live}
                 # every-scoped sequence starts re-initialize an empty
@@ -812,7 +834,8 @@ class NfaEngine:
                             dl1 = jnp.where(viol, pushed, dl1)
                     else:
                         kill = viol
-                    if st.logical_op == "or" and not seq:
+                    grp_final = self.states[st.anchor].next_idx == -1
+                    if st.logical_op == "or" and not (seq and grp_final):
                         p = self.states[st.partner]
                         if st.dl_field:
                             dl2 = jnp.where(kill, DEAD, dl2)
@@ -824,10 +847,11 @@ class NfaEngine:
                             new_valid = jnp.where(both_dead, False,
                                                   new_valid)
                     else:
-                        # sequence: a violation removes the event from
-                        # BOTH sides' pending lists — the whole group
-                        # dies (AbsentLogicalPreStateProcessor
-                        # .processAndReturn SEQUENCE partner remove)
+                        # final-position sequence groups: the violation's
+                        # isEventReturned remove clears BOTH pending
+                        # lists — the whole group dies
+                        # (AbsentLogicalPreStateProcessor.processAndReturn
+                        # SEQUENCE partner remove)
                         new_valid = jnp.where(kill, False, new_valid)
                     if seq and st.partner >= 0:
                         # AbsentLogicalPreStateProcessor.processAndReturn
