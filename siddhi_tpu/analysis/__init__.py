@@ -1,0 +1,22 @@
+"""Static analysis for the siddhi_tpu codebase and its query plans.
+
+Two independent analyzers live here:
+
+- the **TPU-hygiene linter** (`lint_paths` / `tools/lint.py`): pure
+  Python-AST rules enforcing the JAX dispatch/tracing invariants the
+  runtime depends on (see docs/tpu_hygiene.md) — no target code is ever
+  imported;
+- the **query-plan validator** (`validate_app` / `check_app`): semantic
+  checks over `lang/ast.py` SiddhiApp plans, invoked by
+  `lang.parser.parse` so bad plans fail at compile time.
+"""
+from .findings import ERROR, WARNING, Finding
+from .linter import ModuleContext, lint_file, lint_paths, lint_source
+from .registry import all_rules, get_rule, rule_names
+from . import jax_rules  # noqa: F401  (registers the TPU/JAX rules)
+
+__all__ = [
+    "ERROR", "WARNING", "Finding", "ModuleContext",
+    "lint_file", "lint_paths", "lint_source",
+    "all_rules", "get_rule", "rule_names",
+]

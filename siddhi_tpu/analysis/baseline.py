@@ -1,0 +1,62 @@
+"""Checked-in lint baseline: grandfathered findings.
+
+The baseline maps ``rule::path::message`` -> occurrence count. Keys skip
+line numbers on purpose — unrelated edits must not resurrect a
+grandfathered finding — so a file can carry N known instances of a
+pattern and the linter only fails when an N+1th appears (or a new file
+grows one). ``--update-baseline`` rewrites the file from the current
+findings; shrinking it over time is the point.
+"""
+from __future__ import annotations
+
+import collections
+import json
+from typing import Iterable
+
+from .findings import Finding
+
+VERSION = 1
+
+
+def load(path: str) -> dict[str, int]:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except FileNotFoundError:
+        return {}
+    if data.get("version") != VERSION:
+        raise ValueError(f"unsupported baseline version in {path}")
+    return {k: int(v) for k, v in data.get("findings", {}).items()}
+
+
+def save(path: str, findings: Iterable[Finding]) -> None:
+    counts = collections.Counter(f.baseline_key() for f in findings)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": VERSION,
+                   "findings": dict(sorted(counts.items()))},
+                  fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+def filter_new(findings: list[Finding],
+               baseline: dict[str, int]) -> tuple[list[Finding], int]:
+    """Split findings into (new, n_baselined). Within one key, the first
+    `count` occurrences (in line order) are considered grandfathered."""
+    seen: collections.Counter = collections.Counter()
+    fresh: list[Finding] = []
+    baselined = 0
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.col)):
+        key = f.baseline_key()
+        if seen[key] < baseline.get(key, 0):
+            seen[key] += 1
+            baselined += 1
+        else:
+            fresh.append(f)
+    return fresh, baselined
+
+
+def stale_keys(findings: list[Finding],
+               baseline: dict[str, int]) -> list[str]:
+    """Baseline entries no longer matched by any finding (prune these)."""
+    counts = collections.Counter(f.baseline_key() for f in findings)
+    return sorted(k for k, n in baseline.items() if counts[k] < n)

@@ -1,0 +1,81 @@
+"""Command-line driver behind tools/lint.py.
+
+Exit codes: 0 clean (or everything baselined), 1 new findings,
+2 usage/configuration error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional
+
+from . import baseline as baseline_mod
+from .linter import lint_paths
+from .registry import all_rules
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="lint.py",
+        description="TPU-hygiene linter for the siddhi_tpu codebase")
+    p.add_argument("paths", nargs="*", default=["siddhi_tpu"],
+                   help="files/directories to lint (default: siddhi_tpu)")
+    p.add_argument("--root", default=None,
+                   help="directory findings paths are made relative to "
+                        "(default: cwd)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON of grandfathered findings")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline; report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings")
+    p.add_argument("--rule", action="append", dest="rules", default=None,
+                   help="run only this rule (repeatable)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the summary line")
+    return p
+
+
+def main(argv: Optional[list[str]] = None,
+         stdout=None) -> int:
+    out = stdout or sys.stdout
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.name:24} {r.severity:8} {r.rationale}", file=out)
+        return 0
+
+    findings = lint_paths(args.paths, root=args.root, rules=args.rules)
+
+    if args.update_baseline:
+        if not args.baseline:
+            print("--update-baseline requires --baseline PATH", file=out)
+            return 2
+        baseline_mod.save(args.baseline, findings)
+        if not args.quiet:
+            print(f"baseline updated: {len(findings)} finding(s) -> "
+                  f"{args.baseline}", file=out)
+        return 0
+
+    bl = {}
+    if args.baseline and not args.no_baseline:
+        try:
+            bl = baseline_mod.load(args.baseline)
+        except ValueError as e:
+            print(str(e), file=out)
+            return 2
+    fresh, n_baselined = baseline_mod.filter_new(findings, bl)
+
+    for f in fresh:
+        print(f.render(), file=out)
+    stale = baseline_mod.stale_keys(findings, bl)
+    if stale and not args.quiet:
+        for k in stale:
+            print(f"stale baseline entry (prune it): {k}", file=out)
+    if not args.quiet:
+        print(f"{len(fresh)} new finding(s), {n_baselined} baselined, "
+              f"{len(stale)} stale baseline entr(ies)", file=out)
+    return 1 if fresh else 0

@@ -1,0 +1,314 @@
+"""TPU/JAX hygiene lint rules.
+
+Static versions of the invariants this codebase already paid to learn
+(see docs/tpu_hygiene.md and tests/test_dispatch_hygiene.py):
+
+- a module-level jax array captured as a constant by a jitted step knocks
+  the process off the fast dispatch path (~2.4 ms added to EVERY
+  dispatch, measured on TPU v5-lite);
+- host syncs (``jax.device_get``, ``.item()``, ``int()``/``float()`` on
+  device values) inside Python loops serialize the device pipeline once
+  per iteration instead of once per batch;
+- Python control flow on traced values inside ``@jax.jit`` bodies either
+  crashes at trace time or silently forces a concretization;
+- Python scalars feeding shapes and non-hashable static args recompile
+  the step per distinct value;
+- explicit float64 dtypes flip on x64 promotion for the whole program.
+
+Every rule reports ``file:line`` anchors and can be silenced with
+``# lint: disable=<rule>`` or grandfathered via the checked-in baseline.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from .findings import ERROR, WARNING, Finding
+from .linter import ModuleContext
+from .registry import register
+
+# jnp constructors whose result is a device array when called outside jit
+# (dtype scalar constructors included: jnp.int64(0) is a device scalar)
+_JNP = ("jax", "numpy")
+_SHAPE_FNS = {"zeros", "ones", "empty", "full", "arange", "eye"}
+
+
+def _finding(rule, severity, ctx, node, message) -> Finding:
+    return Finding(rule=rule, severity=severity, path=ctx.path,
+                   line=getattr(node, "lineno", 1),
+                   col=getattr(node, "col_offset", 0), message=message)
+
+
+def _runs_at_import(ctx: ModuleContext, node: ast.AST) -> bool:
+    """True when `node` executes at import time: module body, class body,
+    module-level ifs — and def-time positions (defaults, decorators)."""
+    prev = node
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if prev in anc.body:
+                return False
+        elif isinstance(anc, ast.Lambda):
+            if prev is anc.body:
+                return False
+        prev = anc
+    return True
+
+
+def _mentions_jax(ctx: ModuleContext, node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)):
+            c = ctx.canon(sub)
+            if c and c[0] == "jax":
+                return True
+    return False
+
+
+def _param_names(fn_node) -> set[str]:
+    if isinstance(fn_node, ast.Lambda):
+        a = fn_node.args
+    else:
+        a = fn_node.args
+    names = {x.arg for x in a.posonlyargs + a.args + a.kwonlyargs}
+    if a.vararg:
+        names.add(a.vararg.arg)
+    if a.kwarg:
+        names.add(a.kwarg.arg)
+    return names
+
+
+def _mentions_any_name(node: ast.AST, names: set[str]) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id in names
+               for sub in ast.walk(node))
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is best-effort
+        return "<expr>"
+
+
+# ---------------------------------------------------------------------
+# rule: module-device-array
+# ---------------------------------------------------------------------
+
+
+@register(
+    "module-device-array", ERROR,
+    "a module-level jax array captured by a jitted step adds ~2.4 ms to "
+    "every subsequent dispatch; module constants must be numpy")
+def module_device_array(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        c = ctx.canon(node.func)
+        if c is None:
+            continue
+        makes_array = (c[:2] == _JNP and len(c) > 2) \
+            or c == ("jax", "device_put")
+        if makes_array and _runs_at_import(ctx, node):
+            yield _finding(
+                "module-device-array", ERROR, ctx, node,
+                f"'{'.'.join(c)}(...)' at import time creates a device "
+                "array; use a numpy constant so jitted steps embed it as "
+                "an HLO literal (module-level jax arrays poison the "
+                "dispatch fast path)")
+
+
+# ---------------------------------------------------------------------
+# rule: host-sync-in-loop
+# ---------------------------------------------------------------------
+
+
+def _host_sync_reason(ctx: ModuleContext, call: ast.Call):
+    """Classify a call as a device->host sync, or return None."""
+    c = ctx.canon(call.func)
+    if c == ("jax", "device_get"):
+        return "jax.device_get"
+    if isinstance(call.func, ast.Attribute) and call.func.attr == "item" \
+            and not call.args and not call.keywords:
+        return f"{_src(call.func.value)}.item()"
+    if c in (("numpy", "asarray"), ("numpy", "array")) and call.args \
+            and _mentions_jax(ctx, call.args[0]):
+        return f"np.{c[-1]} on a jax value"
+    if isinstance(call.func, ast.Name) and call.func.id in ("int", "float") \
+            and call.func.id not in ctx.alias_map and call.args \
+            and _mentions_jax(ctx, call.args[0]):
+        return f"{call.func.id}() on a jax value"
+    return None
+
+
+@register(
+    "host-sync-in-loop", WARNING,
+    "a device->host transfer inside a Python loop blocks the dispatch "
+    "pipeline once per iteration; batch the transfers into one "
+    "jax.device_get over a pytree")
+def host_sync_in_loop(ctx: ModuleContext) -> Iterator[Finding]:
+    flagged: dict[int, str] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        reason = _host_sync_reason(ctx, node)
+        if reason and ctx.in_loop(node):
+            flagged[id(node)] = reason
+    for node in ast.walk(ctx.tree):
+        if id(node) not in flagged:
+            continue
+        # `int(jax.device_get(x))` is ONE sync: report the outermost call
+        if any(id(anc) in flagged for anc in ctx.ancestors(node)):
+            continue
+        yield _finding(
+            "host-sync-in-loop", WARNING, ctx, node,
+            f"host sync '{flagged[id(node)]}' inside a loop — hoist it "
+            "out or batch the values into a single jax.device_get pytree "
+            "transfer")
+
+
+# ---------------------------------------------------------------------
+# rule: host-sync-in-jit
+# ---------------------------------------------------------------------
+
+
+@register(
+    "host-sync-in-jit", ERROR,
+    "device_get/.item()/int()/float() inside a jit-compiled body forces "
+    "a concretization: trace-time failure or a silent host round-trip")
+def host_sync_in_jit(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = ctx.enclosing_jitted_function(node)
+        if fn is None:
+            continue
+        c = ctx.canon(node.func)
+        reason = None
+        if c == ("jax", "device_get"):
+            reason = "jax.device_get"
+        elif isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "item" \
+                and not node.args and not node.keywords:
+            reason = f"{_src(node.func.value)}.item()"
+        elif isinstance(node.func, ast.Name) \
+                and node.func.id in ("int", "float") \
+                and node.func.id not in ctx.alias_map \
+                and node.args and not isinstance(node.args[0], ast.Constant):
+            reason = f"{node.func.id}({_src(node.args[0])})"
+        elif c in (("numpy", "asarray"), ("numpy", "array")) and node.args \
+                and (_mentions_jax(ctx, node.args[0])
+                     or _mentions_any_name(node.args[0], _param_names(fn))):
+            reason = f"np.{c[-1]} on a traced value"
+        if reason:
+            yield _finding(
+                "host-sync-in-jit", ERROR, ctx, node,
+                f"'{reason}' inside a jit-compiled function — this "
+                "concretizes a tracer (trace error) or forces a host "
+                "round-trip on every call")
+
+
+# ---------------------------------------------------------------------
+# rule: traced-branch-in-jit
+# ---------------------------------------------------------------------
+
+
+@register(
+    "traced-branch-in-jit", ERROR,
+    "Python if/while on a traced value inside @jax.jit leaks the tracer; "
+    "use jnp.where / jax.lax.cond / jax.lax.while_loop")
+def traced_branch_in_jit(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        if ctx.enclosing_jitted_function(node) is None:
+            continue
+        # a jax-rooted call in the test is a definite tracer boolean
+        leaky = any(isinstance(sub, ast.Call)
+                    and (ctx.canon(sub.func) or ("",))[0] == "jax"
+                    for sub in ast.walk(node.test))
+        if leaky:
+            kw = "if" if isinstance(node, ast.If) else "while"
+            yield _finding(
+                "traced-branch-in-jit", ERROR, ctx, node,
+                f"Python '{kw} {_src(node.test)}:' inside a jit-compiled "
+                "function branches on a traced value — use jnp.where / "
+                "jax.lax.cond / jax.lax.while_loop")
+
+
+# ---------------------------------------------------------------------
+# rule: recompile-hazard
+# ---------------------------------------------------------------------
+
+
+@register(
+    "recompile-hazard", WARNING,
+    "Python scalars feeding shapes and non-hashable static args trigger "
+    "a fresh XLA compile per distinct value")
+def recompile_hazard(ctx: ModuleContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            fn = ctx.enclosing_jitted_function(node)
+            if fn is None:
+                continue
+            c = ctx.canon(node.func)
+            # a BARE param in shape position is the hazard; x.shape/x.ndim
+            # of a traced arg is static metadata and fine
+            bare_param = node.args and any(
+                isinstance(sub, ast.Name)
+                and sub.id in _param_names(fn)
+                and not isinstance(ctx.parent(sub), ast.Attribute)
+                for sub in ast.walk(node.args[0]))
+            if c and c[:2] == _JNP and len(c) == 3 \
+                    and c[2] in _SHAPE_FNS and bare_param:
+                yield _finding(
+                    "recompile-hazard", WARNING, ctx, node,
+                    f"parameter-dependent shape '{_src(node.args[0])}' in "
+                    f"jnp.{c[2]} inside a jit-compiled function — each "
+                    "distinct value recompiles the step (or fails to "
+                    "trace); pass shapes via closure or static config")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and ctx.is_jitted(node):
+            defaults = list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]
+            for d in defaults:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    yield _finding(
+                        "recompile-hazard", WARNING, ctx, d,
+                        f"mutable default '{_src(d)}' on jit-compiled "
+                        f"'{node.name}' — non-hashable static args defeat "
+                        "the jit cache and recompile per call")
+
+
+# ---------------------------------------------------------------------
+# rule: float64-literal
+# ---------------------------------------------------------------------
+
+
+@register(
+    "float64-literal", WARNING,
+    "an explicit float64 dtype in device code depends on x64 mode and "
+    "doubles memory/ALU cost on TPU; prefer float32 or jnp.float_")
+def float64_literal(ctx: ModuleContext) -> Iterator[Finding]:
+    f64 = (("jax", "numpy", "float64"), ("numpy", "float64"))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        c = ctx.canon(node.func)
+        if c == ("jax", "numpy", "float64"):
+            yield _finding(
+                "float64-literal", WARNING, ctx, node,
+                "jnp.float64(...) literal promotes to x64 — on TPU this "
+                "needs jax_enable_x64 and runs at half throughput")
+            continue
+        if not (c and c[0] == "jax"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "dtype":
+                continue
+            kc = ctx.canon(kw.value)
+            is_f64 = kc in f64 or (isinstance(kw.value, ast.Constant)
+                                   and kw.value.value == "float64")
+            if is_f64:
+                yield _finding(
+                    "float64-literal", WARNING, ctx, kw.value,
+                    f"dtype=float64 in {'.'.join(c)}(...) triggers x64 "
+                    "promotion — use float32 (or gate behind an explicit "
+                    "x64 config) on TPU")

@@ -1,0 +1,252 @@
+"""AST lint driver: parse a module once, hand every rule a ModuleContext.
+
+The context pre-computes everything the TPU-hygiene rules keep asking
+for — canonical dotted names across import aliases (``jnp.zeros`` /
+``jax.numpy.zeros`` / ``from jax import numpy as jnp`` all normalize to
+``("jax", "numpy", "zeros")``), a child->parent map, which functions are
+jit-compiled, and which source lines carry ``# lint: disable=`` pragmas
+— so individual rules stay ~20 lines of pattern matching.
+
+Suppressions:
+  ``# lint: disable=rule-a,rule-b``   suppress those rules on this line
+  ``# lint: disable=*``               suppress everything on this line
+  ``# lint: disable-file=rule-a``     suppress a rule for the whole file
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Iterator, Optional
+
+from .findings import ERROR, Finding
+from .registry import all_rules
+
+_PRAGMA = re.compile(r"#\s*lint:\s*disable(?P<scope>-file)?\s*=\s*"
+                     r"(?P<rules>[\w*,\- ]+)")
+
+_LOOPS = (ast.For, ast.AsyncFor, ast.While)
+_COMPS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _dotted(node: ast.AST) -> Optional[tuple[str, ...]]:
+    """Name/Attribute chain -> ("a", "b", "c") for a.b.c, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+class ModuleContext:
+    def __init__(self, path: str, source: str, rel_path: Optional[str] = None):
+        self.path = rel_path or path
+        self.source = source
+        self.tree = ast.parse(source, filename=path)
+        self.lines = source.splitlines()
+        self.parents: dict[int, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[id(child)] = parent
+        self.alias_map = self._build_alias_map()
+        self.line_disables, self.file_disables = self._scan_pragmas()
+        self._jitted = self._find_jitted_functions()
+
+    # -- imports / canonical names ------------------------------------
+    def _build_alias_map(self) -> dict[str, tuple[str, ...]]:
+        amap: dict[str, tuple[str, ...]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    parts = tuple(a.name.split("."))
+                    if a.asname:
+                        amap[a.asname] = parts
+                    else:
+                        # `import jax.numpy` binds only the root name
+                        amap.setdefault(parts[0], (parts[0],))
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                base = tuple(node.module.split("."))
+                for a in node.names:
+                    amap[a.asname or a.name] = base + (a.name,)
+        return amap
+
+    def canon(self, node: ast.AST) -> Optional[tuple[str, ...]]:
+        """Canonical dotted name of a Name/Attribute chain, resolving
+        import aliases (jnp.x -> ("jax","numpy","x"))."""
+        d = _dotted(node)
+        if d is None:
+            return None
+        head = self.alias_map.get(d[0])
+        return head + d[1:] if head else d
+
+    # -- pragmas -------------------------------------------------------
+    def _scan_pragmas(self):
+        line_dis: dict[int, set[str]] = {}
+        file_dis: set[str] = set()
+        for i, text in enumerate(self.lines, start=1):
+            m = _PRAGMA.search(text)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+            if m.group("scope"):
+                file_dis |= rules
+            else:
+                line_dis.setdefault(i, set()).update(rules)
+        return line_dis, file_dis
+
+    def suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self.file_disables or "*" in self.file_disables:
+            return True
+        dis = self.line_disables.get(finding.line, ())
+        return finding.rule in dis or "*" in dis
+
+    # -- structural helpers -------------------------------------------
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.parents.get(id(node))
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, _FUNCS):
+                return anc
+        return None
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """True when `node` re-executes per iteration of an enclosing
+        Python loop or comprehension within the same function body."""
+        prev = node
+        for anc in self.ancestors(node):
+            if isinstance(anc, _FUNCS):
+                return False
+            if isinstance(anc, ast.For) and prev is not anc.iter:
+                return True  # the For's own iterable runs once
+            if isinstance(anc, (ast.AsyncFor, ast.While)):
+                return True
+            if isinstance(anc, ast.comprehension):
+                comp = self.parent(anc)
+                first = getattr(comp, "generators", [None])[0]
+                if not (anc is first and prev is anc.iter):
+                    return True  # only the first source evaluates once
+            elif isinstance(anc, _COMPS):
+                if prev not in anc.generators:
+                    return True  # elt/key/value runs per iteration
+            prev = anc
+        return False
+
+    def at_module_scope(self, node: ast.AST) -> bool:
+        """Executed at import time (module body, incl. module-level ifs
+        and class bodies — anything outside a def/lambda)."""
+        return self.enclosing_function(node) is None
+
+    # -- jit detection -------------------------------------------------
+    def _is_jit_expr(self, node: ast.AST) -> bool:
+        c = self.canon(node)
+        if c == ("jax", "jit"):
+            return True
+        if isinstance(node, ast.Call):
+            fc = self.canon(node.func)
+            if fc == ("jax", "jit"):
+                return True
+            if fc == ("functools", "partial") and node.args \
+                    and self.canon(node.args[0]) == ("jax", "jit"):
+                return True
+        return False
+
+    def _find_jitted_functions(self) -> set[int]:
+        by_name: dict[str, list[ast.AST]] = {}
+        jitted: set[int] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, []).append(node)
+                if any(self._is_jit_expr(d) for d in node.decorator_list):
+                    jitted.add(id(node))
+        # `stepf = jax.jit(step)` style wrapping of a local function
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) \
+                    and self.canon(node.func) == ("jax", "jit"):
+                for arg in node.args[:1]:
+                    if isinstance(arg, ast.Name):
+                        for fn in by_name.get(arg.id, ()):
+                            jitted.add(id(fn))
+        return jitted
+
+    def is_jitted(self, fn_node: ast.AST) -> bool:
+        return id(fn_node) in self._jitted
+
+    def enclosing_jitted_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, _FUNCS) and self.is_jitted(anc):
+                return anc
+        return None
+
+
+# ---------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str = "<string>",
+                rel_path: Optional[str] = None,
+                rules: Optional[Iterable[str]] = None) -> list[Finding]:
+    try:
+        ctx = ModuleContext(path, source, rel_path=rel_path)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", severity=ERROR,
+                        path=rel_path or path, line=e.lineno or 1,
+                        col=e.offset or 0, message=f"syntax error: {e.msg}")]
+    wanted = set(rules) if rules is not None else None
+    out: list[Finding] = []
+    for rule in all_rules():
+        if wanted is not None and rule.name not in wanted:
+            continue
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def lint_file(path: str, rel_path: Optional[str] = None,
+              rules: Optional[Iterable[str]] = None) -> list[Finding]:
+    with open(path, encoding="utf-8") as fh:
+        return lint_source(fh.read(), path=path, rel_path=rel_path,
+                           rules=rules)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def lint_paths(paths: Iterable[str], root: Optional[str] = None,
+               rules: Optional[Iterable[str]] = None) -> list[Finding]:
+    """Lint every .py file under `paths`; paths in findings are made
+    relative to `root` (default: cwd) for stable baseline keys."""
+    base = os.path.abspath(root or os.getcwd())
+    out: list[Finding] = []
+    for path in iter_python_files(paths):
+        ap = os.path.abspath(path)
+        rel = os.path.relpath(ap, base)
+        rel = rel.replace(os.sep, "/")
+        out.extend(lint_file(ap, rel_path=rel, rules=rules))
+    return out

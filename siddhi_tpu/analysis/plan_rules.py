@@ -1,0 +1,341 @@
+"""Static query-plan validation over the SiddhiQL object model.
+
+Runs right after parsing (lang/parser.parse calls check_app) so broken
+plans fail with a `file-less` compile error naming the query and the
+construct, instead of surfacing later as an XLA shape error deep inside
+a jitted step. The checks mirror what the runtime planner would reject
+anyway — undefined streams, window/aggregator arity — plus dead-plan
+diagnostics (states that can never fire) the planner silently accepts.
+
+Severity model: ``error`` issues are definite planner rejections and
+make ``check_app`` raise CompileError; ``warning`` issues (dead states,
+constant-false filters, non-positive `within`) are advisory and only
+surfaced through ``validate_app``.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+from ..lang import ast as A
+
+ERROR = "error"
+WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanIssue:
+    code: str
+    severity: str
+    where: str       # query name / partition / definition anchor
+    message: str
+
+    def render(self) -> str:
+        return f"{self.where}: {self.severity} [{self.code}] {self.message}"
+
+
+# parameter-count envelopes for the built-in windows, mirroring
+# core/runtime.py make_window (min, max); max None == unbounded
+WINDOW_ARITY: dict[str, tuple[int, Optional[int]]] = {
+    "time": (1, 1), "length": (1, 1), "lengthbatch": (1, 2),
+    "hopping": (2, 2), "hoping": (2, 2), "timebatch": (1, 3),
+    "externaltimebatch": (2, 5), "externaltime": (2, 2),
+    "timelength": (2, 2), "delay": (1, 1), "batch": (0, 1),
+    "cron": (1, 1), "session": (1, 2), "sort": (1, None),
+    "frequent": (1, None), "lossyfrequent": (1, None),
+}
+
+# windows whose first parameter must be a stream attribute, not a constant
+_ATTR_FIRST_WINDOWS = {"externaltime", "externaltimebatch"}
+
+# aggregator arity over ops/selector.py AGGREGATOR_NAMES: (min, max)
+AGGREGATOR_ARITY: dict[str, tuple[int, int]] = {
+    "sum": (1, 1), "avg": (1, 1), "count": (0, 1),
+    "distinctcount": (1, 1), "min": (1, 1), "max": (1, 1),
+    "minforever": (1, 1), "maxforever": (1, 1), "stddev": (1, 1),
+    "and": (1, 1), "or": (1, 1), "unionset": (1, 1),
+}
+
+
+def _iter_exprs(e) -> Iterator[A.Expression]:
+    """Depth-first walk over an expression tree (dataclass fields)."""
+    if not isinstance(e, A.Expression):
+        return
+    yield e
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, A.Expression):
+            yield from _iter_exprs(v)
+        elif isinstance(v, list):
+            for item in v:
+                yield from _iter_exprs(item)
+
+
+def _iter_state_elements(el) -> Iterator[A.StateElement]:
+    if el is None:
+        return
+    yield el
+    if isinstance(el, A.NextStateElement):
+        yield from _iter_state_elements(el.state)
+        yield from _iter_state_elements(el.next)
+    elif isinstance(el, A.EveryStateElement):
+        yield from _iter_state_elements(el.state)
+    elif isinstance(el, A.LogicalStateElement):
+        yield from _iter_state_elements(el.left)
+        yield from _iter_state_elements(el.right)
+    elif isinstance(el, A.CountStateElement):
+        yield from _iter_state_elements(el.stream)
+
+
+def _state_streams(el) -> Iterator[A.SingleInputStream]:
+    for sub in _iter_state_elements(el):
+        if isinstance(sub, A.StreamStateElement) and sub.stream is not None:
+            yield sub.stream
+
+
+def _query_inputs(q: A.Query) -> Iterator[A.SingleInputStream]:
+    """Every SingleInputStream a query reads from (joins/patterns/anon
+    streams flattened)."""
+    inp = q.input
+    if isinstance(inp, A.SingleInputStream):
+        yield inp
+    elif isinstance(inp, A.JoinInputStream):
+        yield inp.left
+        yield inp.right
+    elif isinstance(inp, A.StateInputStream):
+        yield from _state_streams(inp.state)
+    elif isinstance(inp, A.AnonymousInputStream) and inp.query is not None:
+        yield from _query_inputs(inp.query)
+
+
+class PlanValidator:
+    def __init__(self, app: A.SiddhiApp):
+        self.app = app
+        self.issues: list[PlanIssue] = []
+        # every id events can be consumed from at app scope
+        self.defined: set[str] = set()
+        self.defined |= set(app.stream_definitions)
+        self.defined |= set(app.table_definitions)
+        self.defined |= set(app.window_definitions)
+        self.defined |= set(app.trigger_definitions)
+        self.defined |= set(app.aggregation_definitions)
+        # insert-into targets implicitly define streams (junction_for)
+        for q in self._all_queries():
+            out = q.output
+            if isinstance(out, A.InsertIntoStream) and not out.is_inner \
+                    and not out.is_fault:
+                self.defined.add(out.target)
+
+    def _all_queries(self) -> Iterator[A.Query]:
+        for el in self.app.execution_elements:
+            if isinstance(el, A.Query):
+                yield el
+            elif isinstance(el, A.Partition):
+                yield from el.queries
+
+    def add(self, code, severity, where, message):
+        self.issues.append(PlanIssue(code=code, severity=severity,
+                                     where=where, message=message))
+
+    # -- checks --------------------------------------------------------
+    def validate(self) -> list[PlanIssue]:
+        qn = 0
+        for el in self.app.execution_elements:
+            if isinstance(el, A.Query):
+                qn += 1
+                self.check_query(el, el.name or f"query{qn}",
+                                 inner_scope=None)
+            elif isinstance(el, A.Partition):
+                self.check_partition(el, f"partition{qn + 1}")
+                qn += len(el.queries)
+        return self.issues
+
+    def check_partition(self, part: A.Partition, pname: str):
+        for pt in part.partition_types:
+            if pt.stream_id not in self.defined:
+                self.add("undefined-stream", ERROR, pname,
+                         f"partition key references undefined stream "
+                         f"'{pt.stream_id}'")
+        # inner (#) streams live in the partition's own scope
+        inner = {q.output.target for q in part.queries
+                 if isinstance(q.output, A.InsertIntoStream)
+                 and q.output.is_inner}
+        for i, q in enumerate(part.queries):
+            self.check_query(q, q.name or f"{pname}.query{i + 1}",
+                             inner_scope=inner)
+
+    def check_query(self, q: A.Query, name: str,
+                    inner_scope: Optional[set]):
+        for sin in _query_inputs(q):
+            self.check_input_stream(sin, name, inner_scope)
+        if isinstance(q.input, A.StateInputStream):
+            self.check_state_machine(q.input, name)
+        if isinstance(q.input, A.AnonymousInputStream) \
+                and q.input.query is not None:
+            iq = q.input.query
+            if isinstance(iq.input, A.StateInputStream):
+                self.check_state_machine(iq.input, name)
+        self.check_selector(q.selector, name)
+        self.check_attributes(q, name)
+
+    def check_input_stream(self, sin: A.SingleInputStream, qname: str,
+                           inner_scope: Optional[set]):
+        sid = sin.stream_id
+        if sin.is_fault:
+            return  # !stream junctions materialize from @OnError wiring
+        if sin.is_inner:
+            if inner_scope is not None and sid not in inner_scope:
+                self.add("undefined-stream", ERROR, qname,
+                         f"inner stream '#{sid}' is never produced inside "
+                         "this partition")
+            return
+        if sid not in self.defined:
+            self.add("undefined-stream", ERROR, qname,
+                     f"undefined stream '{sid}' (not defined, not a "
+                     "table/window/trigger/aggregation, and no query "
+                     "inserts into it)")
+        for h in sin.handlers:
+            if isinstance(h, A.WindowHandler):
+                self.check_window(h, qname)
+            elif isinstance(h, A.Filter):
+                self.check_filter(h, qname)
+
+    def check_window(self, h: A.WindowHandler, qname: str):
+        if h.namespace is not None:
+            return  # namespaced -> extension lookup, arity unknown here
+        key = h.name.lower()
+        spec = WINDOW_ARITY.get(key)
+        if spec is None:
+            return  # unknown names resolve via extensions at plan time
+        lo, hi = spec
+        n = len(h.parameters)
+        if n < lo or (hi is not None and n > hi):
+            want = f"{lo}" if hi == lo else \
+                (f"{lo}+" if hi is None else f"{lo}-{hi}")
+            self.add("window-arity", ERROR, qname,
+                     f"window '{h.name}' takes {want} parameter(s), "
+                     f"got {n}")
+        elif key in _ATTR_FIRST_WINDOWS and h.parameters \
+                and not isinstance(h.parameters[0], A.Variable):
+            self.add("window-arity", ERROR, qname,
+                     f"window '{h.name}' first parameter must be a stream "
+                     "attribute (the event timestamp)")
+
+    def check_filter(self, h: A.Filter, qname: str):
+        e = h.expression
+        if isinstance(e, A.Constant) and e.value is False:
+            self.add("dead-filter", WARNING, qname,
+                     "filter condition is constant false — the query can "
+                     "never emit")
+
+    def check_selector(self, sel: A.Selector, qname: str):
+        for oa in sel.attributes:
+            self._check_agg_arity(oa.expression, qname)
+        if sel.having is not None:
+            self._check_agg_arity(sel.having, qname)
+
+    def _check_agg_arity(self, expr, qname: str):
+        for e in _iter_exprs(expr):
+            if not isinstance(e, A.AttributeFunction):
+                continue
+            if e.namespace is not None or e.star:
+                continue
+            spec = AGGREGATOR_ARITY.get(e.name.lower())
+            if spec is None:
+                continue
+            lo, hi = spec
+            n = len(e.parameters)
+            if n < lo or n > hi:
+                want = f"{lo}" if hi == lo else f"{lo}-{hi}"
+                self.add("aggregator-arity", ERROR, qname,
+                         f"aggregator '{e.name}' takes {want} "
+                         f"argument(s), got {n}")
+
+    def check_state_machine(self, sin: A.StateInputStream, qname: str):
+        if sin.within_ms is not None and sin.within_ms <= 0:
+            self.add("nonpositive-within", WARNING, qname,
+                     f"within {sin.within_ms} ms can never be satisfied")
+        for el in _iter_state_elements(sin.state):
+            if isinstance(el, A.CountStateElement):
+                mn, mx = el.min_count, el.max_count
+                if mx != -1 and mn > mx:
+                    self.add("dead-state", ERROR, qname,
+                             f"count state <{mn}:{mx}> can never fire "
+                             "(min > max)")
+                elif mx == 0 and mn == 0:
+                    self.add("dead-state", WARNING, qname,
+                             "count state <0:0> matches nothing — the "
+                             "state is vacuous")
+            if el.within_ms is not None and el.within_ms <= 0:
+                self.add("nonpositive-within", WARNING, qname,
+                         f"state within {el.within_ms} ms can never be "
+                         "satisfied")
+
+    # -- attribute resolution (conservative) ---------------------------
+    def check_attributes(self, q: A.Query, qname: str):
+        """Undefined-attribute check for plain single-stream queries.
+
+        Restricted to inputs whose schema is statically known (explicit
+        stream/table/window definition) with no schema-rewriting stream
+        functions in the chain; anything scoped more dynamically
+        (patterns, joins, aggregation refs) is left to the planner."""
+        sin = q.input
+        if not isinstance(sin, A.SingleInputStream) or sin.is_inner \
+                or sin.is_fault:
+            return
+        if any(isinstance(h, A.StreamFunction) for h in sin.handlers):
+            return
+        defn = self.app.stream_definitions.get(sin.stream_id) \
+            or self.app.table_definitions.get(sin.stream_id) \
+            or self.app.window_definitions.get(sin.stream_id)
+        if defn is None:
+            return
+        attrs = {a.name for a in defn.attributes}
+        table_ids = set(self.app.table_definitions)
+        own_refs = {sin.stream_id}
+        if sin.alias:
+            own_refs.add(sin.alias)
+
+        def scan(expr, where):
+            mentions_table = any(
+                isinstance(e, A.InTable)
+                or (isinstance(e, A.Variable) and e.stream_ref in table_ids)
+                for e in _iter_exprs(expr))
+            if mentions_table:
+                return  # table scopes resolve against the table schema
+            for e in _iter_exprs(expr):
+                if not isinstance(e, A.Variable):
+                    continue
+                if e.attribute is None or e.index is not None \
+                        or e.function_ref or e.is_inner or e.is_fault:
+                    continue
+                if e.attribute.startswith("__"):
+                    continue  # compiler-internal placeholders
+                if e.stream_ref is not None and e.stream_ref not in own_refs:
+                    continue  # cross-stream refs are planner territory
+                if e.attribute not in attrs:
+                    self.add("undefined-attribute", ERROR, qname,
+                             f"'{e.attribute}' is not an attribute of "
+                             f"stream '{sin.stream_id}' ({where})")
+
+        for h in sin.handlers:
+            if isinstance(h, A.Filter):
+                scan(h.expression, "filter")
+        if not q.selector.select_all:
+            for oa in q.selector.attributes:
+                scan(oa.expression, "select")
+        for g in q.selector.group_by:
+            scan(g, "group by")
+
+
+def validate_app(app: A.SiddhiApp) -> list[PlanIssue]:
+    """Run every plan check; returns all issues (errors + warnings)."""
+    return PlanValidator(app).validate()
+
+
+def check_app(app: A.SiddhiApp) -> None:
+    """Raise CompileError on error-severity plan issues (parser hook)."""
+    errors = [i for i in validate_app(app) if i.severity == ERROR]
+    if errors:
+        from ..ops.expr import CompileError
+        raise CompileError("; ".join(i.render() for i in errors))

@@ -1,0 +1,52 @@
+"""Lint rule registry.
+
+A rule is a callable ``(ModuleContext) -> Iterable[Finding]`` registered
+under a stable kebab-case id with a default severity and a one-line
+rationale (shown by ``tools/lint.py --list-rules`` and quoted in
+docs/tpu_hygiene.md). Rules are pure functions of the parsed module —
+no imports of the linted code ever happen.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterable, Iterator
+
+from .findings import SEVERITIES, Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    name: str
+    severity: str
+    rationale: str
+    check: Callable[["ModuleContext"], Iterable[Finding]]  # noqa: F821
+
+
+_RULES: dict[str, Rule] = {}
+
+
+def register(name: str, severity: str, rationale: str):
+    """Decorator: register a check function as a lint rule."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"bad severity {severity!r} for rule {name!r}")
+
+    def deco(fn):
+        if name in _RULES:
+            raise ValueError(f"duplicate rule {name!r}")
+        _RULES[name] = Rule(name=name, severity=severity,
+                            rationale=rationale, check=fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Iterator[Rule]:
+    return iter(sorted(_RULES.values(), key=lambda r: r.name))
+
+
+def get_rule(name: str) -> Rule:
+    return _RULES[name]
+
+
+def rule_names() -> set[str]:
+    return set(_RULES)

@@ -484,7 +484,8 @@ class QueryRuntime(Receiver):
                 if isinstance(op, WindowOp)]
         dues = [d for d in dues if d is not None]
         if dues:
-            self._schedule(int(min(int(jax.device_get(d)) for d in dues)))
+            # one transfer for all window dues, not one sync per window
+            self._schedule(min(int(d) for d in jax.device_get(dues)))
 
     def overflow_total(self) -> int:
         """Sum of overflow counters across operator states (windows etc.;
@@ -1035,8 +1036,8 @@ class JoinQueryRuntime(QueryRuntime):
     def overflow_total(self) -> int:
         """Selector + both side-chains' window overflow + join-cap drops."""
         total = super().overflow_total()
-        for states in self.side_states.values():
-            for st in jax.device_get(states):
+        for states in jax.device_get(self.side_states).values():
+            for st in states:
                 if isinstance(st, dict) and "overflow" in st:
                     total += int(st["overflow"])
         return total + self.overflow
@@ -1065,9 +1066,10 @@ class JoinQueryRuntime(QueryRuntime):
                 if isinstance(op, WindowOp):
                     d = op.next_due(st)
                     if d is not None:
-                        dues.append(int(jax.device_get(d)))
+                        dues.append(d)
         if dues:
-            self._schedule(min(dues))
+            # both sides' dues come back in one pytree transfer
+            self._schedule(min(int(d) for d in jax.device_get(dues)))
 
     def _step_for_side(self, side: str, packed_key=None) -> Callable:
         fn = self._side_steps.get((side, packed_key))
@@ -1343,8 +1345,11 @@ class SiddhiAppRuntime:
             return
         with self._due_lock:
             pending, self._due_pending = self._due_pending, []
-        for q, arr in pending:
-            q._schedule(int(jax.device_get(arr)))
+        # the copy_to_host_async above staged these; collect them in one
+        # transfer instead of a sync per queued due
+        dues = jax.device_get([arr for _, arr in pending])
+        for (q, _), due in zip(pending, dues):
+            q._schedule(int(due))
 
     def on_ingest_ts(self, last_ts: int,
                      first_ts: Optional[int] = None) -> None:
@@ -1452,6 +1457,9 @@ class SiddhiAppRuntime:
         (util/statistics trackers)."""
         from .stats import pytree_nbytes
         report = {}
+        states_host = jax.device_get(
+            {n: q.states for n, q in self.queries.items()
+             if hasattr(q, "states")})
         for n, q in self.queries.items():
             entry = dict(q.stats()) if hasattr(q, "stats") else {}
             qs = getattr(q, "_qstats", None)
@@ -1462,9 +1470,8 @@ class SiddhiAppRuntime:
                 lat = qs.latency.summary()
                 if lat is not None:
                     entry["latency"] = lat
-            if hasattr(q, "states"):
-                entry["state_bytes"] = pytree_nbytes(
-                    jax.device_get(q.states))
+            if n in states_host:
+                entry["state_bytes"] = pytree_nbytes(states_host[n])
             report[n] = entry
         for tid, rt in self.record_tables.items():
             if hasattr(rt, "cache_complete"):
@@ -1585,8 +1592,8 @@ class SiddhiAppRuntime:
                         if hasattr(q, "snapshot_state")},
             "windows": {n: w.snapshot_state()
                         for n, w in self.named_windows.items()},
-            "tables": {tid: jax.device_get(t.state)
-                       for tid, t in self.tables.items()},
+            "tables": jax.device_get(
+                {tid: t.state for tid, t in self.tables.items()}),
             "partitions": {n: b.snapshot_state()
                            for n, b in self.partitions.items()},
             "aggregations": {n: a.snapshot_state()

@@ -1125,8 +1125,20 @@ class Parser:
 # -------------------------------------------------------------------------- #
 
 
-def parse(text: str) -> A.SiddhiApp:
-    return Parser(update_variables(text)).parse_app()
+def parse(text: str, validate: bool = True) -> A.SiddhiApp:
+    """Parse a SiddhiQL app and statically validate the plan.
+
+    Validation (analysis/plan_rules.py) raises CompileError here — at
+    compile time, with the query name and construct — for plans the
+    runtime planner would otherwise reject later as shape errors deep
+    inside a jitted step: undefined streams, window/aggregator arity,
+    states that can never fire. ``validate=False`` skips it (the planner
+    still applies its own checks)."""
+    app = Parser(update_variables(text)).parse_app()
+    if validate:
+        from ..analysis.plan_rules import check_app
+        check_app(app)
+    return app
 
 
 def parse_query(text: str) -> A.Query:

@@ -489,8 +489,11 @@ class PartitionBlockRuntime:
                                      now_dev)
         for qn, out in flat_outs.items():
             self._dispatch(qn, out, timestamp)
-        for qn, due in dues.items():
-            self._schedule(qn, int(jax.device_get(due)))
+        if dues:
+            # one pytree transfer for every query's due, not one sync per
+            # query (docs/tpu_hygiene.md host-sync-in-loop)
+            for qn, due in jax.device_get(dues).items():
+                self._schedule(qn, int(due))
 
     def _dispatch(self, qname: str, out: EventBatch, timestamp: int):
         port = self.ports[qname]
@@ -567,17 +570,20 @@ class PartitionBlockRuntime:
 
     def reschedule(self) -> None:
         """Re-arm per-query timers from restored [K]-stacked states."""
+        per_plan: dict[str, list] = {}
         for p in self.plans:
             if not self._has_timers[p.name]:
                 continue
-            dues = []
             for op, st in zip(p.operators, self.qstates[p.name]):
                 if isinstance(op, WindowOp):
                     d = jax.vmap(op.next_due)(st)
                     if d is not None:
-                        dues.append(int(jax.device_get(jnp.min(d))))
-            if dues:
-                self._schedule(p.name, min(dues))
+                        per_plan.setdefault(p.name, []).append(jnp.min(d))
+        if per_plan:
+            # reductions stay on device; ONE pytree transfer re-arms every
+            # query instead of a per-window sync
+            for qn, ds in jax.device_get(per_plan).items():
+                self._schedule(qn, min(int(d) for d in ds))
 
     # -- introspection ----------------------------------------------------
     def overflow_total(self) -> int:

@@ -30,3 +30,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 assert len(jax.devices()) == 8, jax.devices()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running backstop tests, excluded from tier-1 "
+        "(-m 'not slow')")

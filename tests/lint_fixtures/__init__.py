@@ -1,0 +1,3 @@
+# Lint-rule fixtures: each module seeds exactly the antipattern its name
+# says. They are PARSED by the linter, never imported/executed — keep
+# them import-safe anyway (no side effects beyond the seeded pattern).

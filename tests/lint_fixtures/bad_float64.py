@@ -1,0 +1,20 @@
+"""Seeded antipattern: explicit float64 dtype (float64-literal)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_acc(n):
+    return jnp.zeros((n,), dtype=jnp.float64)     # line 7
+
+
+def make_lit(x):
+    return jnp.float64(x)                         # line 11
+
+
+def make_str(n):
+    return jnp.ones((n,), dtype="float64")        # line 15
+
+
+def fine(n):
+    # host-side numpy f64 and device f32 are both fine
+    return np.zeros((n,), dtype=np.float64), jnp.zeros((n,), jnp.float32)

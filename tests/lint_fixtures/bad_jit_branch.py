@@ -1,0 +1,24 @@
+"""Seeded antipattern: Python branch on traced value (traced-branch-in-jit)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def leaky(x):
+    if jnp.any(x > 0):           # line 8: tracer boolean in `if`
+        return x
+    return -x
+
+
+@jax.jit
+def leaky_while(x):
+    while jnp.sum(x) < 10:       # line 15: tracer boolean in `while`
+        x = x + 1
+    return x
+
+
+@jax.jit
+def fine(x, flag: bool):
+    if flag:                     # python static: fine
+        return jnp.where(x > 0, x, -x)
+    return x

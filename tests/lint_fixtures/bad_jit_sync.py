@@ -1,0 +1,21 @@
+"""Seeded antipattern: host syncs inside jitted bodies (host-sync-in-jit)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def decorated_step(x):
+    n = int(jnp.sum(x))          # line 8: concretizes a tracer
+    return x * n
+
+
+def wrapped_step(x):
+    return jax.device_get(x)     # line 13: sync inside jitted fn
+
+
+wrapped = jax.jit(wrapped_step)
+
+
+def fine_host_helper(x):
+    # not jitted anywhere: host code may sync freely
+    return jax.device_get(x)

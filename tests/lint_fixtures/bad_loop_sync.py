@@ -1,0 +1,33 @@
+"""Seeded antipattern: host syncs inside loops (host-sync-in-loop)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def drain(chunks):
+    total = 0
+    for c in chunks:
+        total += int(jax.device_get(jnp.sum(c)))   # line 10: sync per iter
+    return total
+
+
+def drain_comprehension(chunks):
+    return [np.asarray(jax.device_get(c)) for c in chunks]  # line 15
+
+
+def drain_items(state):
+    out = []
+    while state:
+        out.append(state.pop().item())             # line 21: .item() per iter
+    return out
+
+
+def fine_batched(chunks):
+    # ONE pytree transfer outside any loop: the blessed pattern
+    host = jax.device_get(list(chunks))
+    return sum(int(np.sum(c)) for c in host)
+
+
+def fine_first_source(dues):
+    # the first comprehension source evaluates once — not a loop sync
+    return {k: int(v) for k, v in jax.device_get(dues).items()}

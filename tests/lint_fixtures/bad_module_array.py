@@ -1,0 +1,16 @@
+"""Seeded antipattern: module-level jax array (module-device-array)."""
+import jax
+import jax.numpy as jnp
+
+GOOD_SCALAR = 3                      # plain python: fine
+BAD_CONST = jnp.zeros((4,))          # line 6: device array at import
+
+BAD_PUT = jax.device_put(1.0)        # line 8: device_put at import
+
+
+class Config:
+    BAD_CLASS_ATTR = jnp.int64(0)    # line 12: class body runs at import
+
+
+def fine():
+    return jnp.ones((2,))            # inside a function: fine

@@ -1,0 +1,18 @@
+"""Seeded antipattern: recompilation hazards (recompile-hazard)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def shape_from_param(n):
+    return jnp.zeros(n)          # line 8: param feeds a shape
+
+
+@jax.jit
+def mutable_static(x, opts=[]):  # line 12: non-hashable default
+    return x
+
+
+@jax.jit
+def fine(x):
+    return jnp.zeros(x.shape)    # shape from a traced arg's .shape: fine

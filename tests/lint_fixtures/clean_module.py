@@ -1,0 +1,20 @@
+"""Clean fixture: the blessed patterns — must produce ZERO findings."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# module constants as numpy: embed as HLO literals (ops/sentinels.py)
+NEG_INF = np.int64(-(2 ** 62))
+CAP = 4096
+
+
+@jax.jit
+def step(state, batch):
+    keep = batch > NEG_INF
+    return state + jnp.sum(jnp.where(keep, batch, 0)), keep
+
+
+def drain(states):
+    # single pytree transfer, loop over host values
+    host = jax.device_get(states)
+    return [int(s) for s in host]

@@ -1,0 +1,59 @@
+"""Tier-1 CI gate: the TPU-hygiene linter over the whole siddhi_tpu
+package must report ZERO findings beyond the checked-in baseline
+(tools/lint_baseline.json) — the pytest twin of `python tools/lint.py`.
+
+A failure here means a new TPU antipattern crept in: either fix it,
+suppress it inline with `# lint: disable=<rule>` + a justification, or
+(last resort) re-baseline via
+`python tools/lint.py --baseline tools/lint_baseline.json --update-baseline`.
+"""
+import io
+import os
+
+from siddhi_tpu.analysis import lint_paths
+from siddhi_tpu.analysis.baseline import filter_new, load
+from siddhi_tpu.analysis.cli import main as lint_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "siddhi_tpu")
+BASELINE = os.path.join(REPO, "tools", "lint_baseline.json")
+
+
+def test_package_lints_clean_vs_baseline():
+    findings = lint_paths([PKG], root=REPO)
+    fresh, _ = filter_new(findings, load(BASELINE))
+    assert not fresh, "new TPU-hygiene findings:\n" + "\n".join(
+        f.render() for f in fresh)
+
+
+def test_cli_gate_exits_zero():
+    out = io.StringIO()
+    rc = lint_main([PKG, "--root", REPO, "--baseline", BASELINE], stdout=out)
+    assert rc == 0, out.getvalue()
+
+
+def test_cli_exits_nonzero_on_new_finding(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax.numpy as jnp\nX = jnp.zeros((2,))\n")
+    out = io.StringIO()
+    rc = lint_main([str(bad), "--root", str(tmp_path),
+                    "--baseline", BASELINE], stdout=out)
+    assert rc == 1
+    assert "module-device-array" in out.getvalue()
+
+
+def test_baseline_grandfathers_then_catches_growth(tmp_path):
+    mod = tmp_path / "legacy.py"
+    mod.write_text("import jax.numpy as jnp\nX = jnp.zeros((2,))\n")
+    bl = tmp_path / "bl.json"
+    out = io.StringIO()
+    assert lint_main([str(mod), "--root", str(tmp_path), "--baseline",
+                      str(bl), "--update-baseline"], stdout=out) == 0
+    # grandfathered: gate passes
+    assert lint_main([str(mod), "--root", str(tmp_path),
+                      "--baseline", str(bl)], stdout=out) == 0
+    # an N+1th instance of the same pattern is a NEW finding
+    mod.write_text("import jax.numpy as jnp\nX = jnp.zeros((2,))\n"
+                   "Y = jnp.zeros((2,))\n")
+    assert lint_main([str(mod), "--root", str(tmp_path),
+                      "--baseline", str(bl)], stdout=out) == 1

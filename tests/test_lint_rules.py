@@ -1,0 +1,107 @@
+"""Per-rule linter tests: each fixture module under lint_fixtures/ seeds
+one antipattern; the matching rule must fire with the right file:line,
+the clean fixture must produce zero findings, and pragma suppressions
+must silence findings without touching the code.
+
+The linter only PARSES fixtures (never imports them), so these tests run
+without jax ever materializing a device array.
+"""
+import pathlib
+
+from siddhi_tpu.analysis import lint_file, lint_source, rule_names
+
+FIXTURES = pathlib.Path(__file__).parent / "lint_fixtures"
+
+
+def findings_for(name):
+    return lint_file(str(FIXTURES / name), rel_path=name)
+
+
+def lines_of(findings, rule):
+    return sorted(f.line for f in findings if f.rule == rule)
+
+
+def test_module_device_array_fires_with_anchor():
+    fs = findings_for("bad_module_array.py")
+    assert lines_of(fs, "module-device-array") == [6, 8, 12]
+    f = [x for x in fs if x.rule == "module-device-array"][0]
+    assert f.severity == "error"
+    assert f.anchor == "bad_module_array.py:6"
+    # the in-function jnp.ones must NOT fire
+    assert all(f.line != 16 for f in fs)
+
+
+def test_host_sync_in_loop_fires_with_anchor():
+    fs = findings_for("bad_loop_sync.py")
+    assert lines_of(fs, "host-sync-in-loop") == [10, 15, 21]
+    # nested int(jax.device_get(...)) reports ONCE (outermost call)
+    assert sum(1 for f in fs if f.line == 10) == 1
+    # batched transfer + first-comprehension-source patterns stay clean
+    assert all(f.line < 24 for f in fs)
+
+
+def test_host_sync_in_jit_fires_for_decorated_and_wrapped():
+    fs = findings_for("bad_jit_sync.py")
+    assert lines_of(fs, "host-sync-in-jit") == [8, 13]
+    # the un-jitted helper at the bottom must not fire
+    assert all(f.line < 19 for f in fs)
+
+
+def test_traced_branch_in_jit_fires_for_if_and_while():
+    fs = findings_for("bad_jit_branch.py")
+    assert lines_of(fs, "traced-branch-in-jit") == [8, 15]
+    assert all(f.line < 20 for f in fs)
+
+
+def test_recompile_hazard_fires_for_shape_param_and_mutable_default():
+    fs = findings_for("bad_recompile.py")
+    assert lines_of(fs, "recompile-hazard") == [8, 12]
+    assert all(f.line < 16 for f in fs)
+
+
+def test_float64_literal_fires_for_dtype_kw_call_and_string():
+    fs = findings_for("bad_float64.py")
+    assert lines_of(fs, "float64-literal") == [7, 11, 15]
+    assert all(f.line < 17 for f in fs)
+
+
+def test_clean_fixture_has_zero_findings():
+    assert findings_for("clean_module.py") == []
+
+
+def test_suppression_pragmas_silence_findings():
+    assert findings_for("suppressed.py") == []
+
+
+def test_file_level_suppression():
+    src = ("import jax.numpy as jnp\n"
+           "# lint: disable-file=module-device-array\n"
+           "X = jnp.zeros((2,))\n")
+    assert lint_source(src, path="f.py") == []
+
+
+def test_unsuppressed_source_still_fires():
+    src = "import jax.numpy as jnp\nX = jnp.zeros((2,))\n"
+    fs = lint_source(src, path="f.py")
+    assert [f.rule for f in fs] == ["module-device-array"]
+
+
+def test_alias_resolution():
+    # rules must see through import aliases
+    src = ("from jax import numpy as weird\n"
+           "import jax as j\n"
+           "X = weird.ones((3,))\n"
+           "Y = j.device_put(1)\n")
+    fs = lint_source(src, path="f.py")
+    assert lines_of(fs, "module-device-array") == [3, 4]
+
+
+def test_syntax_error_becomes_parse_error_finding():
+    fs = lint_source("def broken(:\n", path="f.py")
+    assert [f.rule for f in fs] == ["parse-error"]
+
+
+def test_all_seeded_rules_registered():
+    assert {"module-device-array", "host-sync-in-loop", "host-sync-in-jit",
+            "traced-branch-in-jit", "recompile-hazard",
+            "float64-literal"} <= rule_names()

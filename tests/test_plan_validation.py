@@ -1,0 +1,175 @@
+"""Query-plan validator tests (analysis/plan_rules.py): bad plans must
+fail at parse/compile time with the query name and construct in the
+message, instead of surfacing later as runtime shape errors; valid plans
+(including implicit insert-into streams, partitions with inner streams,
+patterns, joins) must pass untouched.
+"""
+import pytest
+
+from siddhi_tpu.analysis.plan_rules import validate_app
+from siddhi_tpu.lang.parser import parse
+from siddhi_tpu.ops.expr import CompileError
+
+
+def codes(issues):
+    return sorted(i.code for i in issues)
+
+
+# ---- valid plans stay valid -------------------------------------------
+
+
+def test_valid_app_has_no_issues():
+    app = parse("""
+        define stream S (symbol string, price float, volume long);
+        @info(name='q1')
+        from S[price > 10]#window.length(5)
+        select symbol, sum(price) as total group by symbol
+        insert into Out;
+        from Out select symbol insert into Final;
+    """)
+    assert validate_app(app) == []
+
+
+def test_implicit_insert_into_stream_counts_as_defined():
+    parse("""
+        define stream S (a int);
+        from S select a insert into Mid;
+        from Mid select a insert into Out;
+    """)
+
+
+def test_pattern_and_join_inputs_resolve():
+    parse("""
+        define stream A (x int);
+        define stream B (y int);
+        from every e1=A -> e2=B[y > e1.x] select e1.x, e2.y insert into Out;
+        from A#window.length(3) join B#window.length(3) on A.x == B.y
+        select A.x insert into J;
+    """)
+
+
+def test_partition_inner_streams_resolve():
+    parse("""
+        define stream S (sym string, v int);
+        partition with (sym of S) begin
+            from S select sym, v insert into #mid;
+            from #mid[v > 0] select sym insert into Out;
+        end;
+    """)
+
+
+def test_trigger_table_window_defs_count_as_defined():
+    parse("""
+        define stream S (a int);
+        define table T (a int);
+        define window W (a int) length(5);
+        define trigger Tick at every 1 sec;
+        from W select a insert into Out;
+    """)
+
+
+# ---- definite errors raise CompileError at parse time -----------------
+
+
+def test_undefined_stream_raises():
+    with pytest.raises(CompileError, match="undefined-stream"):
+        parse("define stream S (a int);\n"
+              "from Missing select a insert into Out;")
+
+
+def test_undefined_join_side_raises():
+    with pytest.raises(CompileError, match="undefined-stream"):
+        parse("define stream A (x int);\n"
+              "from A join Nope on A.x == Nope.x select A.x "
+              "insert into Out;")
+
+
+def test_undefined_pattern_source_raises():
+    with pytest.raises(CompileError, match="undefined-stream"):
+        parse("define stream A (x int);\n"
+              "from every e1=A -> e2=Ghost select e1.x insert into Out;")
+
+
+def test_unproduced_inner_stream_raises():
+    with pytest.raises(CompileError, match="undefined-stream"):
+        parse("""
+            define stream S (sym string, v int);
+            partition with (sym of S) begin
+                from #nowhere select sym insert into Out;
+            end;
+        """)
+
+
+def test_window_arity_raises():
+    with pytest.raises(CompileError, match="window-arity"):
+        parse("define stream S (a int);\n"
+              "from S#window.time(1 sec, 2) select a insert into Out;")
+
+
+def test_external_time_needs_attribute_first():
+    with pytest.raises(CompileError, match="window-arity"):
+        parse("define stream S (a int, ts long);\n"
+              "from S#window.externalTime(5, 1 sec) select a "
+              "insert into Out;")
+
+
+def test_unknown_window_name_left_to_planner():
+    # extensions resolve at plan time; the validator must not guess
+    app = parse("define stream S (a int);\n"
+                "from S#window.customExt(1, 2, 3) select a "
+                "insert into Out;", validate=False)
+    assert codes(validate_app(app)) == []
+
+
+def test_aggregator_arity_raises():
+    with pytest.raises(CompileError, match="aggregator-arity"):
+        parse("define stream S (a int);\n"
+              "from S select sum(a, a) as t insert into Out;")
+
+
+def test_undefined_attribute_raises():
+    with pytest.raises(CompileError, match="undefined-attribute"):
+        parse("define stream S (a int);\n"
+              "from S[b > 1] select a insert into Out;")
+
+
+def test_undefined_attribute_in_select_raises():
+    with pytest.raises(CompileError, match="undefined-attribute"):
+        parse("define stream S (a int);\n"
+              "from S select missing insert into Out;")
+
+
+def test_dead_count_state_raises():
+    with pytest.raises(CompileError, match="dead-state"):
+        parse("define stream A (x int); define stream B (y int);\n"
+              "from every e1=A<3:2> -> e2=B select e2.y insert into Out;")
+
+
+# ---- advisory warnings do not raise -----------------------------------
+
+
+def test_constant_false_filter_warns_but_parses():
+    app = parse("define stream S (a int);\n"
+                "from S[false] select a insert into Out;")
+    assert codes(validate_app(app)) == ["dead-filter"]
+
+
+def test_vacuous_count_state_warns_but_parses():
+    app = parse("define stream A (x int); define stream B (y int);\n"
+                "from e1=A<0:0>, e2=B select e2.y insert into Out;")
+    assert "dead-state" in codes(validate_app(app))
+
+
+def test_table_scoped_filters_are_skipped():
+    # table-resolved variables are planner territory — no false positives
+    parse("""
+        define stream S (a int);
+        define table T (b int);
+        from S[T.b == a in T] select a insert into Out;
+    """, validate=False)
+    app = parse("""
+        define stream S (a int);
+        define table T (b int);
+        from S[a in T] select a insert into Out;
+    """)
+    assert codes(validate_app(app)) == []

@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""TPU-hygiene lint CLI — thin wrapper over siddhi_tpu.analysis.cli.
+
+Usage (from anywhere; relative paths resolve against the repo root):
+
+    python tools/lint.py                  # lint siddhi_tpu/ vs baseline
+    python tools/lint.py siddhi_tpu tests # explicit targets
+    python tools/lint.py --list-rules
+    python tools/lint.py --no-baseline    # show grandfathered findings too
+    python tools/lint.py --baseline tools/lint_baseline.json \
+        --update-baseline                 # re-grandfather current findings
+
+Exits nonzero when any non-baselined, non-suppressed finding exists —
+this is the CI gate (tests/test_lint_repo.py runs the same check in
+tier-1).
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "lint_baseline.json")
+
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from siddhi_tpu.analysis.cli import main  # noqa: E402
+
+
+def _resolve(arg: str) -> str:
+    """Resolve a non-flag argument against the repo root when it does
+    not exist relative to the cwd."""
+    if arg.startswith("-") or os.path.isabs(arg) or os.path.exists(arg):
+        return arg
+    rooted = os.path.join(REPO_ROOT, arg)
+    return rooted if os.path.exists(rooted) else arg
+
+
+def run(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--baseline" not in argv and "--no-baseline" not in argv:
+        argv += ["--baseline", DEFAULT_BASELINE]
+    if "--root" not in argv:
+        argv += ["--root", REPO_ROOT]
+    return main([_resolve(a) for a in argv])
+
+
+if __name__ == "__main__":
+    sys.exit(run())
