@@ -44,11 +44,25 @@ from .lang.parser import (  # noqa: E402
     parse_on_demand_query,
     parse_query,
 )
+from .resilience import (  # noqa: E402
+    CheckpointSupervisor,
+    ErroredEvent,
+    ErrorStore,
+    FaultInjector,
+    FileSystemErrorStore,
+    InMemoryErrorStore,
+)
 
 __all__ = [
     "AttrType",
+    "CheckpointSupervisor",
+    "ErrorStore",
+    "ErroredEvent",
     "Event",
+    "FaultInjector",
+    "FileSystemErrorStore",
     "FileSystemPersistenceStore",
+    "InMemoryErrorStore",
     "InMemoryPersistenceStore",
     "PersistenceStore",
     "QueryCallback",

@@ -48,6 +48,12 @@ WINDOW_ARITY: dict[str, tuple[int, Optional[int]]] = {
 # windows whose first parameter must be a stream attribute, not a constant
 _ATTR_FIRST_WINDOWS = {"externaltime", "externaltimebatch"}
 
+# on-error action envelopes (core/stream.py @OnError routing and
+# core/io.py connector policies)
+ONERROR_STREAM_ACTIONS = ("LOG", "STREAM", "STORE")
+ONERROR_SINK_ACTIONS = ("RETRY", "WAIT", "STORE", "LOG", "STREAM")
+ONERROR_SOURCE_ACTIONS = ("RETRY", "WAIT")
+
 # aggregator arity over ops/selector.py AGGREGATOR_NAMES: (min, max)
 AGGREGATOR_ARITY: dict[str, tuple[int, int]] = {
     "sum": (1, 1), "avg": (1, 1), "count": (0, 1),
@@ -139,6 +145,8 @@ class PlanValidator:
 
     # -- checks --------------------------------------------------------
     def validate(self) -> list[PlanIssue]:
+        for sid, sd in self.app.stream_definitions.items():
+            self.check_on_error_actions(sid, sd)
         qn = 0
         for el in self.app.execution_elements:
             if isinstance(el, A.Query):
@@ -149,6 +157,31 @@ class PlanValidator:
                 self.check_partition(el, f"partition{qn + 1}")
                 qn += len(el.queries)
         return self.issues
+
+    def check_on_error_actions(self, sid: str, sd) -> None:
+        """Unknown @OnError / connector `on.error` action values are
+        definite runtime rejections — fail at parse time with the
+        stream and action named (extends the PR 1 plan rules)."""
+        for ann in sd.annotations:
+            nm = ann.name.lower()
+            if nm == "onerror":
+                action = (ann.element("action") or "LOG").upper()
+                if action not in ONERROR_STREAM_ACTIONS:
+                    self.add(
+                        "on-error-action", ERROR, f"stream {sid}",
+                        f"unknown @OnError action '{action}' (expected "
+                        f"one of {', '.join(ONERROR_STREAM_ACTIONS)})")
+            elif nm in ("sink", "source"):
+                action = ann.element("on.error")
+                if action is None:
+                    continue
+                valid = ONERROR_SINK_ACTIONS if nm == "sink" \
+                    else ONERROR_SOURCE_ACTIONS
+                if action.upper() not in valid:
+                    self.add(
+                        "on-error-action", ERROR, f"stream {sid}",
+                        f"unknown {nm} on.error action '{action}' "
+                        f"(expected one of {', '.join(valid)})")
 
     def check_partition(self, part: A.Partition, pname: str):
         for pt in part.partition_types:

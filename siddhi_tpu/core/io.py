@@ -15,15 +15,43 @@ ingestion. Custom transports register through the extension SPI as
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Callable, Optional
 
 from .stream import Event, StreamCallback
 
+log = logging.getLogger("siddhi_tpu.io")
+
 
 class ConnectionUnavailableException(Exception):
     """Transport temporarily unreachable; triggers retry with backoff."""
+
+
+# on-error actions a connector can declare via `on.error=` (the static
+# plan validator rejects anything else at parse time; constructors also
+# reject so programmatic wiring fails fast)
+SINK_ON_ERROR_ACTIONS = ("RETRY", "WAIT", "STORE", "LOG", "STREAM")
+SOURCE_ON_ERROR_ACTIONS = ("RETRY", "WAIT")
+
+
+def _on_error_opts(options: dict, valid: tuple, default_attempts: int,
+                   what: str) -> tuple[str, int, int, int]:
+    """Parse the shared on.error option family: (action, max attempts,
+    backoff base ms, backoff cap ms)."""
+    action = str(options.get("on.error") or "RETRY").upper()
+    if action not in valid:
+        raise ValueError(
+            f"{what}: unknown on.error action '{action}' "
+            f"(expected one of {', '.join(valid)})")
+    attempts = int(options.get("on.error.max.attempts")
+                   or default_attempts)
+    if attempts < 1:
+        raise ValueError(f"{what}: on.error.max.attempts must be >= 1")
+    base = int(options.get("on.error.backoff.ms") or 5)
+    cap = int(options.get("on.error.backoff.cap.ms") or 1000)
+    return action, attempts, base, cap
 
 
 class BackoffRetryCounter:
@@ -45,7 +73,13 @@ class BackoffRetryCounter:
 
 
 class InMemoryBroker:
-    """Process-wide topic pub/sub (util/transport/InMemoryBroker.java:29)."""
+    """Process-wide topic pub/sub (util/transport/InMemoryBroker.java:29).
+
+    Thread-safe by construction: every subscriber-list mutation happens
+    under the class lock, and publish iterates a snapshot taken under
+    the lock — a sink publishing while a source disconnects can at worst
+    deliver one message to a just-unsubscribed callback, never observe a
+    list mutating mid-iteration."""
 
     _topics: dict = {}
     _lock = threading.Lock()
@@ -150,6 +184,12 @@ class Source:
         self.mapper = mapper
         self.handler = handler
         self.connected = False
+        # on.error='RETRY' (bounded attempts) | 'WAIT' (block until the
+        # transport comes back), with configurable attempt/backoff knobs
+        (self.on_error, self.max_attempts, self._backoff_base_ms,
+         self._backoff_cap_ms) = _on_error_opts(
+            options, SOURCE_ON_ERROR_ACTIONS, 12,
+            f"source {type(self).__name__}")
         self._paused = threading.Event()
         self._paused.set()  # not paused
 
@@ -160,20 +200,29 @@ class Source:
     def disconnect(self) -> None:
         pass
 
-    def connect_with_retry(self, max_tries: int = 12) -> None:
+    def connect_with_retry(self, max_tries: Optional[int] = None) -> None:
         """Source.connectWithRetry (Source.java:155): exponential backoff
-        until the transport accepts the connection."""
-        backoff = BackoffRetryCounter()
-        for _ in range(max_tries):
+        until the transport accepts the connection. on.error='WAIT'
+        blocks (keeps retrying at the backoff cap) until it does; RETRY
+        raises immediately after the final failed attempt — no trailing
+        backoff sleep nobody is waiting on."""
+        if max_tries is None:
+            max_tries = self.max_attempts
+        backoff = BackoffRetryCounter(self._backoff_base_ms,
+                                      self._backoff_cap_ms)
+        attempt = 0
+        while True:
+            attempt += 1
             try:
                 self.connect()
                 self.connected = True
                 return
             except ConnectionUnavailableException:
+                if self.on_error != "WAIT" and attempt >= max_tries:
+                    raise ConnectionUnavailableException(
+                        f"source {type(self).__name__} failed to connect "
+                        f"after {attempt} attempts")
                 time.sleep(backoff.next_wait_s())
-        raise ConnectionUnavailableException(
-            f"source {type(self).__name__} failed to connect after "
-            f"{max_tries} attempts")
 
     def pause(self) -> None:
         self._paused.clear()
@@ -207,18 +256,40 @@ class InMemorySource(Source):
 class Sink(StreamCallback):
     """Publishes stream events to an external system
     (stream/output/sink/Sink.java SPI); publish failures retry with
-    backoff, then follow the on-error action."""
+    backoff, then follow the per-sink `on.error` action
+    (Sink.java:174-243):
+
+    - RETRY (default) / LOG: bounded attempts, then log + count the drop
+    - WAIT: block, retrying at the backoff cap, until the transport
+      recovers (or the sink is disconnected)
+    - STORE: bounded attempts, then capture the event in the app's
+      error store for replay (at-least-once)
+    - STREAM: bounded attempts, then emit a fault event on the origin
+      stream's `!stream` junction
+
+    The policy resolves PER EVENT: one event exhausting its retries must
+    not abort the rest of the batch (events after it are still
+    attempted, not lost to a raised exception)."""
 
     def __init__(self, options: dict, mapper: SinkMapper):
         super().__init__()
         self.options = options
         self.mapper = mapper
+        (self.on_error, self.max_attempts, self._backoff_base_ms,
+         self._backoff_cap_ms) = _on_error_opts(
+            options, SINK_ON_ERROR_ACTIONS, 4,
+            f"sink {type(self).__name__}")
+        # wired by build_io: origin stream id + its junction (fault
+        # routing, error-store resolution, per-stream error counters)
+        self.stream_id: Optional[str] = None
+        self.junction = None
+        self._closed = False
 
     def connect(self) -> None:
-        pass
+        self._closed = False
 
     def disconnect(self) -> None:
-        pass
+        self._closed = True
 
     def publish(self, payload) -> None:
         raise NotImplementedError
@@ -226,15 +297,49 @@ class Sink(StreamCallback):
     def receive(self, events: list[Event]) -> None:
         for e in events:
             payload = self.mapper.map(e)
-            backoff = BackoffRetryCounter()
-            for attempt in range(4):
-                try:
-                    self.publish(payload)
-                    break
-                except ConnectionUnavailableException:
-                    if attempt == 3:
-                        raise
+            try:
+                self._publish_with_retry(payload)
+            except ConnectionUnavailableException as exc:
+                self._on_publish_failure(e, exc)
+
+    def _publish_with_retry(self, payload) -> None:
+        backoff = BackoffRetryCounter(self._backoff_base_ms,
+                                      self._backoff_cap_ms)
+        attempt = 0
+        while True:
+            attempt += 1
+            try:
+                self.publish(payload)
+                return
+            except ConnectionUnavailableException:
+                if self.on_error == "WAIT":
+                    if self._closed:
+                        raise   # disconnected mid-wait: stop blocking
                     time.sleep(backoff.next_wait_s())
+                    continue
+                if attempt >= self.max_attempts:
+                    raise   # terminal — no trailing backoff sleep
+                time.sleep(backoff.next_wait_s())
+
+    def _on_publish_failure(self, event: Event, exc: Exception) -> None:
+        """Terminal per-event on-error resolution; never raises, so the
+        remainder of the batch is still attempted."""
+        sid = self.stream_id or type(self).__name__
+        if self.junction is not None:
+            self.junction.count_error()
+        if self.on_error == "STORE" and self.junction is not None and \
+                self.junction.store_error([event], exc,
+                                          attempts=self.max_attempts):
+            log.warning("sink on stream '%s': event routed to the error "
+                        "store after %d attempts (%s)", sid,
+                        self.max_attempts, exc)
+            return
+        if self.on_error == "STREAM" and self.junction is not None and \
+                self.junction.publish_fault([event], exc):
+            return
+        log.error("sink on stream '%s': dropped event after %d "
+                  "attempt(s) (action=%s)", sid, self.max_attempts,
+                  self.on_error, exc_info=exc)
 
 
 class InMemorySink(Sink):
@@ -373,7 +478,11 @@ def build_io(app, exts: dict) -> None:
                 mcls = SOURCE_MAPPERS.get(mname)
                 if mcls is None:
                     raise CompileError(f"unknown source map '{mname}'")
-                src = cls(opts, mcls(schema), app.input_handlers[sid])
+                try:
+                    src = cls(opts, mcls(schema), app.input_handlers[sid])
+                except ValueError as e:   # bad on.error options
+                    raise CompileError(f"stream '{sid}': {e}") from e
+                src.stream_id = sid
                 app.sources.append(src)
             else:
                 cls = SINK_TYPES.get(typ) or exts.get(f"sink:{typ}")
@@ -410,19 +519,31 @@ def build_io(app, exts: dict) -> None:
                                  for k, v in dist.elements.items()}
                     dest_opts = []
                     children = []
-                    for d in dests:
-                        merged = dict(opts)
-                        merged.update(
-                            {k.lower(): v for k, v in d.elements.items()})
-                        dest_opts.append(merged)
-                        children.append(cls(merged, mcls(schema)))
+                    try:
+                        for d in dests:
+                            merged = dict(opts)
+                            merged.update(
+                                {k.lower(): v
+                                 for k, v in d.elements.items()})
+                            dest_opts.append(merged)
+                            children.append(cls(merged, mcls(schema)))
+                    except ValueError as e:   # bad on.error options
+                        raise CompileError(f"stream '{sid}': {e}") from e
                     strat = scls()
                     try:
                         strat.init(schema, dist_opts, dest_opts)
                     except ValueError as e:
                         raise CompileError(str(e)) from e
                     snk = DistributedSink(children, strat)
+                    for c in children:
+                        c.stream_id = sid
+                        c.junction = app.junctions[sid]
                 else:
-                    snk = cls(opts, mcls(schema))
+                    try:
+                        snk = cls(opts, mcls(schema))
+                    except ValueError as e:   # bad on.error options
+                        raise CompileError(f"stream '{sid}': {e}") from e
+                    snk.stream_id = sid
+                    snk.junction = app.junctions[sid]
                 app.junctions[sid].subscribe(StreamCallbackReceiver(snk))
                 app.sinks.append(snk)

@@ -17,6 +17,7 @@ class SiddhiManager:
         self.app_runtimes: dict[str, SiddhiAppRuntime] = {}
         self.extensions: dict[str, object] = {}
         self.persistence_store = None
+        self.error_store = None
 
     def create_siddhi_app_runtime(self, source,
                                   partition_mesh=None) -> SiddhiAppRuntime:
@@ -53,6 +54,12 @@ class SiddhiManager:
 
     def set_persistence_store(self, store) -> None:
         self.persistence_store = store
+
+    def set_error_store(self, store) -> None:
+        """Shared error store (resilience/errorstore.py): failed events
+        captured by on.error='STORE' land here and survive app restarts
+        for replay."""
+        self.error_store = store
 
     def shutdown(self) -> None:
         for rt in list(self.app_runtimes.values()):

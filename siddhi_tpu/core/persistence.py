@@ -45,6 +45,12 @@ class PersistenceStore:
     def get_last_revision(self, app_name: str) -> Optional[str]:
         raise NotImplementedError
 
+    def list_revisions(self, app_name: str) -> list[str]:
+        """All revisions for an app, oldest first (the checkpoint
+        supervisor walks this newest-first to fall back past corrupted
+        snapshots)."""
+        raise NotImplementedError
+
     def clear_all_revisions(self, app_name: str) -> None:
         raise NotImplementedError
 
@@ -68,6 +74,10 @@ class InMemoryPersistenceStore(PersistenceStore):
         with self._lock:
             revs = self._revisions.get(app_name)
             return sorted(revs)[-1] if revs else None
+
+    def list_revisions(self, app_name):
+        with self._lock:
+            return sorted(self._revisions.get(app_name, ()))
 
     def clear_all_revisions(self, app_name):
         with self._lock:
@@ -100,12 +110,15 @@ class FileSystemPersistenceStore(PersistenceStore):
             return f.read()
 
     def get_last_revision(self, app_name):
+        revs = self.list_revisions(app_name)
+        return revs[-1] if revs else None
+
+    def list_revisions(self, app_name):
         d = self._dir(app_name)
         if not os.path.isdir(d):
-            return None
-        revs = sorted(f[:-len(".snapshot")] for f in os.listdir(d)
+            return []
+        return sorted(f[:-len(".snapshot")] for f in os.listdir(d)
                       if f.endswith(".snapshot"))
-        return revs[-1] if revs else None
 
     def clear_all_revisions(self, app_name):
         d = self._dir(app_name)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import bisect
 import dataclasses
+import logging
 import re
 import threading
 import time
@@ -1292,6 +1293,11 @@ class SiddhiAppRuntime:
         self._last_ingest_wall = 0.0
         self._idle_thread: Optional[threading.Thread] = None
         self._local_store = None  # fallback store when manager is None
+        self._local_error_store = None  # ditto for the error store
+        # per-stream junction/sink error counters (core/stats.py) — always
+        # on; junctions get a reference through junction_for
+        from .stats import StreamErrorStats
+        self.error_stats = StreamErrorStats()
         self._cron_armed = False
         self._due_pending: list = []
         self._due_lock = threading.Lock()
@@ -1415,6 +1421,8 @@ class SiddhiAppRuntime:
             if schema is None:
                 raise CompileError(f"undefined stream '{stream_id}'")
             j = StreamJunction(stream_id, schema)
+            j.app = self
+            j.error_stats = self.error_stats
             self.junctions[stream_id] = j
             self.schemas[stream_id] = schema
         elif schema is not None and schema.types != j.schema.types:
@@ -1480,6 +1488,9 @@ class SiddhiAppRuntime:
                     "completeness_losses": rt.completeness_losses,
                     "compiled_readers": sorted(rt.compiled_readers),
                 }
+        errors = self.error_stats.snapshot()
+        if errors:
+            report["stream_errors"] = errors
         return report
 
     def debug(self):
@@ -1571,6 +1582,26 @@ class SiddhiAppRuntime:
         if self._local_store is None:
             self._local_store = InMemoryPersistenceStore()
         return self._local_store
+
+    def _error_store(self):
+        """The app's error store (resilience/errorstore.py): the
+        manager's shared store when one is registered (survives app
+        restarts, like the persistence store), else a runtime-local
+        in-memory fallback."""
+        from ..resilience.errorstore import InMemoryErrorStore
+        if self.manager is not None:
+            if getattr(self.manager, "error_store", None) is None:
+                self.manager.error_store = InMemoryErrorStore()
+            return self.manager.error_store
+        if self._local_error_store is None:
+            self._local_error_store = InMemoryErrorStore()
+        return self._local_error_store
+
+    def replay_error_store(self) -> int:
+        """Re-inject the error-store backlog through the normal
+        junctions (at-least-once); returns events replayed."""
+        from ..resilience.errorstore import replay
+        return replay(self, self._error_store())
 
     def snapshot(self) -> bytes:
         """Full state snapshot as bytes (SnapshotService.fullSnapshot).
@@ -1693,8 +1724,9 @@ class SiddhiAppRuntime:
                 finally:
                     j.stop_async()
         if flush_errors:
-            print(f"[siddhi_tpu] app '{self.name}': async streams did not "
-                  f"drain cleanly on shutdown: {flush_errors}")
+            logging.getLogger("siddhi_tpu.runtime").error(
+                "app '%s': async streams did not drain cleanly on "
+                "shutdown: %s", self.name, flush_errors)
         self._resolve_dues()
         for s in self.sources:
             s.disconnect()
@@ -1763,6 +1795,12 @@ class Planner:
             oe = A.find_annotation(sd.annotations, "OnError")
             if oe is not None:
                 action = (oe.element("action") or "LOG").upper()
+                if action not in ("LOG", "STREAM", "STORE"):
+                    # the static validator rejects this at parse time;
+                    # planner backstop for validate=False / built ASTs
+                    raise CompileError(
+                        f"stream '{sid}': unknown @OnError action "
+                        f"'{action}' (expected LOG, STREAM or STORE)")
                 j.on_error_action = action
                 if action == "STREAM":
                     # shadow fault stream !sid: original attrs + _error

@@ -111,6 +111,31 @@ def pytree_nbytes(tree) -> int:
     return total
 
 
+class StreamErrorStats:
+    """Per-stream error counters, app-scoped: every junction on-error
+    handling pass and every terminal sink publish failure increments the
+    origin stream's counter (always on — errors are rare enough that the
+    count is free, and silent drops are the one thing stats must never
+    hide)."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def increment(self, stream_id: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[stream_id] = self._counts.get(stream_id, 0) + n
+
+    def count(self, stream_id: str) -> int:
+        with self._lock:
+            return self._counts.get(stream_id, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._counts)
+
+
 class QueryStats:
     """Per-query tracker bundle (created when statistics are enabled)."""
 

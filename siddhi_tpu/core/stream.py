@@ -21,8 +21,11 @@ to batch them onto the device.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 from typing import Any, Callable, Optional, Sequence
+
+log = logging.getLogger("siddhi_tpu.stream")
 
 
 @dataclasses.dataclass
@@ -65,6 +68,10 @@ class StreamJunction:
         self.receivers: list[Receiver] = []
         self.fault_junction: Optional["StreamJunction"] = None
         self.on_error_action: str = "LOG"
+        # wired by the app runtime (junction_for): the owning app (error
+        # store resolution) and the app-wide per-stream error counters
+        self.app = None
+        self.error_stats = None
         self._lock = threading.Lock()
         # @Async state (None = synchronous junction)
         self.async_conf: Optional[tuple[int, int]] = None  # (buffer, batch)
@@ -160,22 +167,53 @@ class StreamJunction:
         # dead queue (sends are already rejected by the running check)
         self._queue = None
 
+    def count_error(self, n: int = 1) -> None:
+        if self.error_stats is not None:
+            self.error_stats.increment(self.stream_id, n)
+
+    def publish_fault(self, events: list[Event], exc: Exception) -> bool:
+        """Convert failing events + exception into fault events on the
+        `!stream` junction; False when no fault junction is wired."""
+        if self.fault_junction is None or not events:
+            return False
+        msg = f"{type(exc).__name__}: {exc}"
+        self.fault_junction.publish([
+            Event(e.timestamp, tuple(e.data) + (msg,),
+                  is_expired=e.is_expired) for e in events])
+        return True
+
+    def store_error(self, events: list[Event], exc: Exception,
+                    attempts: int = 1) -> bool:
+        """Capture failing events into the app's error store for later
+        replay; False when no app is wired (standalone junction)."""
+        if self.app is None or not events:
+            return False
+        from ..resilience.errorstore import ErroredEvent
+        self.app._error_store().store(
+            self.app.name,
+            ErroredEvent.from_events(
+                self.stream_id, events, f"{type(exc).__name__}: {exc}",
+                attempts=attempts, now=self.app.current_time()))
+        return True
+
     def _handle_error(self, events: Optional[list[Event]],
                       exc: Exception) -> None:
         """@OnError routing (StreamJunction.handleError:368-430): STREAM
         converts the failing events + exception into fault events on the
-        `!stream` junction; LOG (default) logs and continues."""
-        if self.on_error_action == "STREAM" and \
-                self.fault_junction is not None and events:
-            msg = f"{type(exc).__name__}: {exc}"
-            self.fault_junction.publish([
-                Event(e.timestamp, tuple(e.data) + (msg,),
-                      is_expired=e.is_expired) for e in events])
+        `!stream` junction; STORE captures them in the error store for
+        replay; LOG (default) logs and continues."""
+        self.count_error()
+        if self.on_error_action == "STREAM" and events and \
+                self.publish_fault(events, exc):
             return
-        import traceback
-        print(f"[siddhi_tpu] error processing events on stream "
-              f"'{self.stream_id}' (action=LOG):")
-        traceback.print_exc()
+        if self.on_error_action == "STORE" and events and \
+                self.store_error(events, exc):
+            log.warning(
+                "stream '%s': %d event(s) routed to the error store "
+                "after %s", self.stream_id, len(events), exc)
+            return
+        log.error("error processing events on stream '%s' (action=%s)",
+                  self.stream_id, self.on_error_action, exc_info=exc)
 
     def publish(self, events: list[Event]) -> None:
         if not events:

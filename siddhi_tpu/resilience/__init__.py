@@ -1,0 +1,27 @@
+"""Fault-tolerance subsystem: error store with replay, checkpoint
+supervision, and a deterministic fault-injection harness.
+
+Reference mapping:
+- util/error/handler/ErrorHandlerUtils + ErrorStore SPI
+  (store/error-store in the reference distribution)  -> errorstore.py
+- @OnError / sink `on.error` actions
+  (stream/StreamJunction.java:368-430, Sink.java:174-243) -> core wiring
+- scheduled state persistence (PersistenceManager in the reference
+  distribution)                                       -> supervisor.py
+- no reference equivalent: faults.py is the seeded chaos harness that
+  makes the recovery paths testable instead of trusted on faith.
+"""
+from .errorstore import (ErroredEvent, ErrorStore, FileSystemErrorStore,
+                         InMemoryErrorStore, replay)
+from .faults import FaultInjector
+from .supervisor import CheckpointSupervisor
+
+__all__ = [
+    "CheckpointSupervisor",
+    "ErroredEvent",
+    "ErrorStore",
+    "FaultInjector",
+    "FileSystemErrorStore",
+    "InMemoryErrorStore",
+    "replay",
+]

@@ -1,0 +1,150 @@
+"""Deterministic, seeded fault injection for chaos tests.
+
+A FaultInjector patches live objects (sinks, sources, callbacks,
+persistence stores) to fail on demand, records every injection, and
+restores the originals on context exit. All randomness comes from one
+``random.Random(seed)`` so a failing chaos run reproduces exactly from
+its seed.
+"""
+from __future__ import annotations
+
+import collections
+import random
+from typing import Callable, Optional
+
+
+class FaultInjector:
+    """Context-manager harness::
+
+        with FaultInjector(seed=7) as fi:
+            fi.break_sink(rt.sinks[0])        # outage until healed
+            ...
+            fi.heal(rt.sinks[0], "publish")   # transport recovers
+
+    Patches are instance-level attribute shadows; ``heal``/``restore_all``
+    put the original callables back.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self.injected = collections.Counter()   # fault kind -> count
+        self._patches: list[tuple[object, str, object]] = []
+
+    # -- lifecycle --------------------------------------------------------
+    def __enter__(self) -> "FaultInjector":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.restore_all()
+        return False
+
+    def _patch(self, obj, attr: str, wrapper) -> None:
+        self._patches.append((obj, attr, getattr(obj, attr)))
+        setattr(obj, attr, wrapper)
+
+    def heal(self, obj, attr: Optional[str] = None) -> None:
+        """Undo patches on obj (all of them, or just obj.attr)."""
+        keep = []
+        for o, a, orig in reversed(self._patches):
+            if o is obj and (attr is None or a == attr):
+                setattr(o, a, orig)
+            else:
+                keep.append((o, a, orig))
+        self._patches = list(reversed(keep))
+
+    def restore_all(self) -> None:
+        while self._patches:
+            obj, attr, orig = self._patches.pop()
+            setattr(obj, attr, orig)
+
+    # -- transports -------------------------------------------------------
+    def break_sink(self, sink, fail: Optional[int] = None,
+                   rate: Optional[float] = None,
+                   match: Optional[Callable] = None) -> None:
+        """Make sink.publish raise ConnectionUnavailableException:
+
+        - fail=None, rate=None: every publish fails until heal(sink)
+        - fail=N: the first N publishes fail, later ones pass
+        - rate=p: each publish fails with seeded probability p
+        - match=fn: only payloads where fn(payload) is truthy can fail
+        """
+        from ..core.io import ConnectionUnavailableException
+        orig = sink.publish
+        calls = {"n": 0}
+
+        def publish(payload):
+            if match is not None and not match(payload):
+                return orig(payload)
+            calls["n"] += 1
+            if fail is not None and calls["n"] > fail:
+                return orig(payload)
+            if rate is not None and self.rng.random() >= rate:
+                return orig(payload)
+            self.injected["sink"] += 1
+            raise ConnectionUnavailableException(
+                f"injected sink outage (seed={self.seed}, "
+                f"call={calls['n']})")
+
+        self._patch(sink, "publish", publish)
+
+    def break_source(self, source, fail: int = 1) -> None:
+        """Make source.connect raise for the first ``fail`` attempts."""
+        from ..core.io import ConnectionUnavailableException
+        orig = source.connect
+        calls = {"n": 0}
+
+        def connect():
+            calls["n"] += 1
+            if calls["n"] <= fail:
+                self.injected["source"] += 1
+                raise ConnectionUnavailableException(
+                    f"injected source outage (attempt {calls['n']})")
+            return orig()
+
+        self._patch(source, "connect", connect)
+
+    # -- callbacks --------------------------------------------------------
+    def break_callback(self, callback, times: Optional[int] = 1,
+                       exc: Optional[Exception] = None) -> None:
+        """Make callback.receive raise for the first ``times`` deliveries
+        (times=None: until healed) — exercises the junction's @OnError
+        routing."""
+        orig = callback.receive
+        calls = {"n": 0}
+
+        def receive(*args, **kwargs):
+            calls["n"] += 1
+            if times is None or calls["n"] <= times:
+                self.injected["callback"] += 1
+                raise exc if exc is not None else RuntimeError(
+                    f"injected callback failure (call {calls['n']})")
+            return orig(*args, **kwargs)
+
+        self._patch(callback, "receive", receive)
+
+    # -- persistence ------------------------------------------------------
+    def corrupt_saves(self, store, mode: str = "truncate",
+                      times: Optional[int] = None) -> None:
+        """Damage snapshot bytes on their way into PersistenceStore.save:
+        ``truncate`` keeps the first third; ``flip`` XORs seeded bytes.
+        times=N damages only the first N saves (None: all)."""
+        orig = store.save
+        calls = {"n": 0}
+
+        def save(app_name, revision, data):
+            calls["n"] += 1
+            if times is None or calls["n"] <= times:
+                self.injected["save"] += 1
+                if mode == "truncate":
+                    data = data[: max(1, len(data) // 3)]
+                elif mode == "flip":
+                    b = bytearray(data)
+                    for _ in range(max(8, len(b) // 64)):
+                        b[self.rng.randrange(len(b))] ^= 0xFF
+                    data = bytes(b)
+                else:
+                    raise ValueError(f"unknown corruption mode '{mode}'")
+            return orig(app_name, revision, data)
+
+        self._patch(store, "save", save)

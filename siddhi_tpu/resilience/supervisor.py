@@ -1,0 +1,103 @@
+"""Checkpoint supervision: periodic persist() on the scheduler, and
+recovery that restores the newest restorable revision then replays the
+error-store backlog.
+
+The periodic persist rides the app's own Scheduler: in wall-clock mode
+it fires on the scheduler thread (under the app barrier, like any timer
+callback); in playback mode it fires synchronously as the virtual clock
+passes each interval boundary — deterministic, so chaos tests can place
+the crash exactly between two checkpoints.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+log = logging.getLogger("siddhi_tpu.resilience")
+
+
+class CheckpointSupervisor:
+    """Supervises one app runtime: schedules persist() every
+    ``interval_ms`` and drives restore + error-store replay on restart.
+
+    Usage::
+
+        sup = CheckpointSupervisor(rt, interval_ms=60_000).start()
+        ...                               # crash happens
+        rt2 = mgr.create_siddhi_app_runtime(ql)
+        rt2.start()
+        rev, replayed = CheckpointSupervisor(rt2).recover()
+    """
+
+    def __init__(self, app, interval_ms: Optional[int] = None,
+                 error_store=None):
+        self.app = app
+        self.interval_ms = interval_ms
+        self.error_store = error_store    # None -> the app's own store
+        self.last_revision: Optional[str] = None
+        self.checkpoints = 0              # successful periodic persists
+        self.failures = 0                 # persist attempts that raised
+        self._stopped = False
+
+    # -- periodic persist -------------------------------------------------
+    def start(self, base_ms: Optional[int] = None
+              ) -> "CheckpointSupervisor":
+        """Arm the periodic checkpoint. In playback mode pass ``base_ms``
+        (the virtual-clock origin) — before the first event the app
+        clock still reads wall time, which would arm the timer far past
+        any virtual timestamp."""
+        if self.interval_ms:
+            self._arm(self.app.current_time() if base_ms is None
+                      else base_ms)
+        return self
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _arm(self, base_ms: int) -> None:
+        self.app.scheduler.notify_at(base_ms + self.interval_ms,
+                                     self._fire)
+
+    def _fire(self, due: int) -> None:
+        if self._stopped or not self.app.running:
+            return
+        try:
+            self.last_revision = self.app.persist()
+            self.checkpoints += 1
+        except Exception:  # noqa: BLE001 — a failed persist must not
+            # kill the scheduler; the next interval tries again
+            self.failures += 1
+            log.error("app '%s': scheduled persist failed",
+                      self.app.name, exc_info=True)
+        self._arm(due)
+
+    # -- recovery ---------------------------------------------------------
+    def recover(self, replay_errors: bool = True
+                ) -> tuple[Optional[str], int]:
+        """Restore the newest restorable revision, skipping corrupted
+        ones (a truncated/tampered snapshot raises on deserialize and
+        the supervisor falls back to the previous revision), then replay
+        the error-store backlog through the restored runtime.
+
+        Returns (restored_revision_or_None, events_replayed).
+        """
+        store = self.app._persistence_store()
+        restored = None
+        for rev in reversed(store.list_revisions(self.app.name)):
+            try:
+                self.app.restore_revision(rev)
+                restored = rev
+                break
+            except Exception as exc:  # noqa: BLE001 — corrupt revision
+                log.warning("app '%s': revision %s is not restorable "
+                            "(%s); falling back to the previous one",
+                            self.app.name, rev, exc)
+        if restored is not None:
+            self.last_revision = restored
+        replayed = 0
+        if replay_errors:
+            from .errorstore import replay
+            estore = self.error_store \
+                if self.error_store is not None else self.app._error_store()
+            replayed = replay(self.app, estore)
+        return restored, replayed

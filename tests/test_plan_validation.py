@@ -145,6 +145,41 @@ def test_dead_count_state_raises():
               "from every e1=A<3:2> -> e2=B select e2.y insert into Out;")
 
 
+def test_unknown_onerror_action_raises():
+    with pytest.raises(CompileError, match="on-error-action"):
+        parse("@OnError(action='EXPLODE')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_unknown_sink_on_error_action_raises():
+    with pytest.raises(CompileError, match="on-error-action"):
+        parse("@sink(type='inMemory', topic='t', on.error='NOPE')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_store_not_valid_for_source_on_error():
+    # sources have no events to store at connect time
+    with pytest.raises(CompileError, match="on-error-action"):
+        parse("@source(type='inMemory', topic='t', on.error='STORE')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_valid_on_error_actions_parse():
+    parse("""
+        @OnError(action='STORE')
+        define stream S (a int);
+        @sink(type='inMemory', topic='t', on.error='WAIT')
+        define stream Out (a int);
+        @source(type='inMemory', topic='u', on.error='WAIT')
+        define stream U (a int);
+        from S select a insert into Out;
+        from U select a insert into Out2;
+    """)
+
+
 # ---- advisory warnings do not raise -----------------------------------
 
 

@@ -1,0 +1,412 @@
+"""Fault-tolerance subsystem tests (siddhi_tpu/resilience/): error store
++ replay, on-error policies on junctions/sources/sinks, checkpoint
+supervision with corrupted-revision fallback, and the seeded chaos
+scenarios — recovery paths exercised under the FaultInjector instead of
+trusted on faith.
+"""
+import threading
+
+import pytest
+
+from siddhi_tpu import (CheckpointSupervisor, ErroredEvent, Event,
+                        FaultInjector, FileSystemErrorStore,
+                        InMemoryErrorStore, InMemoryPersistenceStore,
+                        SiddhiManager, StreamCallback)
+from siddhi_tpu.core import io as sio
+from siddhi_tpu.resilience.errorstore import replay
+from siddhi_tpu.resilience.scenarios import (
+    run_corrupt_snapshot_fallback, run_sink_outage_crash_recovery,
+    run_soak)
+
+PLAYBACK = "@app:playback "
+
+
+def build(ql, mgr=None, out=None):
+    mgr = mgr or SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = []
+    if out:
+        rt.add_callback(out, StreamCallback(fn=lambda e: got.extend(e)))
+    rt.start()
+    return rt, got
+
+
+# ---------------------------------------------------------------------------
+# error store
+# ---------------------------------------------------------------------------
+
+
+class TestErrorStore:
+    def _record(self, v=1):
+        return ErroredEvent.from_events(
+            "S", [Event(1000, (v,))], "RuntimeError: boom", attempts=3,
+            now=1234)
+
+    def test_in_memory_store_peek_drain(self):
+        store = InMemoryErrorStore()
+        store.store("app", self._record(1))
+        store.store("app", self._record(2))
+        assert store.size("app") == 2
+        peeked = store.peek("app")
+        assert len(peeked) == 2 and store.size("app") == 2
+        drained = store.drain("app")
+        assert [r.events[0][1] for r in drained] == [(1,), (2,)]
+        assert store.size("app") == 0
+
+    def test_record_round_trips_events(self):
+        rec = self._record(7)
+        assert rec.origin == "S" and rec.attempts == 3
+        assert rec.stored_at == 1234 and "boom" in rec.cause
+        (e,) = rec.to_events()
+        assert (e.timestamp, e.data, e.is_expired) == (1000, (7,), False)
+
+    def test_filesystem_store_round_trip(self, tmp_path):
+        store = FileSystemErrorStore(str(tmp_path))
+        store.store("app", self._record(1))
+        store.store("app", self._record(2))
+        files = list((tmp_path / "app").iterdir())
+        assert len(files) == 2
+        drained = store.drain("app")
+        assert [r.events[0][1] for r in drained] == [(1,), (2,)]
+        assert list((tmp_path / "app").iterdir()) == []
+        assert store.drain("app") == []
+
+    def test_replay_reinjects_through_junctions(self):
+        rt, got = build(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """, out="Out")
+        store = InMemoryErrorStore()
+        store.store(rt.name, ErroredEvent.from_events(
+            "S", [Event(1000, (5,)), Event(1001, (6,))], "X: y"))
+        assert replay(rt, store) == 2
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [5, 6]
+        assert store.size(rt.name) == 0
+
+    def test_replay_keeps_unroutable_records(self):
+        rt, _ = build(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """)
+        store = InMemoryErrorStore()
+        store.store(rt.name, ErroredEvent.from_events(
+            "Ghost", [Event(1000, (1,))], "X: y"))
+        assert replay(rt, store) == 0
+        rt.shutdown()
+        assert store.size(rt.name) == 1
+
+
+# ---------------------------------------------------------------------------
+# on-error policies
+# ---------------------------------------------------------------------------
+
+
+class TestSourceRetry:
+    def test_no_trailing_backoff_after_final_attempt(self, monkeypatch):
+        # the bug: one extra backoff sleep after the last failed try
+        sleeps = []
+        monkeypatch.setattr(sio.time, "sleep", sleeps.append)
+
+        class Down(sio.Source):
+            def connect(self):
+                raise sio.ConnectionUnavailableException("down")
+
+        src = Down({"on.error.max.attempts": "3"}, None, None)
+        with pytest.raises(sio.ConnectionUnavailableException,
+                           match="after 3 attempts"):
+            src.connect_with_retry()
+        assert len(sleeps) == 2   # between attempts only, not after
+
+    def test_wait_blocks_until_transport_returns(self, monkeypatch):
+        monkeypatch.setattr(sio.time, "sleep", lambda s: None)
+        calls = {"n": 0}
+
+        class Flaky(sio.Source):
+            def connect(self):
+                calls["n"] += 1
+                if calls["n"] < 30:   # far beyond any RETRY budget
+                    raise sio.ConnectionUnavailableException("down")
+
+        src = Flaky({"on.error": "WAIT"}, None, None)
+        src.connect_with_retry()
+        assert src.connected and calls["n"] == 30
+
+    def test_unknown_source_action_rejected(self):
+        with pytest.raises(ValueError, match="on.error"):
+            sio.InMemorySource({"topic": "t", "on.error": "EXPLODE"},
+                               None, None)
+
+
+class CollectSink(sio.Sink):
+    def __init__(self, options=None):
+        super().__init__(dict(options or {}), sio.PassThroughSinkMapper(None))
+        self.published = []
+
+    def publish(self, payload):
+        self.published.append(payload)
+
+
+class TestSinkPolicies:
+    def _events(self, *vals):
+        return [Event(1000 + i, (v,)) for i, v in enumerate(vals)]
+
+    def test_batch_remainder_survives_one_dead_event(self):
+        # the bug: one event exhausting retries raised out of receive()
+        # and dropped every later event in the batch
+        snk = CollectSink({"on.error.max.attempts": "2",
+                           "on.error.backoff.ms": "1"})
+        with FaultInjector(seed=1) as fi:
+            fi.break_sink(snk, match=lambda ev: ev.data[0] == 2)
+            snk.receive(self._events(1, 2, 3))
+        assert [e.data[0] for e in snk.published] == [1, 3]
+
+    def test_store_action_captures_failed_events(self):
+        mgr = SiddhiManager()
+        mgr.set_error_store(InMemoryErrorStore())
+        rt, _ = build(PLAYBACK + """
+            @app:name('sinkstore')
+            define stream S (v int);
+            @sink(type='inMemory', topic='ss.t', on.error='STORE',
+                  on.error.max.attempts='2', on.error.backoff.ms='1')
+            define stream Out (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """, mgr=mgr)
+        with FaultInjector(seed=2) as fi:
+            fi.break_sink(rt.sinks[0])
+            rt.get_input_handler("S").send(Event(1000, (9,)))
+        rt.shutdown()
+        (rec,) = mgr.error_store.drain("sinkstore")
+        assert rec.origin == "Out" and rec.attempts == 2
+        assert "ConnectionUnavailableException" in rec.cause
+        assert rec.events[0][1] == (9,)
+        assert rt.error_stats.count("Out") == 1
+
+    def test_stream_action_routes_to_fault_stream(self):
+        rt, got = build(PLAYBACK + """
+            @OnError(action='STREAM')
+            @sink(type='inMemory', topic='fs.t', on.error='STREAM',
+                  on.error.max.attempts='1')
+            define stream Out (v int);
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+            @info(name = 'f') from !Out select v, _error insert into F;
+        """, out="F")
+        with FaultInjector(seed=3) as fi:
+            fi.break_sink(rt.sinks[0])
+            rt.get_input_handler("S").send(Event(1000, (4,)))
+        rt.shutdown()
+        (e,) = got
+        assert e.data[0] == 4 and "injected sink outage" in e.data[1]
+
+    def test_wait_action_delivers_after_outage(self, monkeypatch):
+        monkeypatch.setattr(sio.time, "sleep", lambda s: None)
+        snk = CollectSink({"on.error": "WAIT"})
+        with FaultInjector(seed=4) as fi:
+            fi.break_sink(snk, fail=10)
+            snk.receive(self._events(1))
+        assert [e.data[0] for e in snk.published] == [1]
+
+    def test_unknown_sink_action_rejected(self):
+        with pytest.raises(ValueError, match="on.error"):
+            CollectSink({"on.error": "NOPE"})
+
+
+class TestJunctionOnError:
+    def test_store_action_and_error_counter(self, caplog):
+        mgr = SiddhiManager()
+        mgr.set_error_store(InMemoryErrorStore())
+        rt, _ = build(PLAYBACK + """
+            @app:name('jstore')
+            @OnError(action='STORE')
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Mid;
+        """, mgr=mgr)
+        cb = StreamCallback(fn=lambda evs: None)
+        rt.add_callback("S", cb)
+        with FaultInjector(seed=5) as fi:
+            fi.break_callback(cb, times=1)
+            with caplog.at_level("WARNING", logger="siddhi_tpu.stream"):
+                rt.get_input_handler("S").send(Event(1000, (3,)))
+        assert "error store" in caplog.text
+        assert rt.error_stats.count("S") == 1
+        assert rt.statistics()["stream_errors"] == {"S": 1}
+        # healed callback sees the event again on replay
+        got = []
+        cb._fn = lambda evs: got.extend(evs)
+        assert rt.replay_error_store() == 1
+        rt.shutdown()
+        assert [e.data[0] for e in got] == [3]
+        assert mgr.error_store.size("jstore") == 0
+
+    def test_log_action_uses_logging_not_stdout(self, caplog, capsys):
+        rt, _ = build(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Mid;
+        """)
+        cb = StreamCallback(fn=lambda evs: None)
+        rt.add_callback("S", cb)
+        with FaultInjector(seed=6) as fi:
+            fi.break_callback(cb, times=1)
+            with caplog.at_level("ERROR", logger="siddhi_tpu.stream"):
+                rt.get_input_handler("S").send(Event(1000, (1,)))
+        rt.shutdown()
+        assert "error processing events on stream 'S'" in caplog.text
+        assert "injected callback failure" in caplog.text  # exc_info
+        assert capsys.readouterr().out == ""   # no bare print
+        assert rt.error_stats.count("S") == 1
+
+
+# ---------------------------------------------------------------------------
+# broker thread-safety (sink publishing during source disconnect)
+# ---------------------------------------------------------------------------
+
+
+class TestBrokerThreadSafety:
+    def test_concurrent_publish_subscribe_unsubscribe(self):
+        topic = "broker.hammer"
+        errors = []
+        stop = threading.Event()
+
+        def churn():
+            try:
+                while not stop.is_set():
+                    fn = sio.InMemoryBroker.subscribe(topic,
+                                                      lambda m: None)
+                    sio.InMemoryBroker.unsubscribe(topic, fn)
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        def pump():
+            try:
+                while not stop.is_set():
+                    sio.InMemoryBroker.publish(topic, ("x",))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=t)
+                   for t in (churn, churn, pump, pump)]
+        for t in threads:
+            t.start()
+        stop_timer = threading.Timer(0.5, stop.set)
+        stop_timer.start()
+        for t in threads:
+            t.join(timeout=10)
+        stop_timer.cancel()
+        stop.set()
+        assert errors == []
+
+
+# ---------------------------------------------------------------------------
+# checkpoint supervisor
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointSupervisor:
+    def test_periodic_persist_on_playback_clock(self):
+        store = InMemoryPersistenceStore()
+        mgr = SiddhiManager()
+        mgr.set_persistence_store(store)
+        rt, _ = build(PLAYBACK + """
+            @app:name('sup')
+            define stream S (v int);
+            @info(name = 'q') from S select sum(v) as t insert into Out;
+        """, mgr=mgr)
+        sup = CheckpointSupervisor(rt, interval_ms=100).start(base_ms=1000)
+        for i in range(5):
+            rt.get_input_handler("S").send(Event(1000 + i * 60, (i,)))
+        rt.shutdown()
+        sup.stop()
+        # virtual span 1000..1240 crosses interval boundaries at 1100
+        # and 1200 -> two scheduled checkpoints
+        assert sup.checkpoints == 2 and sup.failures == 0
+        assert len(store.list_revisions("sup")) == 2
+        assert sup.last_revision == store.get_last_revision("sup")
+
+    def test_recover_falls_back_past_corrupt_revision(self):
+        res = run_corrupt_snapshot_fallback(seed=11)
+        assert res["fell_back"], res
+        assert res["restored"] == res["good_revision"]
+        assert res["post_restore_sums"] == res["expected_sums"]
+
+    def test_recover_with_no_revisions_replays_only(self):
+        mgr = SiddhiManager()
+        mgr.set_persistence_store(InMemoryPersistenceStore())
+        mgr.set_error_store(InMemoryErrorStore())
+        rt, got = build(PLAYBACK + """
+            @app:name('norev')
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """, mgr=mgr, out="Out")
+        mgr.error_store.store("norev", ErroredEvent.from_events(
+            "S", [Event(1000, (8,))], "X: y"))
+        restored, replayed = CheckpointSupervisor(rt).recover()
+        rt.shutdown()
+        assert restored is None and replayed == 1
+        assert [e.data[0] for e in got] == [8]
+
+
+# ---------------------------------------------------------------------------
+# chaos scenarios (the seeded fault-injection suite; tools/chaos.py runs
+# the same functions from the command line)
+# ---------------------------------------------------------------------------
+
+
+class TestChaos:
+    def test_sink_outage_crash_recovery_zero_loss(self):
+        """Acceptance: outage longer than the retry budget + mid-run
+        crash; the supervised restart restores the checkpoint and
+        replays the error-store backlog with zero event loss."""
+        res = run_sink_outage_crash_recovery(seed=7)
+        assert res["lost"] == [], res
+        assert res["stored_backlog"] == 4     # retry budget exhausted
+        assert res["restored"] == res["checkpoint"]
+        assert res["replayed"] == 4
+        # at-least-once, and here exactly-once: replay hit a healthy sink
+        assert res["duplicates"] == []
+
+    def test_outage_determinism_same_seed_same_outcome(self):
+        a = run_sink_outage_crash_recovery(seed=21, rate=0.6)
+        b = run_sink_outage_crash_recovery(seed=21, rate=0.6)
+        assert a["received"] == b["received"]
+        assert a["stored_backlog"] == b["stored_backlog"]
+
+    @pytest.mark.slow
+    def test_soak_many_rounds_never_lose_events(self):
+        for res in run_soak(seed=1, rounds=8):
+            assert res["lost"] == [], res
+
+    @pytest.mark.slow
+    def test_soak_filesystem_error_store(self, tmp_path):
+        # same outage flow, but the backlog survives via files on disk
+        from siddhi_tpu.core.io import InMemoryBroker
+        from siddhi_tpu.resilience import scenarios as sc
+        mgr = SiddhiManager()
+        mgr.set_persistence_store(InMemoryPersistenceStore())
+        mgr.set_error_store(FileSystemErrorStore(str(tmp_path)))
+        topic = sc._fresh_topic("fs")
+        ql = sc.OUTAGE_APP.format(topic=topic)
+        received = []
+        sub = InMemoryBroker.subscribe(topic,
+                                       lambda ev: received.append(
+                                           ev.data[0]))
+        try:
+            with FaultInjector(seed=13) as fi:
+                rt1 = mgr.create_siddhi_app_runtime(ql)
+                rt1.start()
+                for i in range(4):
+                    rt1.get_input_handler("S").send(Event(1000 + i, (i,)))
+                rt1.persist()
+                fi.break_sink(rt1.sinks[0])
+                for i in range(4, 8):
+                    rt1.get_input_handler("S").send(Event(1000 + i, (i,)))
+                rt1.running = False
+            assert mgr.error_store.size("chaos") == 4
+            rt2 = mgr.create_siddhi_app_runtime(ql)
+            rt2.start()
+            restored, replayed = CheckpointSupervisor(rt2).recover()
+            rt2.shutdown()
+        finally:
+            InMemoryBroker.unsubscribe(topic, sub)
+        assert restored is not None and replayed == 4
+        assert sorted(set(received)) == list(range(8))

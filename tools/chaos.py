@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Seeded fault-injection suite — the chaos entry point.
+
+Runs the deterministic recovery scenarios from
+siddhi_tpu/resilience/scenarios.py (the same functions the tier-1 tests
+in tests/test_resilience.py assert on) and reports loss/duplication per
+scenario. Every fault is drawn from one seeded RNG, so a failing run
+reproduces exactly from its seed.
+
+Usage (from anywhere):
+
+    python tools/chaos.py                  # fast suite, seed 0
+    python tools/chaos.py --seed 42        # different fault schedule
+    python tools/chaos.py --soak 25        # + 25 soak rounds (slow)
+
+Exits nonzero when any scenario loses an event or fails to fall back to
+a good checkpoint.
+"""
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def run(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seed", type=int, default=0,
+                    help="fault-schedule seed (default 0)")
+    ap.add_argument("--soak", type=int, default=0, metavar="ROUNDS",
+                    help="also run ROUNDS probabilistic soak rounds")
+    args = ap.parse_args(argv)
+
+    from siddhi_tpu.resilience.scenarios import (
+        run_corrupt_snapshot_fallback, run_sink_outage_crash_recovery,
+        run_soak)
+
+    failures = 0
+
+    def report(name: str, ok: bool, detail: str) -> None:
+        nonlocal failures
+        failures += 0 if ok else 1
+        print(f"[{'PASS' if ok else 'FAIL'}] {name}: {detail}")
+
+    res = run_sink_outage_crash_recovery(seed=args.seed)
+    report("sink-outage-crash-recovery",
+           not res["lost"] and res["restored"] == res["checkpoint"],
+           f"stored={res['stored_backlog']} replayed={res['replayed']} "
+           f"lost={res['lost']} duplicates={res['duplicates']}")
+
+    res = run_corrupt_snapshot_fallback(seed=args.seed)
+    report("corrupt-snapshot-fallback",
+           res["fell_back"]
+           and res["post_restore_sums"] == res["expected_sums"],
+           f"restored={res['restored']} "
+           f"sums={res['post_restore_sums']}")
+
+    if args.soak:
+        for i, r in enumerate(run_soak(seed=args.seed,
+                                       rounds=args.soak)):
+            report(f"soak-round-{i}", not r["lost"],
+                   f"stored={r['stored_backlog']} "
+                   f"replayed={r['replayed']} lost={r['lost']}")
+
+    status = "OK" if failures == 0 else f"{failures} scenario(s) FAILED"
+    print(f"chaos suite: {status} (seed {args.seed})")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())
