@@ -48,6 +48,35 @@ ASSUMED = {
     "seq5": 300_000.0,
 }
 
+# ---------------------------------------------------------------------------
+# time-budget knobs: the r5 harness run hit its timeout (rc=124, empty
+# tail), so the DEFAULT invocation must finish and print its JSON line
+# inside the round budget. Three dials, all env-overridable:
+#   SIDDHI_BENCH_SCALE       event-count multiplier (keeps chunk sizes
+#                            and compiled-program shapes IDENTICAL so
+#                            the .jax_cache still hits; only iteration
+#                            counts shrink)
+#   SIDDHI_BENCH_REPS        best-of-N repetitions per config
+#   SIDDHI_BENCH_BUDGET_S    per-config subprocess timeout
+#   SIDDHI_BENCH_DEADLINE_S  overall wall budget; configs that would
+#                            start after it report {"skipped": ...}
+# `python bench.py --quick` tightens all four for smoke runs;
+# SIDDHI_BENCH_SCALE=1 SIDDHI_BENCH_DEADLINE_S=3600 restores the full
+# r4-style measurement.
+# ---------------------------------------------------------------------------
+_env = os.environ.get
+SCALE = float(_env("SIDDHI_BENCH_SCALE", "0.5") or 0.5)
+REPS = int(_env("SIDDHI_BENCH_REPS", "3") or 3)
+BUDGET_S = float(_env("SIDDHI_BENCH_BUDGET_S", "240") or 240)
+DEADLINE_S = float(_env("SIDDHI_BENCH_DEADLINE_S", "420") or 420)
+
+
+def _scaled(n: int, chunk: int = 1) -> int:
+    """Scale an event count, rounded down to whole chunks (compiled step
+    shapes stay fixed — only the number of steps changes)."""
+    m = int(n * SCALE)
+    return max(chunk, (m // chunk) * chunk)
+
 SYMS = ("IBM", "WSO2", "GOOG", "MSFT")
 TS0 = 1_700_000_000_000
 
@@ -92,6 +121,7 @@ class _Last:
 
 
 def bench_filter(n=1_000_000):
+    n = _scaled(n)
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime("""
         @app:playback
@@ -117,12 +147,13 @@ def bench_filter(n=1_000_000):
     # best-of-3: one timed run is hostage to transient host contention
     # (the r4 driver capture measured 2-6x below the builder's runs)
     dt = min(_timed(lambda: (h.send_arrays(ts, [sym, price, vol]),
-                             _drain(outs))) for _ in range(3))
+                             _drain(outs))) for _ in range(REPS))
     rt.shutdown()
     return _entry("filter", n, dt)
 
 
 def bench_window_agg(n=1_000_000):
+    n = _scaled(n)
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime("""
         @app:playback
@@ -146,7 +177,7 @@ def bench_window_agg(n=1_000_000):
     h.send_arrays(ts, [sym, price, vol])
     _drain(outs)
     dt = min(_timed(lambda: (h.send_arrays(ts, [sym, price, vol]),
-                             _drain(outs))) for _ in range(3))
+                             _drain(outs))) for _ in range(REPS))
     rt.shutdown()
     return _entry("window_agg", n, dt)
 
@@ -156,6 +187,7 @@ def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int):
     built and emitted (the r3 bench capped output at 1024 pairs/step,
     silently dropping >99% on the 4-symbol workload and measuring only
     the condition grid); pairs_dropped in the result must be 0."""
+    n_side = _scaled(n_side, chunk)
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime(f"""
         @app:playback
@@ -190,7 +222,7 @@ def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int):
 
     n_chunks = n_side // chunk
     dts = []
-    for rep in range(3):   # best-of-3 (timestamps keep advancing)
+    for rep in range(REPS):   # best-of-N (timestamps keep advancing)
         base = 1 + rep * n_chunks
         t0 = time.perf_counter()
         for i in range(base, base + n_chunks):
@@ -241,6 +273,7 @@ def bench_join_fanout():
 
 def bench_seq2(n=262_144, chunk=65_536):
     """2-state sequence: Order -> Payment[oid == e1.oid] within 5 sec."""
+    n = _scaled(n, chunk)
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime("""
         @app:playback
@@ -269,7 +302,7 @@ def bench_seq2(n=262_144, chunk=65_536):
     _drain(outs)
     n_chunks = n // chunk
     dts = []
-    for rep in range(3):   # best-of-3 (timestamps keep advancing)
+    for rep in range(REPS):   # best-of-N (timestamps keep advancing)
         base = 1 + rep * n_chunks
         t0 = time.perf_counter()
         for i in range(base, base + n_chunks):
@@ -283,6 +316,7 @@ def bench_seq2(n=262_144, chunk=65_536):
 
 def bench_kleene(n=262_144, chunk=65_536):
     """every (A+ -> B) with count() and within — variable-length NFA."""
+    n = _scaled(n, chunk)
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime("""
         @app:playback
@@ -310,7 +344,7 @@ def bench_kleene(n=262_144, chunk=65_536):
     _drain(outs)
     n_chunks = n // chunk
     dts = []
-    for rep in range(3):   # best-of-3 (timestamps keep advancing)
+    for rep in range(REPS):   # best-of-N (timestamps keep advancing)
         base = 1 + rep * n_chunks
         t0 = time.perf_counter()
         for i in range(base, base + n_chunks):
@@ -325,6 +359,7 @@ def bench_kleene(n=262_144, chunk=65_536):
 def bench_seq5(n=1_048_576, chunk=65_536):
     """North star: 5-state pattern chain over a 1M-event replay, with
     per-chunk p50/p99 match latency (arrival -> match visible)."""
+    n = _scaled(n, chunk)
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime("""
         @app:playback
@@ -366,7 +401,7 @@ def bench_seq5(n=1_048_576, chunk=65_536):
     # reference harness also measures throughput streaming); best-of-3
     # so a transiently-contended host doesn't define the number
     dts = []
-    for _ in range(3):
+    for _ in range(REPS):
         t0 = time.perf_counter()
         for _ in range(n_chunks):
             h.send_arrays(*mk(chunk))
@@ -406,10 +441,12 @@ def bench_seq5(n=1_048_576, chunk=65_536):
 
 # join_fanout: the 2M-pair executable compiles server-side in ~2-2.5 min
 # (the tunnel backend does not reuse the client persistent cache for it)
-# — within the per-config subprocess budget, so it IS in the default
-# list. r5 measured: 494M joined pairs/s, 1.29M input ev/s, 0 drops.
-BENCHES = ("filter", "window_agg", "join", "join_fanout", "seq2",
-           "kleene", "seq5")
+# — r5's default run timed out on exactly this, so expensive configs run
+# LAST and get skipped when the wall deadline approaches; seq5 (the
+# headline metric) runs FIRST so the JSON line always has a value.
+# r5 measured: 494M joined pairs/s, 1.29M input ev/s, 0 drops.
+BENCHES = ("seq5", "filter", "window_agg", "seq2", "kleene", "join",
+           "join_fanout")
 
 
 def main():
@@ -422,17 +459,39 @@ def main():
     # (.jax_cache) keeps child startup cheap after the first ever run.
     import subprocess
     import sys
-    if len(sys.argv) > 1:
-        name = sys.argv[1]
+    argv = sys.argv[1:]
+    env = dict(os.environ)
+    if "--quick" in argv:
+        argv.remove("--quick")
+        env.setdefault("SIDDHI_BENCH_SCALE", "0.125")
+        env.setdefault("SIDDHI_BENCH_REPS", "1")
+        env.setdefault("SIDDHI_BENCH_BUDGET_S", "90")
+        env.setdefault("SIDDHI_BENCH_DEADLINE_S", "240")
+        globals().update(
+            SCALE=float(env["SIDDHI_BENCH_SCALE"]),
+            REPS=int(env["SIDDHI_BENCH_REPS"]),
+            BUDGET_S=float(env["SIDDHI_BENCH_BUDGET_S"]),
+            DEADLINE_S=float(env["SIDDHI_BENCH_DEADLINE_S"]))
+    if argv:
+        name = argv[0]
         print(json.dumps(globals()[f"bench_{name}"]()))
         return
     configs = {}
+    t0 = time.monotonic()
     for name in BENCHES:
+        remaining = DEADLINE_S - (time.monotonic() - t0)
+        if remaining < 20:
+            # out of wall budget: report the skip instead of hanging the
+            # whole invocation past the harness timeout (r5: rc=124)
+            configs[name] = {"skipped": "deadline",
+                             "deadline_s": DEADLINE_S}
+            continue
         proc = None
         try:
             proc = subprocess.run(
                 [sys.executable, __file__, name],
-                capture_output=True, text=True, timeout=900)
+                capture_output=True, text=True, env=env,
+                timeout=min(BUDGET_S, remaining))
             line = [ln for ln in proc.stdout.splitlines()
                     if ln.startswith("{")][-1]
             configs[name] = json.loads(line)
@@ -451,8 +510,9 @@ def main():
         "unit": "events/s",
         "vs_baseline": head["vs_baseline"],
         "baseline": "assumed",
-        "p99_match_latency_ms": head["p99_ms"],
-        "p99_match_latency_ms_1k": head["p99_ms_1k"],
+        "p99_match_latency_ms": head.get("p99_ms", -1),
+        "p99_match_latency_ms_1k": head.get("p99_ms_1k", -1),
+        "scale": SCALE,
         "configs": configs,
     }))
 

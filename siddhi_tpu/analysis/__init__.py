@@ -1,22 +1,32 @@
 """Static analysis for the siddhi_tpu codebase and its query plans.
 
-Two independent analyzers live here:
+Three independent analyzers live here:
 
 - the **TPU-hygiene linter** (`lint_paths` / `tools/lint.py`): pure
   Python-AST rules enforcing the JAX dispatch/tracing invariants the
   runtime depends on (see docs/tpu_hygiene.md) — no target code is ever
   imported;
-- the **query-plan validator** (`validate_app` / `check_app`): semantic
-  checks over `lang/ast.py` SiddhiApp plans, invoked by
-  `lang.parser.parse` so bad plans fail at compile time.
+- the **query-plan validator** (`plan_rules.validate_app` /
+  `check_app`): structural checks over `lang/ast.py` SiddhiApp plans
+  (undefined streams, window/aggregator arity, dead states), invoked by
+  `lang.parser.parse` so bad plans fail at compile time;
+- the **static type checker** (`typecheck.analyze_app` / `check_app`):
+  app-wide schema & dtype inference over the query dataflow graph —
+  inferred schemas for implicit insert-into streams, expression typing
+  mirroring ops/expr.py, insert-into schema compatibility, dead-dataflow
+  and float64-hot-path warnings (see docs/typecheck.md). Also invoked
+  by `lang.parser.parse`; query `.siddhi` files are checkable from the
+  CLI via `tools/lint.py --plan`.
 """
 from .findings import ERROR, WARNING, Finding
 from .linter import ModuleContext, lint_file, lint_paths, lint_source
 from .registry import all_rules, get_rule, rule_names
+from .schema import Schema, aggregator_result_type
 from . import jax_rules  # noqa: F401  (registers the TPU/JAX rules)
 
 __all__ = [
     "ERROR", "WARNING", "Finding", "ModuleContext",
     "lint_file", "lint_paths", "lint_source",
     "all_rules", "get_rule", "rule_names",
+    "Schema", "aggregator_result_type",
 ]

@@ -1,17 +1,35 @@
 """Command-line driver behind tools/lint.py.
 
-Exit codes: 0 clean (or everything baselined), 1 new findings,
+Two modes:
+
+- default: lint Python sources with the TPU-hygiene AST rules;
+- ``--plan``: treat PATHS as SiddhiQL sources (``.siddhi`` files or
+  directories of them) and run the query-plan validator + static type
+  checker over each — parse-time errors (undefined streams, schema
+  mismatches, string/numeric compares) exit nonzero, warnings (dead
+  dataflow, float64 hot-path) flow through the same baseline machinery
+  as the Python rules. File-scope suppression inside ``.siddhi``
+  sources: ``-- lint: disable=insert-coerce,dead-output``.
+
+Exit codes: 0 clean (or everything baselined), 1 new findings (in
+``--plan`` mode: any plan/type ERROR, baselined or not, also exits 1),
 2 usage/configuration error.
 """
 from __future__ import annotations
 
 import argparse
+import os
+import re
 import sys
 from typing import Optional
 
 from . import baseline as baseline_mod
+from .findings import ERROR, Finding
 from .linter import lint_paths
 from .registry import all_rules
+
+_SIDDHI_PRAGMA = re.compile(
+    r"--\s*lint:\s*disable(?:-file)?\s*=\s*(?P<rules>[\w*,\- ]+)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -20,6 +38,11 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-hygiene linter for the siddhi_tpu codebase")
     p.add_argument("paths", nargs="*", default=["siddhi_tpu"],
                    help="files/directories to lint (default: siddhi_tpu)")
+    p.add_argument("--plan", action="store_true",
+                   help="treat PATHS as SiddhiQL (.siddhi) files/"
+                        "directories and run the query-plan validator + "
+                        "static type checker instead of the Python rules; "
+                        "exits 1 on any plan/type error")
     p.add_argument("--root", default=None,
                    help="directory findings paths are made relative to "
                         "(default: cwd)")
@@ -38,6 +61,51 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def iter_siddhi_files(paths):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".siddhi"):
+                        yield os.path.join(root, f)
+
+
+def plan_findings(paths, root: Optional[str] = None) -> list[Finding]:
+    """Parse each .siddhi source and adapt plan/type issues to Findings
+    (file-scope `-- lint: disable=` pragmas applied)."""
+    from ..lang.parser import parse
+    from ..lang.tokens import SiddhiParserException
+    from .plan_rules import validate_app
+    from .typecheck import analyze_app, findings_from_issues
+
+    base = os.path.abspath(root or os.getcwd())
+    out: list[Finding] = []
+    for path in iter_siddhi_files(paths):
+        rel = os.path.relpath(os.path.abspath(path), base)
+        rel = rel.replace(os.sep, "/")
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+        disabled: set = set()
+        for m in _SIDDHI_PRAGMA.finditer(text):
+            disabled |= {r.strip() for r in m.group("rules").split(",")
+                         if r.strip()}
+        try:
+            app = parse(text, validate=False)
+        except SiddhiParserException as e:
+            out.append(Finding(rule="parse-error", severity=ERROR,
+                               path=rel, line=1, col=0, message=str(e)))
+            continue
+        issues = list(validate_app(app)) + list(analyze_app(app).issues)
+        for f in findings_from_issues(issues, rel):
+            if f.rule not in disabled and "*" not in disabled:
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
 def main(argv: Optional[list[str]] = None,
          stdout=None) -> int:
     out = stdout or sys.stdout
@@ -48,7 +116,10 @@ def main(argv: Optional[list[str]] = None,
             print(f"{r.name:24} {r.severity:8} {r.rationale}", file=out)
         return 0
 
-    findings = lint_paths(args.paths, root=args.root, rules=args.rules)
+    if args.plan:
+        findings = plan_findings(args.paths, root=args.root)
+    else:
+        findings = lint_paths(args.paths, root=args.root, rules=args.rules)
 
     if args.update_baseline:
         if not args.baseline:
@@ -78,4 +149,8 @@ def main(argv: Optional[list[str]] = None,
     if not args.quiet:
         print(f"{len(fresh)} new finding(s), {n_baselined} baselined, "
               f"{len(stale)} stale baseline entr(ies)", file=out)
+    if args.plan:
+        # plan/type ERRORS never grandfather (the app would not deploy);
+        # warnings are advisory — visible above, baselined as usual
+        return 1 if any(f.severity == ERROR for f in findings) else 0
     return 1 if fresh else 0

@@ -63,55 +63,11 @@ AGGREGATOR_ARITY: dict[str, tuple[int, int]] = {
 }
 
 
-def _iter_exprs(e) -> Iterator[A.Expression]:
-    """Depth-first walk over an expression tree (dataclass fields)."""
-    if not isinstance(e, A.Expression):
-        return
-    yield e
-    for f in dataclasses.fields(e):
-        v = getattr(e, f.name)
-        if isinstance(v, A.Expression):
-            yield from _iter_exprs(v)
-        elif isinstance(v, list):
-            for item in v:
-                yield from _iter_exprs(item)
-
-
-def _iter_state_elements(el) -> Iterator[A.StateElement]:
-    if el is None:
-        return
-    yield el
-    if isinstance(el, A.NextStateElement):
-        yield from _iter_state_elements(el.state)
-        yield from _iter_state_elements(el.next)
-    elif isinstance(el, A.EveryStateElement):
-        yield from _iter_state_elements(el.state)
-    elif isinstance(el, A.LogicalStateElement):
-        yield from _iter_state_elements(el.left)
-        yield from _iter_state_elements(el.right)
-    elif isinstance(el, A.CountStateElement):
-        yield from _iter_state_elements(el.stream)
-
-
-def _state_streams(el) -> Iterator[A.SingleInputStream]:
-    for sub in _iter_state_elements(el):
-        if isinstance(sub, A.StreamStateElement) and sub.stream is not None:
-            yield sub.stream
-
-
-def _query_inputs(q: A.Query) -> Iterator[A.SingleInputStream]:
-    """Every SingleInputStream a query reads from (joins/patterns/anon
-    streams flattened)."""
-    inp = q.input
-    if isinstance(inp, A.SingleInputStream):
-        yield inp
-    elif isinstance(inp, A.JoinInputStream):
-        yield inp.left
-        yield inp.right
-    elif isinstance(inp, A.StateInputStream):
-        yield from _state_streams(inp.state)
-    elif isinstance(inp, A.AnonymousInputStream) and inp.query is not None:
-        yield from _query_inputs(inp.query)
+# shared AST walkers (lang/ast.py) under the historical local names
+_iter_exprs = A.walk_expressions
+_iter_state_elements = A.iter_state_elements
+_state_streams = A.iter_state_streams
+_query_inputs = A.iter_query_inputs
 
 
 class PlanValidator:
@@ -209,7 +165,6 @@ class PlanValidator:
             if isinstance(iq.input, A.StateInputStream):
                 self.check_state_machine(iq.input, name)
         self.check_selector(q.selector, name)
-        self.check_attributes(q, name)
 
     def check_input_stream(self, sin: A.SingleInputStream, qname: str,
                            inner_scope: Optional[set]):
@@ -304,61 +259,11 @@ class PlanValidator:
                          f"state within {el.within_ms} ms can never be "
                          "satisfied")
 
-    # -- attribute resolution (conservative) ---------------------------
-    def check_attributes(self, q: A.Query, qname: str):
-        """Undefined-attribute check for plain single-stream queries.
-
-        Restricted to inputs whose schema is statically known (explicit
-        stream/table/window definition) with no schema-rewriting stream
-        functions in the chain; anything scoped more dynamically
-        (patterns, joins, aggregation refs) is left to the planner."""
-        sin = q.input
-        if not isinstance(sin, A.SingleInputStream) or sin.is_inner \
-                or sin.is_fault:
-            return
-        if any(isinstance(h, A.StreamFunction) for h in sin.handlers):
-            return
-        defn = self.app.stream_definitions.get(sin.stream_id) \
-            or self.app.table_definitions.get(sin.stream_id) \
-            or self.app.window_definitions.get(sin.stream_id)
-        if defn is None:
-            return
-        attrs = {a.name for a in defn.attributes}
-        table_ids = set(self.app.table_definitions)
-        own_refs = {sin.stream_id}
-        if sin.alias:
-            own_refs.add(sin.alias)
-
-        def scan(expr, where):
-            mentions_table = any(
-                isinstance(e, A.InTable)
-                or (isinstance(e, A.Variable) and e.stream_ref in table_ids)
-                for e in _iter_exprs(expr))
-            if mentions_table:
-                return  # table scopes resolve against the table schema
-            for e in _iter_exprs(expr):
-                if not isinstance(e, A.Variable):
-                    continue
-                if e.attribute is None or e.index is not None \
-                        or e.function_ref or e.is_inner or e.is_fault:
-                    continue
-                if e.attribute.startswith("__"):
-                    continue  # compiler-internal placeholders
-                if e.stream_ref is not None and e.stream_ref not in own_refs:
-                    continue  # cross-stream refs are planner territory
-                if e.attribute not in attrs:
-                    self.add("undefined-attribute", ERROR, qname,
-                             f"'{e.attribute}' is not an attribute of "
-                             f"stream '{sin.stream_id}' ({where})")
-
-        for h in sin.handlers:
-            if isinstance(h, A.Filter):
-                scan(h.expression, "filter")
-        if not q.selector.select_all:
-            for oa in q.selector.attributes:
-                scan(oa.expression, "select")
-        for g in q.selector.group_by:
-            scan(g, "group by")
+    # NOTE: the conservative single-stream undefined-attribute check
+    # that used to live here (PR 1 `check_attributes`) is subsumed by
+    # the app-wide static type checker (analysis/typecheck.py), which
+    # resolves attributes alias-scoped across joins, patterns and
+    # inferred implicit-stream schemas. The parser runs both passes.
 
 
 def validate_app(app: A.SiddhiApp) -> list[PlanIssue]:

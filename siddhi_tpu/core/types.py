@@ -56,23 +56,47 @@ def np_dtype(t: AttrType):
     return _NP_DTYPES[t]
 
 
-_PROMOTION_ORDER = {
+# Shared promotion lattice (exported: ops/expr.py applies it at compile
+# time, analysis/typecheck.py mirrors it statically — one table, not two)
+PROMOTION_ORDER = {
     AttrType.INT: 0,
     AttrType.LONG: 1,
     AttrType.FLOAT: 2,
     AttrType.DOUBLE: 3,
 }
+_PROMOTION_ORDER = PROMOTION_ORDER  # backward-compat alias
 
 
 def promote(a: AttrType, b: AttrType) -> AttrType:
     """Java binary numeric promotion: the wider of the two operand types."""
-    if a not in _PROMOTION_ORDER or b not in _PROMOTION_ORDER:
+    if a not in PROMOTION_ORDER or b not in PROMOTION_ORDER:
         raise TypeError(f"cannot apply numeric promotion to {a} and {b}")
-    order = max(_PROMOTION_ORDER[a], _PROMOTION_ORDER[b])
-    for t, o in _PROMOTION_ORDER.items():
+    order = max(PROMOTION_ORDER[a], PROMOTION_ORDER[b])
+    for t, o in PROMOTION_ORDER.items():
         if o == order:
             return t
     raise AssertionError
+
+
+def can_coerce(src: AttrType, dst: AttrType) -> bool:
+    """Whether a value of `src` widens losslessly-enough into a `dst`
+    column under the promotion lattice (int->long->float->double).
+    Equal types always coerce; non-numeric types only to themselves."""
+    if src is dst:
+        return True
+    if src in PROMOTION_ORDER and dst in PROMOTION_ORDER:
+        return PROMOTION_ORDER[src] <= PROMOTION_ORDER[dst]
+    return False
+
+
+def comparable(a: AttrType, b: AttrType) -> bool:
+    """Whether `a <op> b` has device compare semantics: numeric pairs
+    promote; STRING/BOOL compare only against themselves (STRING travels
+    as int32 dictionary codes — comparing a code against a number is
+    meaningless, so STRING vs numeric is rejected, never coerced)."""
+    if a in NUMERIC_TYPES and b in NUMERIC_TYPES:
+        return True
+    return a is b and a in (AttrType.STRING, AttrType.BOOL)
 
 
 # interned marker object for uuid() sentinel codes (identity-compared)

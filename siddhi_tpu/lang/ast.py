@@ -145,6 +145,7 @@ class StreamDefinition:
     annotations: list[Annotation] = dataclasses.field(default_factory=list)
     is_inner: bool = False
     is_fault: bool = False
+    line: Optional[int] = None  # 1-based source line (parser-populated)
 
 
 @dataclasses.dataclass
@@ -417,6 +418,7 @@ class Query:
     output: OutputStream = None
     output_rate: Optional[OutputRate] = None
     annotations: list[Annotation] = dataclasses.field(default_factory=list)
+    line: Optional[int] = None  # 1-based source line (parser-populated)
 
     @property
     def name(self) -> Optional[str]:
@@ -444,6 +446,7 @@ class Partition:
     partition_types: list[PartitionType] = dataclasses.field(default_factory=list)
     queries: list[Query] = dataclasses.field(default_factory=list)
     annotations: list[Annotation] = dataclasses.field(default_factory=list)
+    line: Optional[int] = None  # 1-based source line (parser-populated)
 
 
 @dataclasses.dataclass
@@ -456,6 +459,74 @@ class OnDemandQuery:
     per: Optional[Expression] = None
     selector: Selector = dataclasses.field(default_factory=Selector)
     output: Optional[OutputStream] = None  # None == find/select
+
+
+# --------------------------------------------------------------------------
+# Tree walkers — shared by the static analyzers (analysis/plan_rules.py,
+# analysis/typecheck.py) and anything else that needs a generic traversal.
+# --------------------------------------------------------------------------
+
+
+def walk_expressions(e):
+    """Depth-first walk over an expression tree (dataclass fields)."""
+    if not isinstance(e, Expression):
+        return
+    yield e
+    for f in dataclasses.fields(e):
+        v = getattr(e, f.name)
+        if isinstance(v, Expression):
+            yield from walk_expressions(v)
+        elif isinstance(v, list):
+            for item in v:
+                yield from walk_expressions(item)
+
+
+def iter_state_elements(el):
+    """Every StateElement in a pattern/sequence tree, self included."""
+    if el is None:
+        return
+    yield el
+    if isinstance(el, NextStateElement):
+        yield from iter_state_elements(el.state)
+        yield from iter_state_elements(el.next)
+    elif isinstance(el, EveryStateElement):
+        yield from iter_state_elements(el.state)
+    elif isinstance(el, LogicalStateElement):
+        yield from iter_state_elements(el.left)
+        yield from iter_state_elements(el.right)
+    elif isinstance(el, CountStateElement):
+        yield from iter_state_elements(el.stream)
+
+
+def iter_state_streams(el):
+    """Every SingleInputStream referenced by a state tree."""
+    for sub in iter_state_elements(el):
+        if isinstance(sub, StreamStateElement) and sub.stream is not None:
+            yield sub.stream
+
+
+def iter_query_inputs(q: "Query"):
+    """Every SingleInputStream a query reads from (joins/patterns/anon
+    streams flattened)."""
+    inp = q.input
+    if isinstance(inp, SingleInputStream):
+        yield inp
+    elif isinstance(inp, JoinInputStream):
+        yield inp.left
+        yield inp.right
+    elif isinstance(inp, StateInputStream):
+        yield from iter_state_streams(inp.state)
+    elif isinstance(inp, AnonymousInputStream) and inp.query is not None:
+        yield from iter_query_inputs(inp.query)
+
+
+def iter_queries(app: "SiddhiApp"):
+    """Every query of an app, partition-nested ones included."""
+    for el in app.execution_elements:
+        if isinstance(el, Query):
+            yield el
+        elif isinstance(el, Partition):
+            yield from el.queries
 
 
 @dataclasses.dataclass

@@ -283,13 +283,14 @@ class Parser:
     # definitions
     # ------------------------------------------------------------------ #
     def _parse_definition(self, app: A.SiddhiApp, annotations):
+        line = self.peek().line
         self.expect_kw("define")
         if self.accept_kw("stream"):
             is_inner, is_fault, sid = self._parse_source_name()
             attrs = self._parse_attr_list()
             app.stream_definitions[sid] = A.StreamDefinition(
                 stream_id=sid, attributes=attrs, annotations=annotations,
-                is_inner=is_inner, is_fault=is_fault)
+                is_inner=is_inner, is_fault=is_fault, line=line)
         elif self.accept_kw("table"):
             _, _, tid = self._parse_source_name()
             attrs = self._parse_attr_list()
@@ -403,7 +404,7 @@ class Parser:
     # queries
     # ------------------------------------------------------------------ #
     def parse_query(self, annotations=None) -> A.Query:
-        q = A.Query(annotations=annotations or [])
+        q = A.Query(annotations=annotations or [], line=self.peek().line)
         self.expect_kw("from")
         q.input = self.parse_query_input()
         if self.at_kw("select"):
@@ -850,10 +851,11 @@ class Parser:
 
     # ---- partition --------------------------------------------------- #
     def parse_partition(self, annotations=None) -> A.Partition:
+        line = self.peek().line
         self.expect_kw("partition")
         self.expect_kw("with")
         self.expect_op("(")
-        p = A.Partition(annotations=annotations or [])
+        p = A.Partition(annotations=annotations or [], line=line)
         while True:
             p.partition_types.append(self._parse_partition_with())
             if not self.accept_op(","):
@@ -1128,16 +1130,20 @@ class Parser:
 def parse(text: str, validate: bool = True) -> A.SiddhiApp:
     """Parse a SiddhiQL app and statically validate the plan.
 
-    Validation (analysis/plan_rules.py) raises CompileError here — at
-    compile time, with the query name and construct — for plans the
-    runtime planner would otherwise reject later as shape errors deep
-    inside a jitted step: undefined streams, window/aggregator arity,
-    states that can never fire. ``validate=False`` skips it (the planner
-    still applies its own checks)."""
+    Validation raises CompileError here — at compile time, with the
+    query name and construct — for plans the runtime planner would
+    otherwise reject later as shape errors deep inside a jitted step:
+    undefined streams, window/aggregator arity, states that can never
+    fire (analysis/plan_rules.py), plus everything type-shaped — schema
+    inference over the dataflow graph, expression dtypes, insert-into
+    schema compatibility (analysis/typecheck.py). ``validate=False``
+    skips both (the planner still applies its own checks)."""
     app = Parser(update_variables(text)).parse_app()
     if validate:
         from ..analysis.plan_rules import check_app
+        from ..analysis.typecheck import check_app as check_types
         check_app(app)
+        check_types(app)
     return app
 
 

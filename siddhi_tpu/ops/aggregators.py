@@ -37,6 +37,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from ..analysis.schema import aggregator_result_type
 from ..core.event import (CURRENT, EXPIRED, RESET, Attribute, EventBatch,
                           StreamSchema)
 from ..core.types import AttrType, NUMERIC_TYPES, np_dtype, promote
@@ -114,9 +115,9 @@ class SumAgg(AggSpec):
         if arg_type not in NUMERIC_TYPES:
             raise CompileError(f"sum() requires numeric input, got {arg_type}")
         self.name = "sum"
-        self.out_type = (AttrType.LONG if arg_type in (AttrType.INT,
-                                                       AttrType.LONG)
-                         else AttrType.DOUBLE)
+        # shared result-typing rule (analysis/schema.py): LONG for
+        # integral inputs, DOUBLE for floating — mirrored statically
+        self.out_type = aggregator_result_type("sum", arg_type)
         self.acc_dtype = np_dtype(self.out_type)
         self.lanes = (Lane("sum", self.acc_dtype), Lane("sum", jnp.int64))
 
@@ -139,7 +140,7 @@ class AvgAgg(AggSpec):
         if arg_type not in NUMERIC_TYPES:
             raise CompileError(f"avg() requires numeric input, got {arg_type}")
         self.name = "avg"
-        self.out_type = AttrType.DOUBLE
+        self.out_type = aggregator_result_type("avg", arg_type)
         self.lanes = (Lane("sum", jnp.float64), Lane("sum", jnp.int64))
 
     def contribs(self, arg, is_add, is_remove):
@@ -161,7 +162,7 @@ class CountAgg(AggSpec):
 
     def __init__(self):
         self.name = "count"
-        self.out_type = AttrType.LONG
+        self.out_type = aggregator_result_type("count", None)
         self.lanes = (Lane("sum", jnp.int64),)
 
     def contribs(self, arg, is_add, is_remove):
@@ -183,7 +184,7 @@ class StdDevAgg(AggSpec):
             raise CompileError(
                 f"stdDev() requires numeric input, got {arg_type}")
         self.name = "stdDev"
-        self.out_type = AttrType.DOUBLE
+        self.out_type = aggregator_result_type("stddev", arg_type)
         self.lanes = (Lane("sum", jnp.float64), Lane("sum", jnp.float64),
                       Lane("sum", jnp.int64))
 
@@ -213,7 +214,7 @@ class MinMaxAgg(AggSpec):
         if arg_type not in NUMERIC_TYPES:
             raise CompileError("min()/max() requires numeric input")
         self.name = "max" if is_max else "min"
-        self.out_type = arg_type
+        self.out_type = aggregator_result_type(self.name, arg_type)
         self.dtype = np_dtype(arg_type)
         self.lanes = (Lane("max" if is_max else "min", self.dtype),
                       Lane("sum", jnp.int64))
@@ -256,7 +257,7 @@ class BoolAgg(AggSpec):
             raise CompileError("and()/or() requires BOOL input")
         self.name = "and" if is_and else "or"
         self.is_and = is_and
-        self.out_type = AttrType.BOOL
+        self.out_type = aggregator_result_type(self.name, arg_type)
         self.lanes = (Lane("sum", jnp.int64), Lane("sum", jnp.int64))
 
     def contribs(self, arg, is_add, is_remove):
@@ -292,7 +293,7 @@ class DistinctCountAgg(AggSpec):
         if arg_type is None:
             raise CompileError("distinctCount() needs an argument")
         self.name = "distinctCount"
-        self.out_type = AttrType.LONG
+        self.out_type = aggregator_result_type("distinctcount", arg_type)
         self.lanes = (Lane("sum", jnp.int64),)
 
     def init_table(self, K: int):
@@ -398,7 +399,7 @@ class UnionSetAgg(AggSpec):
             raise CompileError(
                 "unionSet() with group by is not supported yet")
         self.name = "unionSet"
-        self.out_type = AttrType.OBJECT
+        self.out_type = aggregator_result_type("unionset", arg_type)
         self.S = SET_LANES
         self.lanes = (Lane("sum", jnp.int64),)
 
@@ -491,7 +492,7 @@ class SlidingMinMaxAgg(AggSpec):
             raise CompileError("min()/max() requires numeric input")
         self.name = "max" if is_max else "min"
         self.is_max = is_max
-        self.out_type = arg_type
+        self.out_type = aggregator_result_type(self.name, arg_type)
         self.dtype = np_dtype(arg_type)
         self.W = 256 if grouped else 4096  # ring capacity per key
         self.lanes = (Lane("max" if is_max else "min", self.dtype),

@@ -26,7 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.types import AttrType, GLOBAL_STRINGS, NUMERIC_TYPES, np_dtype, promote
+from ..core.types import (AttrType, GLOBAL_STRINGS, NUMERIC_TYPES,
+                          comparable, np_dtype, promote)
 from ..lang import ast as A
 
 
@@ -268,6 +269,19 @@ def _compile_math(e: A.MathOp, comp) -> CompiledExpr:
 def _compile_compare(e: A.Compare, comp) -> CompiledExpr:
     l, r = comp(e.left), comp(e.right)
     op = e.op
+    if not comparable(l.type, r.type):
+        # defense in depth for the static `string-numeric-compare` rule:
+        # STRING columns are int32 dictionary codes on device, so a
+        # STRING vs numeric comparison would relate codes, not text —
+        # reject it explicitly instead of ever falling into a numeric
+        # path (STRING vs STRING equality stays supported below)
+        if (l.type is AttrType.STRING) != (r.type is AttrType.STRING):
+            other = r.type if l.type is AttrType.STRING else l.type
+            raise CompileError(
+                f"cannot compare STRING with {other}: device strings "
+                "are int32 dictionary codes — the comparison would "
+                "relate codes, not text")
+        raise CompileError(f"cannot compare {l.type} with {r.type}")
     if l.type in NUMERIC_TYPES and r.type in NUMERIC_TYPES:
         t = promote(l.type, r.type)
         dt = np_dtype(t)
@@ -281,19 +295,17 @@ def _compile_compare(e: A.Compare, comp) -> CompiledExpr:
             return Col(v, jnp.zeros_like(v))
         return CompiledExpr(AttrType.BOOL, fn)
 
-    if l.type == r.type and l.type in (AttrType.STRING, AttrType.BOOL):
-        if op not in ("==", "!=") and l.type is AttrType.STRING:
-            raise CompileError(
-                "ordering comparison on STRING is not supported on device")
+    # comparable() guarantees same-type STRING/BOOL here
+    if op not in ("==", "!=") and l.type is AttrType.STRING:
+        raise CompileError(
+            "ordering comparison on STRING is not supported on device")
 
-        def fn(env):
-            lc, rc = l.fn(env), r.fn(env)
-            v = _cmp(op, lc.values, rc.values)
-            v = v & ~(lc.nulls | rc.nulls)
-            return Col(v, jnp.zeros_like(v))
-        return CompiledExpr(AttrType.BOOL, fn)
-
-    raise CompileError(f"cannot compare {l.type} with {r.type}")
+    def fn(env):
+        lc, rc = l.fn(env), r.fn(env)
+        v = _cmp(op, lc.values, rc.values)
+        v = v & ~(lc.nulls | rc.nulls)
+        return Col(v, jnp.zeros_like(v))
+    return CompiledExpr(AttrType.BOOL, fn)
 
 
 def _cmp(op, lv, rv):

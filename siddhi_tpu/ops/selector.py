@@ -18,11 +18,9 @@ from .expr import Col, CompileError, CompiledExpr, Scope, compile_expression, en
 from .keyed import cumsum_fast
 from .operators import Operator
 
-# aggregator function names recognized in select clauses
-AGGREGATOR_NAMES = {
-    "sum", "avg", "count", "distinctcount", "min", "max", "minforever",
-    "maxforever", "stddev", "and", "or", "unionset",
-}
+# aggregator function names recognized in select clauses — single
+# source of truth lives with the static typing rules
+from ..analysis.schema import AGGREGATOR_NAMES  # noqa: E402
 
 
 def has_aggregators(expr: A.Expression) -> bool:

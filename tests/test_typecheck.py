@@ -1,0 +1,483 @@
+"""Static type checker tests (analysis/typecheck.py): schema inference
+over the query dataflow graph, expression dtype rules mirroring
+ops/expr.py, insert-into schema compatibility, dead-dataflow and
+float64 warnings — with both error fixtures (CompileError at parse
+time) and clean-pass fixtures, plus a corpus sweep asserting zero false
+positives on the real Siddhi test-suite queries.
+"""
+import json
+import pathlib
+
+import pytest
+
+from siddhi_tpu.analysis.schema import (AGGREGATOR_NAMES,
+                                        aggregator_result_type)
+from siddhi_tpu.analysis.typecheck import analyze_app
+from siddhi_tpu.core.types import AttrType, can_coerce, comparable
+from siddhi_tpu.lang import ast as A
+from siddhi_tpu.lang.parser import parse
+from siddhi_tpu.lang.tokens import SiddhiParserException
+from siddhi_tpu.ops.expr import CompileError
+
+
+def report(text):
+    return analyze_app(parse(text, validate=False))
+
+
+def codes(issues):
+    return sorted({i.code for i in issues})
+
+
+# ---- schema inference over the dataflow graph --------------------------
+
+
+def test_implicit_stream_schema_inferred():
+    r = report("""
+        define stream S (symbol string, price float, volume long);
+        from S select symbol, price * 2 as p2 insert into Mid;
+        from Mid select p2 insert into Out;
+    """)
+    assert r.errors == []
+    assert r.schemas["Mid"].attrs == (
+        ("symbol", AttrType.STRING), ("p2", AttrType.FLOAT))
+    assert r.schemas["Out"].attrs == (("p2", AttrType.FLOAT),)
+
+
+def test_aggregator_result_types_inferred():
+    r = report("""
+        define stream S (sym string, price float, vol long, n int);
+        from S select avg(price) as ap, count() as c, sum(n) as sn,
+                      sum(price) as sp, max(n) as mx, stdDev(price) as sd
+        group by sym insert into AggOut;
+    """)
+    assert r.errors == []
+    assert r.schemas["AggOut"].attrs == (
+        ("ap", AttrType.DOUBLE), ("c", AttrType.LONG),
+        ("sn", AttrType.LONG), ("sp", AttrType.DOUBLE),
+        ("mx", AttrType.INT), ("sd", AttrType.DOUBLE))
+
+
+def test_chained_inference_through_three_queries():
+    r = report("""
+        define stream S (a int);
+        from S select a, a + 1 as b insert into M1;
+        from M1 select b * 2 as c insert into M2;
+        from M2[c > 0] select c insert into Out;
+    """)
+    assert r.errors == []
+    assert r.schemas["Out"].attrs == (("c", AttrType.INT),)
+
+
+def test_select_star_passthrough_and_join_combined():
+    r = report("""
+        define stream L (x int, u long);
+        define stream R (y int);
+        from L select * insert into Copy;
+        from L#window.length(3) join R#window.length(3) on L.x == R.y
+        select * insert into J;
+    """)
+    assert r.schemas["Copy"].names == ("x", "u")
+    assert r.schemas["J"].attrs == (
+        ("x", AttrType.INT), ("u", AttrType.LONG), ("y", AttrType.INT))
+
+
+def test_pattern_select_star_flattens_cap1_slots():
+    r = report("""
+        define stream A (x int);
+        define stream B (y long);
+        from every e1=A -> e2=B select * insert into Out;
+    """)
+    assert r.schemas["Out"].attrs == (
+        ("e1_x", AttrType.INT), ("e2_y", AttrType.LONG))
+
+
+def test_math_promotion_mirrors_expr_compiler():
+    r = report("""
+        define stream S (i int, l long, f float, d double);
+        from S select i + l as a, i * f as b, l / d as c, i % i as e
+        insert into Out;
+    """)
+    assert [t for _, t in r.schemas["Out"].attrs] == [
+        AttrType.LONG, AttrType.FLOAT, AttrType.DOUBLE, AttrType.INT]
+
+
+# ---- error fixtures: CompileError at parse time ------------------------
+
+
+def test_insert_arity_mismatch_raises_at_parse_time():
+    # previously a runtime-only junction_for rejection
+    with pytest.raises(CompileError, match="insert-arity"):
+        parse("""
+            define stream S (a int, b int);
+            define stream Out (a int, b int, c int);
+            from S select a, b insert into Out;
+        """)
+
+
+def test_insert_type_mismatch_raises():
+    with pytest.raises(CompileError, match="insert-type"):
+        parse("""
+            define stream S (a int, s string);
+            define stream Out (a int, s long);
+            from S select a, s insert into Out;
+        """)
+
+
+def test_insert_coercible_widening_warns_but_parses():
+    app = parse("""
+        define stream S (a int);
+        define stream Out (a long);
+        from S select a insert into Out;
+    """, validate=False)
+    r = analyze_app(app)
+    assert codes(r.errors) == []
+    assert "insert-coerce" in codes(r.warnings)
+
+
+def test_conflicting_implicit_schemas_raise():
+    with pytest.raises(CompileError, match="implicit-schema-conflict"):
+        parse("""
+            define stream S (a int, s string);
+            from S select a insert into Mid;
+            from S select s insert into Mid;
+        """)
+
+
+def test_inner_stream_conflict_raises():
+    with pytest.raises(CompileError, match="implicit-schema-conflict"):
+        parse("""
+            define stream S (sym string, v int);
+            partition with (sym of S) begin
+                from S select v insert into #m;
+                from S select sym insert into #m;
+            end;
+        """)
+
+
+def test_string_numeric_compare_raises():
+    with pytest.raises(CompileError, match="string-numeric-compare"):
+        parse("define stream S (sym string, v int);\n"
+              "from S[sym == 3] select v insert into Out;")
+
+
+def test_string_ordering_raises():
+    with pytest.raises(CompileError, match="string-ordering"):
+        parse("define stream S (a string, b string);\n"
+              "from S[a < b] select a insert into Out;")
+
+
+def test_bool_numeric_compare_raises():
+    with pytest.raises(CompileError, match="incomparable-types"):
+        parse("define stream S (f bool, v int);\n"
+              "from S[f == v] select v insert into Out;")
+
+
+def test_non_bool_filter_raises():
+    with pytest.raises(CompileError, match="non-bool-filter"):
+        parse("define stream S (v int);\n"
+              "from S[v + 1] select v insert into Out;")
+
+
+def test_non_bool_having_raises():
+    with pytest.raises(CompileError, match="non-bool-having"):
+        parse("define stream S (v int);\n"
+              "from S select sum(v) as t having t + 1 insert into Out;")
+
+
+def test_non_numeric_math_raises():
+    with pytest.raises(CompileError, match="non-numeric-math"):
+        parse("define stream S (s string, v int);\n"
+              "from S select s + v as x insert into Out;")
+
+
+def test_non_bool_logical_raises():
+    with pytest.raises(CompileError, match="non-bool-logical"):
+        parse("define stream S (v int);\n"
+              "from S[v and v > 2] select v insert into Out;")
+
+
+def test_aggregator_input_type_raises():
+    with pytest.raises(CompileError, match="aggregator-input"):
+        parse("define stream S (sym string);\n"
+              "from S select avg(sym) as a insert into Out;")
+
+
+def test_undefined_attribute_in_inferred_schema_raises():
+    # resolution against an INFERRED (implicit-stream) schema
+    with pytest.raises(CompileError, match="undefined-attribute"):
+        parse("""
+            define stream S (a int);
+            from S select a as renamed insert into Mid;
+            from Mid select a insert into Out;
+        """)
+
+
+def test_join_alias_replaces_stream_id():
+    # mirror of ops/join.py: `as x` makes the original id unresolvable
+    with pytest.raises(CompileError, match="unresolved-reference"):
+        parse("""
+            define stream L (x int);
+            define stream R (y int);
+            from L as l join R#window.length(2) on L.x == R.y
+            select l.x insert into Out;
+        """)
+
+
+def test_join_attribute_resolution_errors():
+    with pytest.raises(CompileError, match="undefined-attribute"):
+        parse("""
+            define stream L (x int);
+            define stream R (y int);
+            from L#window.length(2) join R#window.length(2)
+            on L.nope == R.y select R.y insert into Out;
+        """)
+
+
+def test_join_ambiguous_attribute_raises():
+    with pytest.raises(CompileError, match="unresolved-reference"):
+        parse("""
+            define stream L (x int);
+            define stream R (x int);
+            from L#window.length(2) join R#window.length(2)
+            select x as out insert into Out;
+        """)
+
+
+def test_pattern_event_ref_resolution():
+    with pytest.raises(CompileError, match="undefined-attribute"):
+        parse("""
+            define stream A (x int);
+            define stream B (y int);
+            from every e1=A -> e2=B[y > e1.nope]
+            select e1.x insert into Out;
+        """)
+
+
+def test_pattern_cross_state_predicate_types():
+    # e2's condition references e1 alias-scoped; string/numeric mismatch
+    # inside a pattern condition must still be caught
+    with pytest.raises(CompileError, match="string-numeric-compare"):
+        parse("""
+            define stream A (sym string);
+            define stream B (v int);
+            from every e1=A -> e2=B[v == e1.sym]
+            select e2.v insert into Out;
+        """)
+
+
+# ---- clean passes (no false positives) ---------------------------------
+
+
+def test_clean_pattern_join_partition_app():
+    r = report("""
+        define stream A (sym string, x int);
+        define stream B (sym string, y int);
+        from every e1=A[x > 0] -> e2=B[sym == e1.sym]
+        select e1.sym as s, e1.x + e2.y as t insert into P;
+        from A#window.length(5) as l join B#window.length(5) as r
+        on l.sym == r.sym select l.sym as s, l.x + r.y as t
+        insert into P;
+        partition with (sym of A) begin
+            from A select sym, x * 2 as x2 insert into #m;
+            from #m[x2 > 0] select sym, x2 insert into POut;
+        end;
+    """)
+    assert r.errors == []
+    # both producers agree on P's schema: no conflict
+    assert r.schemas["P"].attrs == (
+        ("s", AttrType.STRING), ("t", AttrType.INT))
+
+
+def test_unknown_functions_suppress_not_error():
+    # extension/namespaced functions are planner territory: unknown
+    # result types must not cascade into false insert-type errors
+    r = report("""
+        define stream S (v int);
+        define stream Out (x double);
+        from S select custom:thing(v) as x insert into Out;
+    """)
+    assert r.errors == []
+
+
+def test_convert_and_udf_return_types():
+    r = report("""
+        define function dbl[python] return double { return v * 2.0 };
+        define stream S (v int);
+        from S select convert(v, 'long') as lv, dbl(v) as dv
+        insert into Out;
+    """)
+    assert r.errors == []
+    assert r.schemas["Out"].attrs == (
+        ("lv", AttrType.LONG), ("dv", AttrType.DOUBLE))
+
+
+def test_table_scoped_expressions_skipped():
+    app = parse("""
+        define stream S (a int);
+        define table T (b int);
+        from S[a in T] select a insert into Out;
+    """)
+    assert codes(analyze_app(app).errors) == []
+
+
+# ---- warnings ----------------------------------------------------------
+
+
+def test_dead_stream_warning():
+    r = report("""
+        define stream S (a int);
+        define stream Orphan (b int);
+        from S select a insert into Out;
+    """)
+    assert "dead-stream" in codes(r.warnings)
+    assert all(i.code != "dead-stream" or "Orphan" in i.message
+               for i in r.warnings)
+
+
+def test_dead_output_warning():
+    r = report("""
+        define stream S (a int);
+        from S select a insert into Nowhere;
+    """)
+    assert "dead-output" in codes(r.warnings)
+
+
+def test_float64_hot_path_warning():
+    r = report("""
+        define stream S (price double);
+        from S select price insert into Out;
+    """)
+    w = [i for i in r.warnings if i.code == "float64-hot-path"]
+    assert w and any("price" in i.message for i in w)
+    assert any("tpu_hygiene" in i.message for i in w)
+
+
+def test_trigger_stream_insert_checked():
+    r = report("""
+        define stream S (a int);
+        define trigger T5 at every 5 sec;
+        from T5 select triggered_time insert into Out;
+    """)
+    assert r.errors == []
+    assert r.schemas["Out"].attrs == (("triggered_time", AttrType.LONG),)
+
+
+# ---- shared tables stay shared -----------------------------------------
+
+
+def test_aggregator_names_match_selector_registry():
+    from siddhi_tpu.ops import selector
+    assert selector.AGGREGATOR_NAMES == AGGREGATOR_NAMES
+
+
+def test_aggregator_result_table_matches_executors():
+    from siddhi_tpu.ops.aggregators import (AvgAgg, CountAgg, MinMaxAgg,
+                                            StdDevAgg, SumAgg)
+    assert SumAgg(AttrType.INT).out_type is \
+        aggregator_result_type("sum", AttrType.INT) is AttrType.LONG
+    assert SumAgg(AttrType.FLOAT).out_type is AttrType.DOUBLE
+    assert AvgAgg(AttrType.INT).out_type is AttrType.DOUBLE
+    assert CountAgg().out_type is AttrType.LONG
+    assert StdDevAgg(AttrType.FLOAT).out_type is AttrType.DOUBLE
+    assert MinMaxAgg(AttrType.INT, is_max=True).out_type is AttrType.INT
+
+
+def test_promotion_tables_shared():
+    assert can_coerce(AttrType.INT, AttrType.DOUBLE)
+    assert not can_coerce(AttrType.DOUBLE, AttrType.INT)
+    assert not can_coerce(AttrType.STRING, AttrType.INT)
+    assert comparable(AttrType.INT, AttrType.DOUBLE)
+    assert comparable(AttrType.STRING, AttrType.STRING)
+    assert not comparable(AttrType.STRING, AttrType.INT)
+
+
+# ---- expr.py defense in depth ------------------------------------------
+
+
+def test_expr_compiler_rejects_string_numeric_compare():
+    # the runtime twin of the static rule: even with validation skipped,
+    # ops/expr.py refuses to relate dictionary codes to numbers
+    from siddhi_tpu.core.event import StreamSchema, Attribute
+    from siddhi_tpu.ops.expr import SingleStreamScope, compile_expression
+    schema = StreamSchema("S", (Attribute("sym", AttrType.STRING),
+                                Attribute("v", AttrType.INT)))
+    expr = A.Compare(op="==",
+                     left=A.Variable(attribute="sym"),
+                     right=A.Constant(value=3, type=AttrType.INT))
+    with pytest.raises(CompileError, match="dictionary codes"):
+        compile_expression(expr, SingleStreamScope(schema))
+    # STRING vs STRING equality keeps working
+    eq = A.Compare(op="==", left=A.Variable(attribute="sym"),
+                   right=A.Constant(value="IBM", type=AttrType.STRING))
+    assert compile_expression(eq, SingleStreamScope(schema)).type \
+        is AttrType.BOOL
+
+
+# ---- corpus sweep: no false positives on real queries ------------------
+
+
+CORPUS = pathlib.Path(__file__).parent / "ref_corpus"
+
+
+def _corpus_apps():
+    def ids(fname):
+        p = CORPUS / fname
+        if not p.exists():
+            return frozenset()
+        return frozenset(ln.split("|")[0].strip()
+                         for ln in p.read_text().splitlines()
+                         if ln.strip() and not ln.startswith("#"))
+    gated = ids("compile_gated.txt")
+    out = []
+    for f in sorted(CORPUS.glob("*.json")):
+        d = json.loads(f.read_text())
+        for c in d["cases"]:
+            cid = f"{f.stem}.{c['name']}"
+            if c.get("expect_error") or cid in gated:
+                continue  # quarantined: rejection is the expected outcome
+            out.append((cid, c["app"]))
+    return out
+
+
+def test_corpus_type_checks_clean_and_infers_implicit_schemas():
+    """Every non-quarantined corpus case must type-check with ZERO
+    errors (these apps all run bit-equal against the reference), and
+    every implicit insert-into stream must get an inferred schema."""
+    bad, missing = [], []
+    n_implicit = 0
+    for cid, text in _corpus_apps():
+        try:
+            app = parse(text, validate=False)
+        except SiddhiParserException:
+            continue
+        r = analyze_app(app)
+        if r.errors:
+            bad.append((cid, [i.render() for i in r.errors]))
+        for q in A.iter_queries(app):
+            o = q.output
+            if isinstance(o, A.InsertIntoStream) and not o.is_inner \
+                    and not o.is_fault \
+                    and o.target not in app.stream_definitions \
+                    and o.target not in app.table_definitions \
+                    and o.target not in app.window_definitions:
+                n_implicit += 1
+                if o.target not in r.schemas:
+                    missing.append((cid, o.target))
+    assert not bad, f"false-positive type errors on corpus: {bad[:5]}"
+    assert n_implicit > 300  # the corpus genuinely exercises inference
+    assert not missing, \
+        f"implicit streams without inferred schemas: {missing[:10]}"
+
+
+def test_corpus_parse_with_validation_matches_quarantine():
+    """Full parse (plan rules + typecheck) over the corpus: CompileError
+    only on quarantined (compile-gated / expect_error) cases."""
+    regressions = []
+    for cid, text in _corpus_apps():
+        try:
+            parse(text)
+        except SiddhiParserException:
+            continue
+        except CompileError as e:
+            regressions.append((cid, str(e)[:120]))
+    assert not regressions, f"compile regressions: {regressions[:5]}"

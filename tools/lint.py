@@ -9,6 +9,8 @@ Usage (from anywhere; relative paths resolve against the repo root):
     python tools/lint.py --no-baseline    # show grandfathered findings too
     python tools/lint.py --baseline tools/lint_baseline.json \
         --update-baseline                 # re-grandfather current findings
+    python tools/lint.py --plan apps/     # validate + type-check .siddhi
+                                          # query files (exit 1 on errors)
 
 Exits nonzero when any non-baselined, non-suppressed finding exists —
 this is the CI gate (tests/test_lint_repo.py runs the same check in
