@@ -10,8 +10,16 @@ Covers all five BASELINE.md configs:
 plus the north-star workload:
   seq5             — 5-state pattern chain over a single-event replay,
                      with p50/p99 per-chunk match latency.
+and the chain-fusion workload:
+  chain3           — 3-query insert-into chain, measured fused
+                     (default: whole segment = one XLA program per
+                     chunk) AND with SIDDHI_TPU_FUSE=0 per-query
+                     dispatch.
 
-The headline metric/value is the north-star seq5 events/s.
+The headline metric/value is the north-star seq5 events/s. Each config
+additionally flushes its own {"config": ...} JSON line the moment it
+finishes, so a timed-out run leaves parseable partial results; the
+summary line is always printed last.
 
 vs_baseline: the reference repo publishes no numbers (BASELINE.md) and this
 image has no JVM, so single-thread Java figures CANNOT be measured here.
@@ -35,6 +43,12 @@ import os
 os.environ.setdefault(
     "SIDDHI_TPU_CACHE_DIR",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
+# SIDDHI_BENCH_PLATFORM=cpu pins the backend for smoke runs (the axon
+# sitecustomize's jax.config.update overrides JAX_PLATFORMS alone, so the
+# env var is not enough — see tests/conftest.py)
+if os.environ.get("SIDDHI_BENCH_PLATFORM"):
+    jax.config.update("jax_platforms",
+                      os.environ["SIDDHI_BENCH_PLATFORM"])
 import siddhi_tpu
 from siddhi_tpu import SiddhiManager
 from siddhi_tpu.core.types import GLOBAL_STRINGS
@@ -46,6 +60,9 @@ ASSUMED = {
     "seq2": 400_000.0,
     "kleene": 200_000.0,
     "seq5": 300_000.0,
+    # 3-query insert-into chain: per-hop dispatch costs put the Java
+    # figure below the single-filter guess
+    "chain3": 500_000.0,
 }
 
 # ---------------------------------------------------------------------------
@@ -150,6 +167,66 @@ def bench_filter(n=1_000_000):
                              _drain(outs))) for _ in range(REPS))
     rt.shutdown()
     return _entry("filter", n, dt)
+
+
+CHAIN3_APP = """
+    @app:playback
+    define stream S (sym string, v int, price float);
+    @info(name = 'q1')
+    from S[v > 3] select sym, v, price insert into S1;
+    @info(name = 'q2')
+    from S1[price > 10.0] select sym, v, price insert into S2;
+    @info(name = 'q3')
+    from S2[v < 900] select sym, v, price insert into OutS;
+"""
+
+
+def _run_chain3(n: int, fused: bool) -> float:
+    """One chain3 measurement; SIDDHI_TPU_FUSE toggles whole-segment
+    fusion (read at app start — see docs/performance.md)."""
+    prev = os.environ.get("SIDDHI_TPU_FUSE")
+    os.environ["SIDDHI_TPU_FUSE"] = "1" if fused else "0"
+    try:
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(CHAIN3_APP)
+        q3 = rt.queries["q3"]
+        outs = _Last()
+        q3.batch_callbacks.append(outs)
+        rt.start()
+        assert (rt.queries["q1"]._fused_chain is not None) == fused
+        h = rt.get_input_handler("S")
+        rng = np.random.default_rng(13)
+        syms = np.array([GLOBAL_STRINGS.encode(s) for s in SYMS], np.int32)
+        ts = TS0 + np.arange(n, dtype=np.int64)
+        sym = syms[rng.integers(0, len(syms), n)]
+        v = rng.integers(0, 1000, n).astype(np.int32)
+        price = rng.uniform(0, 200, n).astype(np.float32)
+        h.send_arrays(ts, [sym, v, price])     # warmup/compile
+        outs.drain()
+        dt = min(_timed(lambda: (h.send_arrays(ts, [sym, v, price]),
+                                 outs.drain())) for _ in range(REPS))
+        rt.shutdown()
+        return dt
+    finally:
+        if prev is None:
+            os.environ.pop("SIDDHI_TPU_FUSE", None)
+        else:
+            os.environ["SIDDHI_TPU_FUSE"] = prev
+
+
+def bench_chain3(n=1_048_576):
+    """3-query insert-into chain (Q1 -> S1 -> Q2 -> S2 -> Q3): the chain
+    fusion workload. Measures both the fused default (whole segment =
+    one XLA program per chunk) and SIDDHI_TPU_FUSE=0 per-query dispatch;
+    the headline value is the fused number."""
+    n = _scaled(n)
+    dt_fused = _run_chain3(n, fused=True)
+    dt_unfused = _run_chain3(n, fused=False)
+    return _entry("chain3", n, dt_fused, extra={
+        "fused_eps": round(n / dt_fused, 1),
+        "unfused_eps": round(n / dt_unfused, 1),
+        "fused_speedup": round(dt_unfused / dt_fused, 3),
+    })
 
 
 def bench_window_agg(n=1_000_000):
@@ -445,8 +522,8 @@ def bench_seq5(n=1_048_576, chunk=65_536):
 # LAST and get skipped when the wall deadline approaches; seq5 (the
 # headline metric) runs FIRST so the JSON line always has a value.
 # r5 measured: 494M joined pairs/s, 1.29M input ev/s, 0 drops.
-BENCHES = ("seq5", "filter", "window_agg", "seq2", "kleene", "join",
-           "join_fanout")
+BENCHES = ("seq5", "chain3", "filter", "window_agg", "seq2", "kleene",
+           "join", "join_fanout")
 
 
 def main():
@@ -485,21 +562,26 @@ def main():
             # whole invocation past the harness timeout (r5: rc=124)
             configs[name] = {"skipped": "deadline",
                              "deadline_s": DEADLINE_S}
-            continue
-        proc = None
-        try:
-            proc = subprocess.run(
-                [sys.executable, __file__, name],
-                capture_output=True, text=True, env=env,
-                timeout=min(BUDGET_S, remaining))
-            line = [ln for ln in proc.stdout.splitlines()
-                    if ln.startswith("{")][-1]
-            configs[name] = json.loads(line)
-        except Exception as e:  # noqa: BLE001 — record, keep benching
-            err = f"{type(e).__name__}: {e}"
-            if proc is not None and proc.stderr:
-                err += " | stderr: " + proc.stderr.strip()[-500:]
-            configs[name] = {"error": err}
+        else:
+            proc = None
+            try:
+                proc = subprocess.run(
+                    [sys.executable, __file__, name],
+                    capture_output=True, text=True, env=env,
+                    timeout=min(BUDGET_S, remaining))
+                line = [ln for ln in proc.stdout.splitlines()
+                        if ln.startswith("{")][-1]
+                configs[name] = json.loads(line)
+            except Exception as e:  # noqa: BLE001 — record, keep benching
+                err = f"{type(e).__name__}: {e}"
+                if proc is not None and proc.stderr:
+                    err += " | stderr: " + proc.stderr.strip()[-500:]
+                configs[name] = {"error": err}
+        # flush one JSON line per finished config: a run killed at the
+        # harness timeout leaves parseable partial results instead of an
+        # empty tail (BENCH_r05: rc=124, tail ""); the summary line is
+        # still printed LAST, so tail-line parsers keep working
+        print(json.dumps({"config": name, **configs[name]}), flush=True)
     head = configs["seq5"]
     if "value" not in head:  # seq5 child failed: still report the rest
         head = {"value": 0, "vs_baseline": 0,

@@ -141,7 +141,9 @@ def delete_rows_of_table(table, rows: list) -> None:
         for i, row in zip(phys, current):
             if row in kill:
                 valid[i] = False
-        table.state = {**table.state, "valid": jnp.asarray(valid)}
+        # copy=True: jnp.asarray may alias the numpy buffer zero-copy,
+        # and table states feed donated step arguments (runtime._donate)
+        table.state = {**table.state, "valid": jnp.array(valid, copy=True)}
 
 
 class OnDemandExecutor:

@@ -129,10 +129,24 @@ class FileSystemPersistenceStore(PersistenceStore):
                 os.remove(os.path.join(d, f))
 
 
+_rev_lock = threading.Lock()
+_last_rev_ms = 0
+
+
 def new_revision(app_name: str) -> str:
     """Monotonic, sortable revision id (reference: restoreRevision ids are
-    '<millis>_<appName>')."""
-    return f"{int(time.time() * 1000):015d}_{app_name}"
+    '<millis>_<appName>'). The wall clock alone is NOT monotonic at
+    checkpoint speed — two persists inside the same millisecond would
+    collide on one id (observed once snapshots stopped copying state
+    buffers), so the last issued millisecond is bumped forward when the
+    clock hasn't advanced."""
+    global _last_rev_ms
+    with _rev_lock:
+        ms = int(time.time() * 1000)
+        if ms <= _last_rev_ms:
+            ms = _last_rev_ms + 1
+        _last_rev_ms = ms
+    return f"{ms:015d}_{app_name}"
 
 
 def dump_strings() -> list:

@@ -12,12 +12,23 @@ Reference mapping:
 Execution model: each query compiles to ONE jitted step function
 (state, batch, now) -> (state', out_batch, next_due). The host junction layer
 feeds micro-batches in; batch capacity is bucketed so jit caches stay warm.
+
+Chain fusion (docs/performance.md): at app start the planner's junction
+graph is walked and fusible `insert into` segments Q1 -> S -> Q2 -> ... are
+compiled into ONE jitted chain step, so a micro-batch traverses the whole
+segment in a single XLA program instead of paying a host dispatch (plus an
+eager kind-rewrite) per hop. `SIDDHI_TPU_FUSE=0` falls back to per-query
+dispatch. State/window buffers are donated to their steps
+(`SIDDHI_TPU_DONATE=0` opts out) so they update in place instead of
+copy-on-writing every chunk.
 """
 from __future__ import annotations
 
 import bisect
+import contextlib
 import dataclasses
 import logging
+import os
 import re
 import threading
 import time
@@ -93,6 +104,121 @@ def bucket_capacity(n: int) -> int:
     return BATCH_BUCKETS[i]
 
 
+def _donate(*argnums):
+    """donate_argnums kwargs for the state-carrying arguments of a step:
+    XLA aliases the output state buffers onto the input ones, so large
+    window/NFA states update in place instead of copy-on-writing every
+    chunk. Donated inputs are INVALID after the call — safe here because
+    every step replaces the runtime's state references before releasing
+    its lock, and snapshot/statistics reads take the same lock/barrier.
+    SIDDHI_TPU_DONATE=0 opts out (debugging aid)."""
+    if os.environ.get("SIDDHI_TPU_DONATE", "1") == "0":
+        return {}
+    return {"donate_argnums": argnums}
+
+
+def _fresh_device(tree):
+    """Fresh device buffers for restored state. Snapshot payloads hold
+    numpy arrays (device_get), and jax may alias a numpy buffer
+    ZERO-COPY on device_put — donating such an aliased buffer to a step
+    (see _donate) would free memory numpy still owns. Every restore path
+    copies through here before the state re-enters a donated step
+    argument."""
+    return jax.tree_util.tree_map(lambda x: jnp.array(x, copy=True), tree)
+
+
+def _as_current(batch: EventBatch) -> EventBatch:
+    """Insert-into kind rewrite (InsertIntoStreamCallback.java:52-55):
+    EXPIRED events become CURRENT on insert. Pure trace transform —
+    usable both inside a fused chain step and under `_rewrite_current`."""
+    return EventBatch(
+        ts=batch.ts, cols=batch.cols, nulls=batch.nulls,
+        kind=jnp.where(batch.valid, jnp.int32(CURRENT), batch.kind),
+        valid=batch.valid)
+
+
+# jitted wrapper for the UNFUSED hop path: one cached dispatch per hop
+# instead of three eager ops (where + broadcast + convert)
+_rewrite_current = jax.jit(_as_current)
+
+
+def _chain_body(ops, has_timers: bool):
+    """The traced body of one query's operator chain:
+    (states, tstates, emitted, batch, now) ->
+    (states', tstates', emitted', out, due). Shared by the per-query
+    step compilers and the fused chain step."""
+
+    def chain(states, tstates, emitted, batch, now):
+        new_states = []
+        for op, st in zip(ops, states):
+            if op.needs_tables:
+                st, batch, tstates = op.step_tables(st, batch, now,
+                                                    tstates)
+            else:
+                st, batch = op.step(st, batch, now)
+            new_states.append(st)
+        if has_timers:
+            dues = [op.next_due(st) for op, st in zip(ops, new_states)
+                    if isinstance(op, WindowOp)]
+            dues = [d for d in dues if d is not None]
+            due = dues[0]
+            for d in dues[1:]:
+                due = jnp.minimum(due, d)
+        else:
+            due = jnp.asarray(POS_INF)
+        emitted = emitted + batch.count().astype(jnp.int64)
+        return tuple(new_states), tstates, emitted, batch, due
+
+    return chain
+
+
+def _build_packed_step(chain, schema: StreamSchema, enc: tuple,
+                       capacity: int, sub_cap: Optional[int],
+                       playback: bool) -> Callable:
+    """Fused unpack + chain over a PackedChunk's single buffer. `chain`
+    has the _chain_body signature; its states/emitted/due slots may be
+    arbitrary pytrees (the fused chain threads tuples-per-query through
+    the same builder). See QueryRuntime._packed_step_for for the
+    sort-heavy scan rationale."""
+    if sub_cap is not None and capacity > sub_cap:
+        k = capacity // sub_cap
+
+        def pstep(states, tstates, emitted, buf):
+            batch, now = unpack_buffer(schema, enc, capacity, buf)
+            subs = jax.tree_util.tree_map(
+                lambda x: x.reshape((k, sub_cap) + x.shape[1:]),
+                batch)
+
+            def body(carry, sub):
+                states, tstates, emitted, run_ts = carry
+                if playback:
+                    sub_now = jnp.maximum(run_ts, jnp.max(
+                        jnp.where(sub.valid, sub.ts,
+                                  jnp.asarray(NEG_INF))))
+                else:
+                    sub_now = now
+                states, tstates, emitted, out, due = chain(
+                    states, tstates, emitted, sub, sub_now)
+                return ((states, tstates, emitted, sub_now),
+                        (out, due))
+
+            carry0 = (states, tstates, emitted,
+                      jnp.asarray(NEG_INF))
+            (states, tstates, emitted, _), (outs, dues) = \
+                jax.lax.scan(body, carry0, subs)
+            out = jax.tree_util.tree_map(
+                lambda x: x.reshape((x.shape[0] * x.shape[1],)
+                                    + x.shape[2:]), outs)
+            due = jax.tree_util.tree_map(lambda d: d[-1], dues)
+            return states, tstates, emitted, out, due
+    else:
+        def pstep(states, tstates, emitted, buf):
+            batch, now = unpack_buffer(schema, enc, capacity, buf)
+            return chain(states, tstates, emitted, batch, now)
+
+    return jax.jit(pstep, **_donate(0, 1, 2))
+
+
 class OutputHandler:
     def handle(self, timestamp: int, rows: list) -> None:
         raise NotImplementedError
@@ -122,11 +248,9 @@ class InsertIntoStreamHandler(OutputHandler):
         if not receivers:
             return True  # nobody listening — drop without decode
         if all(hasattr(r, "process_batch") for r in receivers):
-            out = EventBatch(
-                ts=out.ts, cols=out.cols, nulls=out.nulls,
-                kind=jnp.where(out.valid, jnp.int32(CURRENT), out.kind),
-                valid=out.valid)
-            self.junction.publish_batch(out, timestamp)
+            # kind rewrite runs as ONE jitted dispatch per hop (fused
+            # segments do it inside the chain trace instead)
+            self.junction.publish_batch(_rewrite_current(out), timestamp)
             return True
         return False
 
@@ -144,11 +268,7 @@ class InsertIntoWindowHandler(OutputHandler):
         self.wq = wq
 
     def handle_device_batch(self, out, timestamp):
-        out = EventBatch(
-            ts=out.ts, cols=out.cols, nulls=out.nulls,
-            kind=jnp.where(out.valid, jnp.int32(CURRENT), out.kind),
-            valid=out.valid)
-        self.wq.process_batch(out, timestamp)
+        self.wq.process_batch(_rewrite_current(out), timestamp)
         return True
 
     def handle(self, timestamp, rows):
@@ -297,34 +417,17 @@ class QueryRuntime(Receiver):
             getattr(op, "needs_catchup", False) for op in operators)
         self.rate_limiter = None
         self._qstats = None  # lazily created when statistics enabled
+        # set on the HEAD query of a fusible insert-into segment
+        # (SiddhiAppRuntime._build_fused_chains): batches entering this
+        # query traverse the whole segment in one XLA program
+        self._fused_chain: Optional["FusedChain"] = None
+        # DETAIL latency probe sampling counter (see _lat_sample)
+        self._lat_counter = 0
 
     # -- compile ---------------------------------------------------------
     def _make_step(self):
-        ops = self.operators
-        has_timers = self._has_timers
-
-        def step(states, tstates, emitted, batch: EventBatch, now):
-            new_states = []
-            for op, st in zip(ops, states):
-                if op.needs_tables:
-                    st, batch, tstates = op.step_tables(st, batch, now,
-                                                        tstates)
-                else:
-                    st, batch = op.step(st, batch, now)
-                new_states.append(st)
-            if has_timers:
-                dues = [op.next_due(st) for op, st in zip(ops, new_states)
-                        if isinstance(op, WindowOp)]
-                dues = [d for d in dues if d is not None]
-                due = dues[0]
-                for d in dues[1:]:
-                    due = jnp.minimum(due, d)
-            else:
-                due = jnp.asarray(POS_INF)
-            emitted = emitted + batch.count().astype(jnp.int64)
-            return tuple(new_states), tstates, emitted, batch, due
-
-        return jax.jit(step)
+        return jax.jit(_chain_body(self.operators, self._has_timers),
+                       **_donate(0, 1, 2))
 
     def _step_for(self, capacity: int) -> Callable:
         # one jit wrapper; XLA specializes per batch-capacity shape
@@ -349,69 +452,10 @@ class QueryRuntime(Receiver):
         derived per sub-chunk on the host."""
         fn = self._packed_steps.get((enc, capacity))
         if fn is None:
-            ops = self.operators
-            has_timers = self._has_timers
-            schema = self.in_schema
-            sub_cap = self.max_step_capacity
-            playback = self.app._playback
-
-            def chain(states, tstates, emitted, batch, now):
-                new_states = []
-                for op, st in zip(ops, states):
-                    if op.needs_tables:
-                        st, batch, tstates = op.step_tables(st, batch, now,
-                                                            tstates)
-                    else:
-                        st, batch = op.step(st, batch, now)
-                    new_states.append(st)
-                if has_timers:
-                    dues = [op.next_due(st) for op, st in
-                            zip(ops, new_states) if isinstance(op, WindowOp)]
-                    dues = [d for d in dues if d is not None]
-                    due = dues[0]
-                    for d in dues[1:]:
-                        due = jnp.minimum(due, d)
-                else:
-                    due = jnp.asarray(POS_INF)
-                emitted = emitted + batch.count().astype(jnp.int64)
-                return tuple(new_states), tstates, emitted, batch, due
-
-            if sub_cap is not None and capacity > sub_cap:
-                k = capacity // sub_cap
-
-                def pstep(states, tstates, emitted, buf):
-                    batch, now = unpack_buffer(schema, enc, capacity, buf)
-                    subs = jax.tree_util.tree_map(
-                        lambda x: x.reshape((k, sub_cap) + x.shape[1:]),
-                        batch)
-
-                    def body(carry, sub):
-                        states, tstates, emitted, run_ts = carry
-                        if playback:
-                            sub_now = jnp.maximum(run_ts, jnp.max(
-                                jnp.where(sub.valid, sub.ts,
-                                          jnp.asarray(NEG_INF))))
-                        else:
-                            sub_now = now
-                        states, tstates, emitted, out, due = chain(
-                            states, tstates, emitted, sub, sub_now)
-                        return ((states, tstates, emitted, sub_now),
-                                (out, due))
-
-                    carry0 = (states, tstates, emitted,
-                              jnp.asarray(NEG_INF))
-                    (states, tstates, emitted, _), (outs, dues) = \
-                        jax.lax.scan(body, carry0, subs)
-                    out = jax.tree_util.tree_map(
-                        lambda x: x.reshape((x.shape[0] * x.shape[1],)
-                                            + x.shape[2:]), outs)
-                    return states, tstates, emitted, out, dues[-1]
-            else:
-                def pstep(states, tstates, emitted, buf):
-                    batch, now = unpack_buffer(schema, enc, capacity, buf)
-                    return chain(states, tstates, emitted, batch, now)
-
-            fn = jax.jit(pstep)
+            fn = _build_packed_step(
+                _chain_body(self.operators, self._has_timers),
+                self.in_schema, enc, capacity, self.max_step_capacity,
+                self.app._playback)
             self._packed_steps[(enc, capacity)] = fn
         return fn
 
@@ -430,6 +474,8 @@ class QueryRuntime(Receiver):
             else max(self.SCAN_CHUNK_CAP, self.max_step_capacity)
 
     def process_packed(self, chunk: PackedChunk) -> None:
+        if self._fused_chain is not None:
+            return self._fused_chain.process_packed(chunk)
         lat = self._stats_mark(chunk.n)
         self._last_now = max(self._last_now, chunk.last_ts)
         with self._lock:
@@ -469,8 +515,8 @@ class QueryRuntime(Receiver):
 
     def restore_state(self, snap: dict) -> None:
         with self._lock:
-            self.states = snap["states"]
-            self._emitted_dev = jnp.asarray(snap["emitted"])
+            self.states = _fresh_device(snap["states"])
+            self._emitted_dev = jnp.array(snap["emitted"], copy=True)
             self._sched_due = None
             if self.rate_limiter is not None and "rate" in snap:
                 self.rate_limiter.restore_state(snap["rate"])
@@ -530,6 +576,15 @@ class QueryRuntime(Receiver):
             self._qstats = QueryStats()
         return self._qstats
 
+    def _lat_sample(self) -> bool:
+        """DETAIL latency probes block_until_ready the step output, which
+        serializes the async dispatch pipeline — so only every Nth chunk
+        is measured (SIDDHI_TPU_LAT_EVERY, default 16; the first chunk
+        always samples so short runs still report)."""
+        n = self._lat_counter
+        self._lat_counter = n + 1
+        return n % self.app.lat_sample_every == 0
+
     def _stats_mark(self, n: int):
         """Ingest-boundary throughput (real event count) + DETAIL
         latency handle."""
@@ -537,14 +592,14 @@ class QueryRuntime(Receiver):
             return None
         qs = self._qs()
         qs.throughput.mark(n)
-        if self.app.stats_level >= 2:
+        if self.app.stats_level >= 2 and self._lat_sample():
             qs.latency.mark_in()
             return qs.latency
         return None
 
     def _stats_lat(self):
         """DETAIL latency only (timer/internal batches: not traffic)."""
-        if self.app.stats_level < 2:
+        if self.app.stats_level < 2 or not self._lat_sample():
             return None
         lat = self._qs().latency
         lat.mark_in()
@@ -579,6 +634,10 @@ class QueryRuntime(Receiver):
                 self.process_batch(sub, timestamp, now=now,
                                    skip_due=skip_due)
             return
+        if self._fused_chain is not None:
+            return self._fused_chain.process_batch(batch, timestamp,
+                                                   now=now,
+                                                   skip_due=skip_due)
         if now is None:
             now = self.app.current_time()
         lat = self._stats_lat()
@@ -601,7 +660,6 @@ class QueryRuntime(Receiver):
             due=due if (self._has_timers and not skip_due) else None)
 
     def _table_locks(self):
-        import contextlib
         stack = contextlib.ExitStack()
         for t in self.table_deps:  # sorted — consistent lock order
             stack.enter_context(self.app.tables[t].lock)
@@ -614,6 +672,8 @@ class QueryRuntime(Receiver):
         rl.emit = self._emit_limited
         rl.start(self.app)
         self.rate_limiter = rl
+        # a limiter makes this query's hop unfusible — re-derive segments
+        self.app._rebuild_fused_chains()
 
     def _emit_limited(self, timestamp: int, rows) -> None:
         for h in self.output_handlers:
@@ -757,6 +817,148 @@ class QueryRuntime(Receiver):
             self.arm_host_timers(due)
 
 
+class FusedChain:
+    """A fusible linear `insert into` segment [Q1 -> Q2 -> ... -> Qk]
+    compiled into ONE jitted chain step
+    (statesQ1..Qk, tstates, emittedQ1..Qk, batch, now) ->
+    (states', tstates', emitted', out, dues) — a micro-batch traverses
+    the whole segment in a single XLA program with the insert-into
+    CURRENT-kind rewrite done inside the trace, instead of one jit
+    dispatch plus three eager ops per hop.
+
+    Eligibility is decided by SiddhiAppRuntime._fusible_next (see
+    docs/performance.md); the HEAD query's process_batch/process_packed
+    delegate here. Member queries keep their own per-query steps for
+    everything else (their timers, direct sends to the intermediate
+    streams), so fused and unfused execution interleave safely: every
+    path updates `q.states` under `q._lock`, and the fused step takes
+    the member locks in segment order before running."""
+
+    def __init__(self, app: "SiddhiAppRuntime", queries: list):
+        self.app = app
+        self.queries = list(queries)
+        self.head = self.queries[0]
+        self.tail = self.queries[-1]
+        self.name = "+".join(q.name for q in self.queries)
+        self.table_deps = sorted({t for q in self.queries
+                                  for t in q.table_deps})
+        self._chain = self._make_chain()
+        self._step: Optional[Callable] = None
+        self._packed_steps: dict = {}
+
+    def _make_chain(self):
+        bodies = [_chain_body(q.operators, q._has_timers)
+                  for q in self.queries]
+
+        def chain(states, tstates, emitteds, batch, now):
+            out = batch
+            new_states, new_emitted, dues = [], [], []
+            for i, body in enumerate(bodies):
+                if i:
+                    out = _as_current(out)  # insert-into hop, in-trace
+                st, tstates, em, out, due = body(
+                    states[i], tstates, emitteds[i], out, now)
+                new_states.append(st)
+                new_emitted.append(em)
+                dues.append(due)
+            return (tuple(new_states), tstates, tuple(new_emitted), out,
+                    tuple(dues))
+
+        return chain
+
+    # -- locks -----------------------------------------------------------
+    def _locks(self):
+        stack = contextlib.ExitStack()
+        for q in self.queries:  # segment order; no path takes them in
+            stack.enter_context(q._lock)  # reverse, so no deadlock
+        return stack
+
+    def _table_locks(self):
+        stack = contextlib.ExitStack()
+        for t in self.table_deps:  # sorted — consistent lock order
+            stack.enter_context(self.app.tables[t].lock)
+        return stack
+
+    # -- compile ---------------------------------------------------------
+    def _step_for(self) -> Callable:
+        if self._step is None:
+            self._step = jax.jit(self._chain, **_donate(0, 1, 2))
+        return self._step
+
+    def _packed_step_for(self, enc: tuple, capacity: int) -> Callable:
+        fn = self._packed_steps.get((enc, capacity))
+        if fn is None:
+            fn = _build_packed_step(self._chain, self.head.in_schema,
+                                    enc, capacity,
+                                    self.head.max_step_capacity,
+                                    self.app._playback)
+            self._packed_steps[(enc, capacity)] = fn
+        return fn
+
+    # -- runtime ---------------------------------------------------------
+    def _run(self, step, *args):
+        """Execute the fused step under segment + table locks and write
+        every member query's state back (donated inputs are replaced
+        before the locks release, so snapshot/restore and statistics —
+        which take the same locks/barrier — always see live buffers)."""
+        with self._locks():
+            with self._table_locks():
+                tstates = {t: self.app.tables[t].state
+                           for t in self.table_deps}
+                states = tuple(q.states for q in self.queries)
+                emitted = tuple(q._emitted_dev for q in self.queries)
+                states, tstates, emitted, out, dues = step(
+                    states, tstates, emitted, *args)
+                for t in self.table_deps:
+                    self.app.tables[t].state = tstates[t]
+            for q, st, em in zip(self.queries, states, emitted):
+                q.states = st
+                q._emitted_dev = em
+        return out, dues
+
+    def process_packed(self, chunk: PackedChunk) -> None:
+        lat = self.head._stats_mark(chunk.n)
+        for q in self.queries:
+            q._last_now = max(q._last_now, chunk.last_ts)
+        out, dues = self._run(
+            self._packed_step_for(chunk.enc, chunk.capacity), chunk.buf)
+        if lat is not None:
+            jax.block_until_ready(out.valid)
+            lat.mark_out()
+        self._schedule_dues(dues, chunk.ts_min)
+        self.tail._dispatch_output(out, chunk.last_ts)
+
+    def process_batch(self, batch: EventBatch, timestamp: int,
+                      now: Optional[int] = None,
+                      skip_due: bool = False) -> None:
+        if now is None:
+            now = self.app.current_time()
+        lat = self.head._stats_lat()
+        for q in self.queries:
+            q._last_now = max(q._last_now, int(now))
+        now_dev = jnp.asarray(now, dtype=jnp.int64)
+        out, dues = self._run(self._step_for(), batch, now_dev)
+        if lat is not None:
+            jax.block_until_ready(out.valid)
+            lat.mark_out()
+        self._schedule_dues(dues, None, skip_head_due=skip_due)
+        self.tail._dispatch_output(out, timestamp)
+
+    def _schedule_dues(self, dues, ts_min,
+                       skip_head_due: bool = False) -> None:
+        """Per-member timer scheduling: host-bounded windows schedule
+        with zero readbacks; device dues resolve asynchronously
+        (app.defer_due) like the no-row-consumer single-query path."""
+        for i, (q, due) in enumerate(zip(self.queries, dues)):
+            if not q._has_timers or (skip_head_due and i == 0):
+                continue
+            if q._host_due_all and ts_min is not None:
+                q._schedule(min(op.host_due_bound(ts_min)
+                                for op in q._timer_ops))
+            else:
+                self.app.defer_due(q, due)
+
+
 class StreamCallbackReceiver(Receiver):
     def __init__(self, callback: StreamCallback):
         self.callback = callback
@@ -826,9 +1028,9 @@ class PatternQueryRuntime(QueryRuntime):
 
     def restore_state(self, snap: dict) -> None:
         with self._lock:
-            self.states = snap["states"]
-            self._emitted_dev = jnp.asarray(snap["emitted"])
-            self.nfa_state = snap["nfa"]
+            self.states = _fresh_device(snap["states"])
+            self._emitted_dev = jnp.array(snap["emitted"], copy=True)
+            self.nfa_state = _fresh_device(snap["nfa"])
             self._sched_due = None
 
     def reschedule(self) -> None:
@@ -873,7 +1075,7 @@ class PatternQueryRuntime(QueryRuntime):
                 emitted = emitted + match.count().astype(jnp.int64)
                 return nfa_state, tuple(new_sel), emitted, match
 
-            self._timer_step = jax.jit(full)
+            self._timer_step = jax.jit(full, **_donate(0, 1, 2))
         with self._lock:
             (self.nfa_state, self.states, self._emitted_dev,
              out) = self._timer_step(self.nfa_state, self.states,
@@ -918,7 +1120,7 @@ class PatternQueryRuntime(QueryRuntime):
                         nfa_state, sel_states, tstates, batch, now)
                     emitted = emitted + match.count().astype(jnp.int64)
                     return nfa_state, sel, tstates, emitted, match
-            fn = jax.jit(step)
+            fn = jax.jit(step, **_donate(0, 1, 2, 3))
             self._stream_steps[key] = fn
         return fn
 
@@ -1052,10 +1254,11 @@ class JoinQueryRuntime(QueryRuntime):
 
     def restore_state(self, snap: dict) -> None:
         with self._lock:
-            self.states = snap["states"]
-            self._emitted_dev = jnp.asarray(snap["emitted"])
-            self.side_states = snap["sides"]
-            self._overflow_dev = jnp.asarray(snap["join_overflow"])
+            self.states = _fresh_device(snap["states"])
+            self._emitted_dev = jnp.array(snap["emitted"], copy=True)
+            self.side_states = _fresh_device(snap["sides"])
+            self._overflow_dev = jnp.array(snap["join_overflow"],
+                                           copy=True)
             self._sched_due = None
 
     def reschedule(self) -> None:
@@ -1142,7 +1345,9 @@ class JoinQueryRuntime(QueryRuntime):
                     emitted = emitted + joined.count().astype(jnp.int64)
                     return my, sel, tstates, emitted, joined, lost, due
 
-                fn = jax.jit(pstep)
+                # opp_states (arg 1) is read-only and NOT returned — the
+                # opposite side keeps referencing it, so never donate it
+                fn = jax.jit(pstep, **_donate(0, 2, 3, 4))
             else:
                 def ustep(my_states, opp_states, sel_states, tstates,
                           emitted, batch, now):
@@ -1152,7 +1357,7 @@ class JoinQueryRuntime(QueryRuntime):
                     emitted = emitted + joined.count().astype(jnp.int64)
                     return my, sel, tstates, emitted, joined, lost, due
 
-                fn = jax.jit(ustep)
+                fn = jax.jit(ustep, **_donate(0, 2, 3, 4))
             self._side_steps[(side, packed_key)] = fn
         return fn
 
@@ -1302,6 +1507,9 @@ class SiddhiAppRuntime:
         self._due_pending: list = []
         self._due_lock = threading.Lock()
         self.stats_level = 0      # OFF; see core/stats.py
+        # DETAIL latency probe sampling stride (QueryRuntime._lat_sample)
+        self.lat_sample_every = max(
+            1, int(os.environ.get("SIDDHI_TPU_LAT_EVERY", "16") or 16))
         self.debugger = None
         # app-wide quiesce barrier (= ThreadBarrier): ingest and wall-clock
         # timer dispatch hold it; snapshot/restore take it exclusively
@@ -1413,6 +1621,78 @@ class SiddhiAppRuntime:
         with self.barrier:
             return OnDemandExecutor(self).execute(q)
 
+    # -- chain fusion (docs/performance.md) -------------------------------
+    def _fusible_next(self, q) -> Optional["QueryRuntime"]:
+        """The single downstream QueryRuntime the hop q -> next can fuse
+        into, or None. Fusible means: q is a plain single-stream query
+        whose ONLY output is `insert into` a synchronous junction with
+        exactly one subscriber that is itself a plain QueryRuntime taking
+        device batches — no row-level consumers (query callbacks, rate
+        limiters, device taps) on q, no @Async/@OnError machinery on the
+        intermediate stream, and no sort-heavy capacity cap downstream
+        (capped queries re-split batches on the host, which a fused trace
+        cannot do)."""
+        if type(q) is not QueryRuntime:
+            return None
+        if q.rate_limiter is not None or q.callback_handler.callbacks \
+                or q.batch_callbacks:
+            return None
+        if len(q.output_handlers) != 1:
+            return None
+        h = q.output_handlers[0]
+        if type(h) is not InsertIntoStreamHandler:
+            return None
+        j = h.junction
+        if j.async_conf is not None or j.fault_junction is not None \
+                or j.on_error_action != "LOG":
+            return None
+        if len(j.receivers) != 1:
+            return None
+        r = j.receivers[0]
+        if type(r) is not QueryRuntime or r is q \
+                or r.max_step_capacity is not None:
+            return None
+        return r
+
+    def _build_fused_chains(self) -> None:
+        """Walk the junction graph and compile each maximal fusible
+        linear segment into a FusedChain on its head query. Cleared and
+        re-derived whenever the graph changes (new subscriber, callback,
+        rate limiter, debugger). SIDDHI_TPU_FUSE=0 keeps today's
+        per-query dispatch; attaching a debugger does too (row
+        breakpoints need per-query delivery)."""
+        for q in self.queries.values():
+            if type(q) is QueryRuntime:
+                q._fused_chain = None
+        if os.environ.get("SIDDHI_TPU_FUSE", "1") == "0":
+            return
+        if self.debugger is not None:
+            return
+        nxt = {}
+        for q in self.queries.values():
+            r = self._fusible_next(q)
+            if r is not None:
+                nxt[q.name] = r
+        targets = {r.name for r in nxt.values()}
+        for qn in nxt:
+            if qn in targets:  # mid-segment (or part of a pure cycle)
+                continue
+            seg = [self.queries[qn]]
+            seen = {qn}
+            while seg[-1].name in nxt:
+                r = nxt[seg[-1].name]
+                if r.name in seen:
+                    break
+                seg.append(r)
+                seen.add(r.name)
+            if len(seg) >= 2:
+                seg[0]._fused_chain = FusedChain(self, seg)
+
+    def _rebuild_fused_chains(self) -> None:
+        if self.running:
+            with self.barrier:  # quiesce in-flight fused dispatch
+                self._build_fused_chains()
+
     # -- wiring ----------------------------------------------------------
     def junction_for(self, stream_id: str,
                      schema: Optional[StreamSchema] = None) -> StreamJunction:
@@ -1447,11 +1727,13 @@ class SiddhiAppRuntime:
             if q is None:
                 raise KeyError(f"no query named '{target}'")
             q.callback_handler.callbacks.append(callback)
+            self._rebuild_fused_chains()
         else:
             j = self.junctions.get(target)
             if j is None:
                 raise KeyError(f"no stream '{target}' to subscribe to")
             j.subscribe(StreamCallbackReceiver(callback))
+            self._rebuild_fused_chains()
 
     def set_statistics_level(self, level) -> None:
         """OFF/BASIC/DETAIL at runtime
@@ -1465,11 +1747,18 @@ class SiddhiAppRuntime:
         (util/statistics trackers)."""
         from .stats import pytree_nbytes
         report = {}
-        states_host = jax.device_get(
-            {n: q.states for n, q in self.queries.items()
-             if hasattr(q, "states")})
+        # barrier: with donated state buffers a concurrent step would
+        # invalidate the arrays mid-read; the barrier quiesces ingest and
+        # timer dispatch for the walk (same guard snapshot() uses)
+        with self.barrier:
+            states_host = jax.device_get(
+                {n: q.states for n, q in self.queries.items()
+                 if hasattr(q, "states")})
+            stats_host = {n: dict(q.stats())
+                          for n, q in self.queries.items()
+                          if hasattr(q, "stats")}
         for n, q in self.queries.items():
-            entry = dict(q.stats()) if hasattr(q, "stats") else {}
+            entry = stats_host.get(n, {})
             qs = getattr(q, "_qstats", None)
             if qs is not None:
                 eps = qs.throughput.events_per_sec()
@@ -1497,10 +1786,13 @@ class SiddhiAppRuntime:
         """Attach a step debugger (SiddhiAppRuntimeImpl.debug():657)."""
         from .debugger import SiddhiDebugger
         self.debugger = SiddhiDebugger(self)
+        # row breakpoints need per-query delivery — drop fused segments
+        self._build_fused_chains()
         return self.debugger
 
     def start(self) -> None:
         self.running = True
+        self._build_fused_chains()
         self.scheduler.start()
         self._start_record_tables()
         for s in self.sources:
@@ -1561,6 +1853,7 @@ class SiddhiAppRuntime:
         """Lifecycle split (SiddhiAppRuntimeImpl.startWithoutSources
         :495): run queries but keep sources disconnected."""
         self.running = True
+        self._build_fused_chains()
         self.scheduler.start()
         self._start_record_tables()
         if not self._playback:
@@ -1653,7 +1946,8 @@ class SiddhiAppRuntime:
                 w.restore_state(snap)
         for tid, tstate in payload["tables"].items():
             if tid in self.tables:
-                self.tables[tid].state = tstate
+                # fresh buffers: table states feed donated step args
+                self.tables[tid].state = _fresh_device(tstate)
         for n, snap in payload["partitions"].items():
             if n in self.partitions:
                 self.partitions[n].restore_state(snap)

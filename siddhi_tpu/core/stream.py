@@ -83,6 +83,12 @@ class StreamJunction:
 
     def subscribe(self, receiver: Receiver) -> None:
         self.receivers.append(receiver)
+        # a new subscriber can break a fused insert-into segment's
+        # single-consumer invariant — re-derive segments on a live app
+        # (no-op before start; core/runtime._build_fused_chains)
+        app = self.app
+        if app is not None and getattr(app, "running", False):
+            app._rebuild_fused_chains()
 
     # -- @Async micro-batch pipeline -------------------------------------
     def enable_async(self, app, buffer_size: int, batch_max: int) -> None:

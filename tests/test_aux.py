@@ -35,6 +35,10 @@ class TestStatistics:
             define stream S (v int);
             @info(name = 'q') from S select v insert into Out;
         """)
+        # stride 1 = probe every chunk (the default SIDDHI_TPU_LAT_EVERY
+        # samples every 16th so DETAIL stats don't serialize the async
+        # dispatch pipeline; see docs/performance.md)
+        rt.lat_sample_every = 1
         rt.set_statistics_level("DETAIL")
         rt.start()
         h = rt.get_input_handler("S")
@@ -44,6 +48,22 @@ class TestStatistics:
         rt.shutdown()
         lat = stats["q"]["latency"]
         assert lat["samples"] == 3 and lat["p99_ms"] >= lat["p50_ms"] >= 0
+
+    def test_detail_latency_sampling_stride(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """)
+        rt.lat_sample_every = 4
+        rt.set_statistics_level("DETAIL")
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(8):   # chunks 0 and 4 sample
+            h.send(Event(1000 + i, (i,)))
+        stats = rt.statistics()
+        rt.shutdown()
+        assert stats["q"]["latency"]["samples"] == 2
 
 
 class TestDebugger:

@@ -1,0 +1,53 @@
+"""Tier-1 bench smoke: `bench.py --quick <config>` must exit 0 and print
+a parseable JSON line — guards the rc=124 / `"parsed": null` regression
+class permanently (BENCH_r05 timed out with an empty tail; bench.py now
+flushes a JSON line per config AND each single-config invocation prints
+its own line).
+
+Runs at a tiny event scale on the CPU backend so the whole smoke stays
+inside the tier-1 budget; SIDDHI_BENCH_PLATFORM pins the backend because
+the axon sitecustomize overrides JAX_PLATFORMS (see tests/conftest.py).
+"""
+import json
+import os
+import subprocess
+import sys
+
+BENCH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                     "bench.py")
+
+
+def _run_config(name: str) -> dict:
+    env = dict(os.environ)
+    env.update(
+        SIDDHI_BENCH_PLATFORM="cpu",
+        JAX_PLATFORMS="cpu",
+        SIDDHI_BENCH_SCALE="0.008",   # ~8k events: smoke, not a benchmark
+        SIDDHI_BENCH_REPS="1",
+    )
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--quick", name],
+        capture_output=True, text=True, env=env, timeout=240)
+    assert proc.returncode == 0, \
+        f"bench.py {name} rc={proc.returncode}\n{proc.stderr[-2000:]}"
+    lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no JSON line in stdout:\n{proc.stdout[-2000:]}"
+    parsed = json.loads(lines[-1])
+    assert parsed is not None
+    return parsed
+
+
+def test_bench_filter_quick_parses():
+    d = _run_config("filter")
+    assert d["unit"] == "events/s"
+    assert d["value"] > 0 and d["events"] > 0
+
+
+def test_bench_chain3_quick_parses_fused_vs_unfused():
+    d = _run_config("chain3")
+    assert d["unit"] == "events/s"
+    assert d["value"] > 0
+    # fused vs unfused events/s must both be reported (the chain-fusion
+    # acceptance metric)
+    assert d["fused_eps"] > 0 and d["unfused_eps"] > 0
+    assert d["fused_speedup"] > 0

@@ -1,0 +1,285 @@
+"""Chain fusion (docs/performance.md): equivalence sweep + compile
+hygiene.
+
+Fused `insert into` segments must be OBSERVABLY IDENTICAL to per-query
+dispatch: same rows, kinds, timestamps, per-query statistics(), and
+snapshot/restore round-trips that cross fusion modes. The sweep runs a
+corpus of chain topologies through both SIDDHI_TPU_FUSE settings and
+both ingest paths (row `send` and columnar `send_arrays`).
+
+The recompile guard asserts steady-state chunk processing triggers zero
+fresh jit traces — the jit caches (per encoding tuple x capacity) must
+stay warm across chunks.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+from siddhi_tpu.core.types import GLOBAL_STRINGS
+
+PLAYBACK = "@app:playback\n"
+
+# -- the chain corpus -------------------------------------------------------
+# (name, app, head query, fusible?) — `fusible` False marks topologies the
+# eligibility rules must DECLINE (sort-heavy downstream capacity caps:
+# capped queries re-split batches on the host, which a fused trace cannot
+# do) while still producing identical output either way.
+CHAIN_CORPUS = [
+    ("filter3", """
+        define stream S (sym string, v int, p float);
+        @info(name = 'q1') from S[v > 2] select sym, v, p insert into M1;
+        @info(name = 'q2') from M1[p > 1.0] select sym, v, p * 2.0 as p
+            insert into M2;
+        @info(name = 'q3') from M2 select sym, v + 1 as v, p insert into Out;
+     """, "q1", True),
+    ("window_head", """
+        define stream S (sym string, v int, p float);
+        @info(name = 'q1') from S#window.time(2 sec)
+            select sym, sum(v) as total group by sym insert into M1;
+        @info(name = 'q2') from M1[total > 3] select sym, total
+            insert into Out;
+     """, "q1", True),
+    ("batch_window_mid", """
+        define stream S (sym string, v int, p float);
+        @info(name = 'q1') from S[v > 0] select sym, v insert into M1;
+        @info(name = 'q2') from M1#window.lengthBatch(4)
+            select sym, max(v) as mx insert into M2;
+        @info(name = 'q3') from M2 select sym, mx * 10 as mx
+            insert into Out;
+     """, "q1", False),  # q2 is sort-heavy (capacity-capped)
+    ("length_window_mid", """
+        define stream S (sym string, v int, p float);
+        @info(name = 'q1') from S select sym, v insert into M1;
+        @info(name = 'q2') from M1#window.length(3)
+            select sym, sum(v) as total insert into Out;
+     """, "q1", False),  # q2 is sort-heavy (capacity-capped)
+    ("table_in_chain", """
+        define table T (sym string, v int);
+        define stream S (sym string, v int, p float);
+        @info(name = 'q1') from S[v > 1] select sym, v insert into M1;
+        @info(name = 'q2') from M1 select sym, v insert into T;
+     """, "q1", True),
+]
+
+
+def _events(n=24, seed=3):
+    rng = np.random.default_rng(seed)
+    out = []
+    for i in range(n):
+        out.append((1000 + 137 * i,
+                    ("A" if rng.integers(0, 2) else "B",
+                     int(rng.integers(0, 8)),
+                     float(np.float32(rng.uniform(0.0, 3.0))))))
+    return out
+
+
+def _arrays(events):
+    ts = np.array([e[0] for e in events], np.int64)
+    sym = np.array([GLOBAL_STRINGS.encode(e[1][0]) for e in events],
+                   np.int32)
+    v = np.array([e[1][1] for e in events], np.int32)
+    p = np.array([e[1][2] for e in events], np.float32)
+    return ts, [sym, v, p]
+
+
+def _build(app, fused, persistence_store=None):
+    os.environ["SIDDHI_TPU_FUSE"] = "1" if fused else "0"
+    try:
+        mgr = SiddhiManager()
+        if persistence_store is not None:
+            mgr.set_persistence_store(persistence_store)
+        rt = mgr.create_siddhi_app_runtime(PLAYBACK + app)
+        got = []
+        if "Out" in rt.junctions:
+            rt.add_callback("Out", StreamCallback(fn=lambda evs: got.extend(
+                (e.timestamp, e.data, e.is_expired) for e in evs)))
+        rt.start()
+        return rt, got
+    finally:
+        os.environ.pop("SIDDHI_TPU_FUSE", None)
+
+
+def _deterministic_stats(rt):
+    """statistics() minus the wall-clock-derived keys."""
+    stats = rt.statistics()
+    out = {}
+    for name, entry in stats.items():
+        if not isinstance(entry, dict):
+            out[name] = entry
+            continue
+        out[name] = {k: v for k, v in entry.items()
+                     if k not in ("throughput_eps", "latency")}
+    return out
+
+
+def _run(app, head, fused, columnar, fusible=True, events=None):
+    rt, got = _build(app, fused)
+    q = rt.queries[head]
+    assert (q._fused_chain is not None) == (fused and fusible), \
+        f"expected fusion={fused and fusible} on '{head}'"
+    if events is None:
+        events = _events()
+    if columnar:
+        ts, cols = _arrays(events)
+        rt.get_input_handler("S").send_arrays(ts, cols)
+    else:
+        h = rt.get_input_handler("S")
+        for ts, data in events:
+            h.send(Event(ts, data))
+    stats = _deterministic_stats(rt)
+    tables = {tid: sorted(rt.query(f"from {tid} select *"))
+              for tid in rt.tables}
+    rt.shutdown()
+    return got, stats, tables
+
+
+@pytest.mark.parametrize("columnar", [False, True],
+                         ids=["rows", "columnar"])
+@pytest.mark.parametrize("name,app,head,fusible",
+                         CHAIN_CORPUS,
+                         ids=[c[0] for c in CHAIN_CORPUS])
+def test_fused_equals_unfused(name, app, head, fusible, columnar):
+    fused = _run(app, head, fused=True, columnar=columnar,
+                 fusible=fusible)
+    unfused = _run(app, head, fused=False, columnar=columnar,
+                   fusible=fusible)
+    assert fused == unfused
+
+
+@pytest.mark.parametrize("restore_fused", [True, False],
+                         ids=["restore-fused", "restore-unfused"])
+def test_snapshot_restore_crosses_fusion_modes(restore_fused):
+    """A snapshot taken mid-run under fusion restores bit-equal into
+    either mode (and vice versa) — donation + fusion never leak into
+    the persisted state layout."""
+    app = CHAIN_CORPUS[1][1]  # window_head: has timer windows
+    events = _events(n=20, seed=7)
+    cut = 10
+
+    full_ref, _, _ = _run(app, "q1", fused=not restore_fused,
+                          columnar=False, events=events)
+
+    rt, got1 = _build(app, fused=True)
+    h = rt.get_input_handler("S")
+    for ts, data in events[:cut]:
+        h.send(Event(ts, data))
+    snap = rt.snapshot()
+    rt.shutdown()
+
+    rt2, got2 = _build(app, fused=restore_fused)
+    rt2.restore(snap)
+    h2 = rt2.get_input_handler("S")
+    for ts, data in events[cut:]:
+        h2.send(Event(ts, data))
+    rt2.shutdown()
+    assert got1 + got2 == full_ref
+
+
+def test_non_fusible_shapes_stay_unfused():
+    """Row-level consumers / fan-out on the intermediate stream block
+    fusion — and the output still matches the fused-eligible app."""
+    app = """
+        define stream S (sym string, v int, p float);
+        @info(name = 'q1') from S[v > 2] select sym, v insert into M1;
+        @info(name = 'q2') from M1 select sym, v insert into Out;
+    """
+    # fan-out: a second subscriber on M1
+    rt, _ = _build(app + """
+        @info(name = 'q3') from M1[v > 5] select sym insert into Out2;
+    """, fused=True)
+    assert rt.queries["q1"]._fused_chain is None
+    rt.shutdown()
+    # @Async intermediate stream
+    rt, _ = _build("define stream S (sym string, v int, p float);\n"
+                   "@Async(buffer.size='64')\n"
+                   "define stream M1 (sym string, v int);\n"
+                   "@info(name = 'q1') from S[v > 2] select sym, v "
+                   "insert into M1;\n"
+                   "@info(name = 'q2') from M1 select sym, v "
+                   "insert into Out;", fused=True)
+    assert rt.queries["q1"]._fused_chain is None
+    rt.shutdown()
+
+
+def test_post_start_callback_breaks_segment():
+    """add_callback on the intermediate stream AFTER start() re-derives
+    segments: the row consumer must observe every hop."""
+    app = CHAIN_CORPUS[0][1]
+    rt, got = _build(app, fused=True)
+    assert rt.queries["q1"]._fused_chain is not None
+    mids = []
+    rt.add_callback("M1", StreamCallback(fn=lambda evs: mids.extend(evs)))
+    assert rt.queries["q1"]._fused_chain is None, \
+        "segment must dissolve when M1 gains a row consumer"
+    h = rt.get_input_handler("S")
+    for ts, data in _events(8):
+        h.send(Event(ts, data))
+    rt.shutdown()
+    assert mids, "intermediate callback saw no events"
+
+
+def test_debugger_disables_fusion():
+    app = CHAIN_CORPUS[0][1]
+    os.environ["SIDDHI_TPU_FUSE"] = "1"
+    try:
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(PLAYBACK + app)
+        rt.debug()
+        rt.start()
+        assert rt.queries["q1"]._fused_chain is None
+        rt.shutdown()
+    finally:
+        os.environ.pop("SIDDHI_TPU_FUSE", None)
+
+
+def test_steady_state_zero_recompiles(monkeypatch):
+    """After warmup, chunk processing through a fused chain must hit the
+    jit caches: zero new traces across further chunks (recompiles in
+    the hot loop are the #1 TPU throughput hazard, docs/tpu_hygiene.md)."""
+    import functools
+
+    import jax
+
+    real_jit = jax.jit
+    traces = [0]
+
+    def counting_jit(f, *a, **kw):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            traces[0] += 1
+            return f(*args, **kwargs)
+        return real_jit(wrapped, *a, **kw)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+
+    rt, _ = _build(CHAIN_CORPUS[0][1], fused=True)
+    q = rt.queries["q1"]
+    assert q._fused_chain is not None
+    h = rt.get_input_handler("S")
+
+    def chunk(i):
+        n = 64
+        ts = 1_000_000 + i * n + np.arange(n, dtype=np.int64)
+        sym = np.full((n,), GLOBAL_STRINGS.encode("A"), np.int32)
+        # fixed span per chunk: sticky encodings stay put
+        v = (np.arange(n, dtype=np.int32) * 7) % 1000
+        p = np.linspace(0.0, 3.0, n, dtype=np.float32)
+        return ts, [sym, v, p]
+
+    for i in range(3):  # warmup: compiles + encoding stickiness settle
+        h.send_arrays(*chunk(i))
+    before = traces[0]
+    for i in range(3, 10):
+        h.send_arrays(*chunk(i))
+    rt.shutdown()
+    assert traces[0] == before, \
+        f"steady-state chunks triggered {traces[0] - before} new traces"
+
+
+def test_fuse_env_kill_switch():
+    rt, _ = _build(CHAIN_CORPUS[0][1], fused=False)
+    assert all(getattr(q, "_fused_chain", None) is None
+               for q in rt.queries.values())
+    rt.shutdown()
