@@ -240,15 +240,40 @@ def traced_branch_in_jit(ctx: ModuleContext) -> Iterator[Finding]:
 
 @register(
     "recompile-hazard", WARNING,
-    "Python scalars feeding shapes and non-hashable static args trigger "
-    "a fresh XLA compile per distinct value")
+    "Python scalars feeding shapes, non-hashable static args, and "
+    "per-call jax.jit wrapping trigger a fresh trace/compile per call")
 def recompile_hazard(ctx: ModuleContext) -> Iterator[Finding]:
     for node in ast.walk(ctx.tree):
         if isinstance(node, ast.Call):
+            c = ctx.canon(node.func)
+            if c == ("jax", "jit"):
+                # a FRESH jit wrapper per iteration / per call retraces
+                # every time: the in-memory jit cache is keyed on the
+                # wrapped function object, so a new lambda/closure never
+                # hits it (and re-pays persistent-cache lookups). Build
+                # the jitted step once and cache it (instance attribute
+                # or keyed dict — see core/runtime.py _step_for).
+                if ctx.in_loop(node):
+                    yield _finding(
+                        "recompile-hazard", WARNING, ctx, node,
+                        "jax.jit inside a loop builds a fresh jit "
+                        "wrapper per iteration — each one retraces and "
+                        "defeats the in-memory jit cache; hoist the "
+                        "jit out of the loop and reuse it")
+                    continue
+                parent = ctx.parent(node)
+                if isinstance(parent, ast.Call) and parent.func is node \
+                        and ctx.enclosing_function(node) is not None:
+                    yield _finding(
+                        "recompile-hazard", WARNING, ctx, node,
+                        "immediately-invoked jax.jit(...) in a per-call "
+                        "path — the wrapper (and its trace) is rebuilt "
+                        "on every call; cache the jitted function once "
+                        "and dispatch through it")
+                    continue
             fn = ctx.enclosing_jitted_function(node)
             if fn is None:
                 continue
-            c = ctx.canon(node.func)
             # a BARE param in shape position is the hazard; x.shape/x.ndim
             # of a traced arg is static metadata and fine
             bare_param = node.args and any(

@@ -375,5 +375,8 @@ class AggregationRuntime(Receiver):
             return jax.device_get(self.states)
 
     def restore_state(self, snap: dict) -> None:
+        from .runtime import _fresh_device
         with self._lock:
-            self.states = snap
+            # fresh device buffers: snapshots hold host numpy that
+            # device_put may alias zero-copy (see runtime._fresh_device)
+            self.states = _fresh_device(snap)

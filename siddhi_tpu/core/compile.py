@@ -1,0 +1,515 @@
+"""Ahead-of-time compile service: parallel step lowering + persistent
+cache telemetry (docs/compile_cache.md).
+
+Every query in an app compiles to one (or a few) jitted step programs.
+Left to the default lazy path, those programs compile serially, one at a
+time, on the first chunk that reaches each query — for a realistic app
+that is minutes of wall clock before the first result, paid AFTER
+traffic has started arriving (the r01..r05 bench rounds all died inside
+this phase). Siddhi deploys in milliseconds because its executor tree
+is interpreted; the TPU build gets the same deploy-time behavior by
+compiling everything up front, in parallel, and persisting the results:
+
+1. `CompileService.specs(buckets)` enumerates every jitted step the app
+   can dispatch for the configured ingest buckets — per-query row +
+   packed steps, fused-chain steps, per-stream pattern steps, join side
+   steps, partition trigger steps, and the cap-16 timer-batch shapes —
+   together with zero-filled arguments of the exact shapes/dtypes the
+   runtime will pass.
+2. `warmup()` executes each spec once on a thread pool. XLA compilation
+   releases the GIL, so N steps compile concurrently and wall time is
+   max(compile) instead of sum(compile). Warming by *calling the
+   runtime's own cached jit object* (not a parallel AOT handle)
+   guarantees the dispatch-path caches are the ones that get hot: the
+   first real chunk performs zero traces and zero compiles.
+3. Compiles are persisted via JAX's compilation cache
+   (`SIDDHI_TPU_CACHE_DIR`, wired in `siddhi_tpu/__init__.py` with
+   min-compile-time/min-entry-size 0 so every program is written).
+   A warm process start loads executables from disk instead of
+   recompiling; the hit/miss counters below make that observable.
+
+Packed-ingest steps are keyed by the sticky per-stream encoding tuple
+(core/ingest.py). Traffic has not arrived at warmup time, so the service
+compiles the encoder's INITIAL encoding by default (affine timestamps +
+constant columns — what the first chunk of zeros-and-ramps traffic
+produces) and accepts per-stream `samples` to derive the encoding real
+traffic will settle on.
+
+Telemetry (per app, cumulative over warmups) surfaces through
+`SiddhiAppRuntime.statistics()["compile"]` and the warmup() return
+value: program count, compile wall ms, persistent-cache hits/misses,
+and at DETAIL stats level the per-step timing list.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .event import EventBatch, StreamSchema
+from .ingest import initial_encoding, encoding_for_sample, zero_packed_buffer
+
+# -- persistent-cache hit/miss counters --------------------------------------
+# jax.monitoring events are process-global; one listener feeds every
+# CompileService (snapshots delta around each warmup).
+
+_CACHE_COUNTS = {"hits": 0, "misses": 0}
+_CACHE_LOCK = threading.Lock()
+
+
+def _cache_event(event: str, **kwargs) -> None:
+    if event == "/jax/compilation_cache/cache_hits":
+        with _CACHE_LOCK:
+            _CACHE_COUNTS["hits"] += 1
+    elif event == "/jax/compilation_cache/cache_misses":
+        with _CACHE_LOCK:
+            _CACHE_COUNTS["misses"] += 1
+
+
+try:  # monitoring is a stable public module, but stay import-safe
+    jax.monitoring.register_event_listener(_cache_event)
+except Exception:  # noqa: BLE001 — telemetry is best-effort
+    pass
+
+
+def cache_counts() -> dict:
+    with _CACHE_LOCK:
+        return dict(_CACHE_COUNTS)
+
+
+def warm_buckets_from_env() -> tuple:
+    """`SIDDHI_TPU_WARM_BUCKETS='1024,65536'` -> (1024, 65536). Unset or
+    empty/'0' means no automatic warmup at start()."""
+    raw = os.environ.get("SIDDHI_TPU_WARM_BUCKETS", "")
+    if not raw or raw.strip() in ("0", "off"):
+        return ()
+    from .runtime import bucket_capacity
+    out = []
+    for part in raw.split(","):
+        part = part.strip()
+        if part:
+            out.append(bucket_capacity(int(part)))
+    return tuple(sorted(set(out)))
+
+
+def _workers_from_env() -> int:
+    raw = os.environ.get("SIDDHI_TPU_COMPILE_WORKERS", "")
+    if raw:
+        return max(1, int(raw))
+    return max(1, min(8, os.cpu_count() or 1))
+
+
+# -- zero-argument builders ---------------------------------------------------
+
+
+def _zeros_like_tree(tree):
+    """Zero device arrays shaped like a live state pytree. Fresh buffers,
+    never the runtime's own state: the warm call donates its arguments."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(jnp.shape(x), jnp.result_type(x)), tree)
+
+
+def _zero_batch(schema: StreamSchema, capacity: int) -> EventBatch:
+    return EventBatch.empty(schema, capacity)
+
+
+def _zero_now():
+    return jnp.asarray(0, dtype=jnp.int64)
+
+
+class CompileSpec:
+    """One warmable program: a display key + a builder that returns
+    (jitted_fn, args). The builder runs on the main thread (it touches
+    the runtime's jit caches); the call runs on the pool."""
+
+    __slots__ = ("key", "build")
+
+    def __init__(self, key: str, build: Callable):
+        self.key = key
+        self.build = build
+
+
+class CompileService:
+    """Per-app AOT compiler: enumerate + compile every step program."""
+
+    def __init__(self, app):
+        self.app = app
+        self.records: list[dict] = []   # [{"step", "ms"}...]
+        self.total_ms = 0.0
+        self.programs = 0
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.warmups = 0
+        self._lock = threading.Lock()
+
+    # -- enumeration -----------------------------------------------------
+
+    def _encodings(self, schema: StreamSchema, samples: Optional[dict]):
+        """Packed encodings to warm for one stream: the encoder's initial
+        (cold) encoding, plus the sticky encoding a traffic sample would
+        settle on."""
+        encs = [initial_encoding(schema)]
+        if samples and schema.stream_id in samples:
+            ts, cols = samples[schema.stream_id]
+            enc = encoding_for_sample(schema, ts, cols)
+            if enc not in encs:
+                encs.append(enc)
+        return encs
+
+    def specs(self, buckets, samples: Optional[dict] = None) -> list:
+        """Every step program the app can dispatch for the given ingest
+        buckets, deduplicated by key. Mirrors the dispatch paths:
+        send_arrays' per-junction capacity negotiation, process_batch's
+        sort-heavy splitting, and the cap-16 timer-batch shapes."""
+        from .runtime import (BATCH_BUCKETS, JoinStreamReceiver,
+                              PatternStreamReceiver, QueryRuntime,
+                              bucket_capacity)
+        from ..parallel.partition import BlockStreamReceiver
+        app = self.app
+        buckets = tuple(sorted({bucket_capacity(int(b)) for b in buckets}))
+        timer_cap = BATCH_BUCKETS[0]
+        specs: dict[str, CompileSpec] = {}
+
+        def add(key: str, build: Callable) -> None:
+            if key not in specs:
+                specs[key] = CompileSpec(key, build)
+
+        fused_members = set()
+        for q in app.queries.values():
+            ch = getattr(q, "_fused_chain", None)
+            if ch is not None:
+                for m in ch.queries[1:]:
+                    fused_members.add(id(m))
+
+        # -- ingest-path steps, per junction (send_arrays negotiation) ---
+        for sid, j in app.junctions.items():
+            receivers = list(j.receivers)
+            if not receivers or not buckets:
+                continue
+            packed_ok = all(getattr(r, "supports_packed", False)
+                            for r in receivers)
+            jcap = BATCH_BUCKETS[-1]
+            for r in receivers:
+                if packed_ok:
+                    rc = getattr(r, "max_packed_capacity",
+                                 getattr(r, "max_step_capacity", None))
+                else:
+                    rc = getattr(r, "max_step_capacity", None)
+                if rc is not None:
+                    jcap = min(jcap, rc)
+            if j.async_conf is not None:
+                jcap = min(jcap, j.async_conf[1])
+            caps = sorted({bucket_capacity(min(B, jcap)) for B in buckets})
+            for r in receivers:
+                if isinstance(r, QueryRuntime):
+                    if id(r) in fused_members:
+                        continue  # fused segments dispatch via the head
+                    target = r._fused_chain or r
+                    self._query_specs(add, target, j.schema, caps,
+                                      packed_ok, samples)
+                elif isinstance(r, PatternStreamReceiver):
+                    self._pattern_specs(add, r.runtime, r.stream_id,
+                                        j.schema, caps, packed_ok, samples)
+                elif isinstance(r, JoinStreamReceiver):
+                    self._join_specs(add, r.runtime, r.side, j.schema,
+                                     caps, packed_ok, samples)
+                elif isinstance(r, BlockStreamReceiver):
+                    self._partition_specs(add, r.block, sid, j.schema,
+                                          caps)
+
+        # -- named windows: fed by InsertIntoWindowHandler at the feeding
+        # query's batch capacity (approximated by the ingest buckets)
+        if buckets:
+            for wq in app.named_windows.values():
+                caps = sorted({bucket_capacity(
+                    min(B, wq.max_step_capacity or B)) for B in buckets})
+                self._query_specs(add, wq, wq.in_schema, caps,
+                                  packed_ok=False, samples=samples)
+
+        # -- timer-batch steps (cap-16 row shapes, scheduler-driven) ------
+        for q in list(app.queries.values()) + list(
+                app.named_windows.values()):
+            self._timer_specs(add, q, timer_cap)
+        for block in app.partitions.values():
+            self._partition_timer_specs(add, block, timer_cap)
+        return list(specs.values())
+
+    # -- per-runtime spec builders ---------------------------------------
+
+    def _query_specs(self, add, q, schema, caps, packed_ok, samples):
+        """Row + packed steps for a plain QueryRuntime or a FusedChain."""
+        from .runtime import FusedChain
+        fused = isinstance(q, FusedChain)
+        name = q.name
+        app = self.app
+
+        def tstates_zero():
+            return {t: _zeros_like_tree(app.tables[t].state)
+                    for t in q.table_deps}
+
+        def states_zero():
+            if fused:
+                return (tuple(_zeros_like_tree(m.states)
+                              for m in q.queries),
+                        tuple(jnp.asarray(0, jnp.int64)
+                              for _ in q.queries))
+            return (_zeros_like_tree(q.states), jnp.asarray(0, jnp.int64))
+
+        head = q.head if fused else q
+        row_caps = sorted({min(c, head.max_step_capacity or c)
+                           for c in caps})
+        for cap in row_caps:
+            def build(cap=cap):
+                states, emitted = states_zero()
+                fn = q._step_for() if fused else q._step_for(cap)
+                return fn, (states, tstates_zero(), emitted,
+                            _zero_batch(schema, cap), _zero_now())
+            add(f"{name}/row/{cap}", build)
+        if packed_ok:
+            pk_caps = sorted({min(c, head.max_packed_capacity or c)
+                              for c in caps})
+            for enc in self._encodings(schema, samples):
+                for cap in pk_caps:
+                    def build(enc=enc, cap=cap):
+                        states, emitted = states_zero()
+                        fn = q._packed_step_for(enc, cap)
+                        return fn, (states, tstates_zero(), emitted,
+                                    zero_packed_buffer(schema, enc, cap))
+                    add(f"{name}/packed/{cap}/{','.join(enc)}", build)
+
+    def _pattern_specs(self, add, q, stream_id, schema, caps, packed_ok,
+                       samples):
+        app = self.app
+
+        def tstates_zero():
+            return {t: _zeros_like_tree(app.tables[t].state)
+                    for t in q.table_deps}
+
+        row_caps = sorted({min(c, q.max_step_capacity or c) for c in caps})
+        for cap in row_caps:
+            def build(cap=cap):
+                fn = q._step_for_stream(stream_id)
+                return fn, (_zeros_like_tree(q.nfa_state),
+                            _zeros_like_tree(q.states), tstates_zero(),
+                            jnp.asarray(0, jnp.int64),
+                            _zero_batch(schema, cap), _zero_now())
+            add(f"{q.name}/pattern/{stream_id}/row/{cap}", build)
+        if packed_ok:
+            for enc in self._encodings(schema, samples):
+                for cap in row_caps:
+                    def build(enc=enc, cap=cap):
+                        fn = q._step_for_stream(stream_id, (enc, cap))
+                        return fn, (_zeros_like_tree(q.nfa_state),
+                                    _zeros_like_tree(q.states),
+                                    tstates_zero(),
+                                    jnp.asarray(0, jnp.int64),
+                                    zero_packed_buffer(schema, enc, cap))
+                    add(f"{q.name}/pattern/{stream_id}/packed/{cap}/"
+                        f"{','.join(enc)}", build)
+
+    def _join_specs(self, add, q, side, schema, caps, packed_ok, samples):
+        app = self.app
+        opp = "R" if side == "L" else "L"
+
+        def tstates_zero():
+            return {t: _zeros_like_tree(app.tables[t].state)
+                    for t in q.table_deps}
+
+        def side_zero(s):
+            return _zeros_like_tree(q.side_states[s])
+
+        row_caps = sorted({min(c, q.max_step_capacity or c) for c in caps})
+        for cap in row_caps:
+            def build(cap=cap):
+                fn = q._step_for_side(side)
+                return fn, (side_zero(side), side_zero(opp),
+                            _zeros_like_tree(q.states), tstates_zero(),
+                            jnp.asarray(0, jnp.int64),
+                            _zero_batch(schema, cap), _zero_now())
+            add(f"{q.name}/join/{side}/row/{cap}", build)
+        if packed_ok:
+            for enc in self._encodings(schema, samples):
+                for cap in row_caps:
+                    def build(enc=enc, cap=cap):
+                        fn = q._step_for_side(side, (enc, cap))
+                        return fn, (side_zero(side), side_zero(opp),
+                                    _zeros_like_tree(q.states),
+                                    tstates_zero(),
+                                    jnp.asarray(0, jnp.int64),
+                                    zero_packed_buffer(schema, enc, cap))
+                    add(f"{q.name}/join/{side}/packed/{cap}/"
+                        f"{','.join(enc)}", build)
+
+    def _partition_specs(self, add, block, stream_id, schema, caps):
+        row_caps = sorted({min(c, block.max_step_capacity or c)
+                           for c in caps})
+        for cap in row_caps:
+            def build(cap=cap):
+                fn = block._step_for(("stream", stream_id), cap)
+                return fn, (_zeros_like_tree(block.slot_tbl),
+                            _zeros_like_tree(block.qstates),
+                            _zeros_like_tree(block._emitted),
+                            _zeros_like_tree(block._lost),
+                            _zero_batch(schema, cap), _zero_now())
+            add(f"{block.name}/stream/{stream_id}/{cap}", build)
+
+    def _partition_timer_specs(self, add, block, timer_cap):
+        for plan in block.plans:
+            if not block._has_timers.get(plan.name):
+                continue
+
+            def build(plan=plan):
+                fn = block._step_for(("timer", plan.name), timer_cap)
+                return fn, (_zeros_like_tree(block.slot_tbl),
+                            _zeros_like_tree(block.qstates),
+                            _zeros_like_tree(block._emitted),
+                            _zeros_like_tree(block._lost),
+                            _zero_batch(plan.in_schema, timer_cap),
+                            _zero_now())
+            add(f"{block.name}/timer/{plan.name}/{timer_cap}", build)
+
+    def _timer_specs(self, add, q, timer_cap):
+        """Scheduler-driven shapes: cap-16 TIMER batches run through the
+        row steps; absent-pattern engines add a dedicated timer step and
+        a due readback program."""
+        from .runtime import (FusedChain, JoinQueryRuntime,
+                              PatternQueryRuntime, QueryRuntime)
+        app = self.app
+        if isinstance(q, PatternQueryRuntime):
+            if not getattr(q.engine, "has_absent", False):
+                return
+
+            def build_timer():
+                fn = q._timer_step_for()
+                return fn, (_zeros_like_tree(q.nfa_state),
+                            _zeros_like_tree(q.states),
+                            jnp.asarray(0, jnp.int64), _zero_now())
+            add(f"{q.name}/pattern/timer", build_timer)
+
+            def build_due():
+                fn = q._due_fn_for()
+                return fn, (_zeros_like_tree(q.nfa_state),)
+            add(f"{q.name}/pattern/due", build_due)
+            return
+        if isinstance(q, JoinQueryRuntime):
+            if not q._has_timers:
+                return
+            for side in ("L", "R"):
+                schema = q.in_schemas[side]
+
+                def build(side=side, schema=schema):
+                    fn = q._step_for_side(side)
+                    opp = "R" if side == "L" else "L"
+                    return fn, (
+                        _zeros_like_tree(q.side_states[side]),
+                        _zeros_like_tree(q.side_states[opp]),
+                        _zeros_like_tree(q.states),
+                        {t: _zeros_like_tree(app.tables[t].state)
+                         for t in q.table_deps},
+                        jnp.asarray(0, jnp.int64),
+                        _zero_batch(schema, timer_cap), _zero_now())
+                add(f"{q.name}/join/{side}/row/{timer_cap}", build)
+            return
+        if isinstance(q, QueryRuntime):
+            if not q._has_timers:
+                return
+            target = q._fused_chain or q
+
+            def build():
+                fused = isinstance(target, FusedChain)
+                if fused:
+                    states = (tuple(_zeros_like_tree(m.states)
+                                    for m in target.queries),
+                              tuple(jnp.asarray(0, jnp.int64)
+                                    for _ in target.queries))
+                else:
+                    states = (_zeros_like_tree(q.states),
+                              jnp.asarray(0, jnp.int64))
+                st, emitted = states
+                fn = target._step_for() if fused \
+                    else target._step_for(timer_cap)
+                tst = {t: _zeros_like_tree(app.tables[t].state)
+                       for t in target.table_deps}
+                return fn, (st, tst, emitted,
+                            _zero_batch(q.in_schema, timer_cap),
+                            _zero_now())
+            add(f"{target.name}/row/{timer_cap}", build)
+
+    # -- execution -------------------------------------------------------
+
+    def warmup(self, buckets=None, samples: Optional[dict] = None,
+               workers: Optional[int] = None) -> dict:
+        """Compile every enumerated step, concurrently. Returns (and
+        accumulates) telemetry: programs, compile_ms, cache hits/misses,
+        per-step records."""
+        if buckets is None:
+            buckets = warm_buckets_from_env()
+        specs = self.specs(buckets, samples=samples)
+        before = cache_counts()
+        t0 = time.perf_counter()
+        records: list[dict] = []
+        errors: list[dict] = []
+
+        def run(spec: CompileSpec) -> None:
+            s0 = time.perf_counter()
+            try:
+                fn, args = spec.build()
+                out = fn(*args)
+                jax.block_until_ready(out)
+            except Exception as e:  # noqa: BLE001 — warmup is best-effort:
+                # a failed spec falls back to lazy compile on first chunk
+                errors.append({"step": spec.key,
+                               "error": f"{type(e).__name__}: {e}"})
+                return
+            records.append({"step": spec.key,
+                            "ms": round((time.perf_counter() - s0) * 1e3,
+                                        1)})
+
+        nworkers = workers or _workers_from_env()
+        if specs:
+            with ThreadPoolExecutor(max_workers=nworkers) as pool:
+                list(pool.map(run, specs))
+        if errors:
+            logging.getLogger("siddhi_tpu.compile").warning(
+                "app '%s': %d warmup spec(s) failed and will compile "
+                "lazily: %s", self.app.name, len(errors), errors[:3])
+        wall = time.perf_counter() - t0
+        after = cache_counts()
+        result = {
+            "programs": len(records),
+            "seconds": round(wall, 3),
+            "compile_ms": round(wall * 1e3, 1),
+            "cache_hits": after["hits"] - before["hits"],
+            "cache_misses": after["misses"] - before["misses"],
+            "steps": sorted(records, key=lambda r: -r["ms"]),
+        }
+        if errors:
+            result["errors"] = errors
+        with self._lock:
+            self.warmups += 1
+            self.programs += result["programs"]
+            self.total_ms += result["compile_ms"]
+            self.cache_hits += result["cache_hits"]
+            self.cache_misses += result["cache_misses"]
+            self.records.extend(records)
+        return result
+
+    def summary(self, detail: bool = False) -> dict:
+        with self._lock:
+            out = {
+                "warmups": self.warmups,
+                "programs": self.programs,
+                "compile_ms": round(self.total_ms, 1),
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+            }
+            if detail:
+                out["steps"] = sorted(self.records,
+                                      key=lambda r: -r["ms"])
+        return out

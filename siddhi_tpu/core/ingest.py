@@ -72,6 +72,41 @@ def layout(n_cols: int, enc: tuple, capacity: int):
     return H, offs, o
 
 
+def initial_encoding(schema: StreamSchema) -> tuple:
+    """The sticky encoding a fresh PackedEncoder starts from (affine
+    timestamps, every column constant). This is the encoding tuple the
+    FIRST chunk of a stream compiles against unless the data forces a
+    widening — the AOT compile service (core/compile.py) precompiles
+    packed steps for it so cold starts hit a ready program."""
+    return ("aff",) + ("c",) * len(schema.types)
+
+
+def encoding_for_sample(schema: StreamSchema, ts, cols,
+                        now: int = 0) -> tuple:
+    """The sticky encoding a traffic sample settles on: run a throwaway
+    encoder over the sample and return its (widened) tuple. Lets
+    warmup() precompile the packed step real traffic will dispatch."""
+    enc = PackedEncoder(schema)
+    ts = np.ascontiguousarray(ts, dtype=np.int64)
+    _, tup, _ = enc.encode(ts, cols, _sample_capacity(len(ts)), now)
+    return tup
+
+
+def _sample_capacity(n: int) -> int:
+    cap = 8
+    while cap < n:
+        cap *= 2
+    return cap
+
+
+def zero_packed_buffer(schema: StreamSchema, enc: tuple, capacity: int):
+    """A device-resident all-zero packed buffer for (enc, capacity) —
+    the abstract argument the compile service warms packed steps with
+    (header decodes as n=0: every row is padding)."""
+    _, _, total = layout(len(schema.types), enc, capacity)
+    return jax.device_put(np.zeros((total,), np.uint8))
+
+
 def _int_code(span: int) -> str:
     if span < 2 ** 8:
         return "d8"

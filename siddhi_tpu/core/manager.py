@@ -49,6 +49,14 @@ class SiddhiManager:
             app_ast = source
         SiddhiAppRuntime(app_ast, manager=None)
 
+    def warmup(self, buckets=None, samples=None, workers=None) -> dict:
+        """AOT-compile every registered app's step programs (see
+        SiddhiAppRuntime.warmup / docs/compile_cache.md). Returns
+        {app_name: warmup telemetry}."""
+        return {name: rt.warmup(buckets=buckets, samples=samples,
+                                workers=workers)
+                for name, rt in self.app_runtimes.items()}
+
     def set_extension(self, name: str, ext) -> None:
         self.extensions[name.lower()] = ext
 

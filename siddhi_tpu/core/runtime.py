@@ -1047,21 +1047,22 @@ class PatternQueryRuntime(QueryRuntime):
         self._schedule_absent()
 
     # -- absent-pattern timers -------------------------------------------
+    def _due_fn_for(self) -> Callable:
+        if self._due_fn is None:
+            self._due_fn = jax.jit(self.engine.next_due)
+        return self._due_fn
+
     def _schedule_absent(self) -> None:
         """After a step: schedule a wakeup at the earliest live absent
         deadline (AbsentStreamPreStateProcessor's scheduler role)."""
         if not getattr(self.engine, "has_absent", False):
             return
-        if self._due_fn is None:
-            eng = self.engine
-            self._due_fn = jax.jit(eng.next_due)
-        due = int(jax.device_get(self._due_fn(self.nfa_state)))
+        due = int(jax.device_get(self._due_fn_for()(self.nfa_state)))
         self._schedule(due)
 
-    def _on_timer(self, due: int) -> None:
-        self._sched_due = None
-        if not self.app.running:
-            return
+    def _timer_step_for(self) -> Callable:
+        """The absent-deadline timer step, built once and cached on the
+        instance (the compile service AOT-warms it at start)."""
         if self._timer_step is None:
             tstep = self.engine.make_timer_step()
             sel_ops = self.operators
@@ -1076,6 +1077,13 @@ class PatternQueryRuntime(QueryRuntime):
                 return nfa_state, tuple(new_sel), emitted, match
 
             self._timer_step = jax.jit(full, **_donate(0, 1, 2))
+        return self._timer_step
+
+    def _on_timer(self, due: int) -> None:
+        self._sched_due = None
+        if not self.app.running:
+            return
+        self._timer_step_for()
         with self._lock:
             (self.nfa_state, self.states, self._emitted_dev,
              out) = self._timer_step(self.nfa_state, self.states,
@@ -1517,6 +1525,11 @@ class SiddhiAppRuntime:
         self.scheduler = Scheduler(playback=False, barrier=self.barrier)
         self.scheduler.resolve_hook = self._resolve_dues
         Planner(self).plan()
+        # AOT compile service (core/compile.py): warmup() lowers and
+        # compiles every step program in parallel; start() triggers it
+        # for the buckets configured via SIDDHI_TPU_WARM_BUCKETS
+        from .compile import CompileService
+        self.compile_service = CompileService(self)
         self.scheduler.playback = self._playback
         # start-state absent deadlines are based at app start, not the
         # first event (AbsentStreamPreStateProcessor.partitionCreated);
@@ -1780,6 +1793,12 @@ class SiddhiAppRuntime:
         errors = self.error_stats.snapshot()
         if errors:
             report["stream_errors"] = errors
+        # AOT compile telemetry (only once a warmup ran): program count,
+        # compile wall ms, persistent-cache hits/misses; DETAIL level
+        # adds the per-step timing list
+        if self.compile_service.warmups:
+            report["compile"] = self.compile_service.summary(
+                detail=self.stats_level >= 2)
         return report
 
     def debug(self):
@@ -1790,9 +1809,39 @@ class SiddhiAppRuntime:
         self._build_fused_chains()
         return self.debugger
 
+    # -- AOT compile (core/compile.py, docs/compile_cache.md) -------------
+    def warmup(self, buckets=None, samples=None, workers=None) -> dict:
+        """Ahead-of-time compile every step program for the given ingest
+        buckets (default: SIDDHI_TPU_WARM_BUCKETS; with no buckets
+        configured only the cap-16 timer-batch shapes compile).
+        Lowering/compiling runs concurrently on a thread pool — XLA
+        releases the GIL — so wall time is the slowest single compile,
+        not the sum. `samples` maps stream ids to (ts, cols) arrays so
+        packed steps compile for the encoding real traffic settles on.
+        Returns telemetry: programs, compile_ms, cache_hits/misses,
+        per-step timings (also surfaced via statistics()['compile'])."""
+        if not self.running:
+            # segments must exist before enumeration so the warmed steps
+            # are the ones traffic will dispatch
+            self._build_fused_chains()
+        return self.compile_service.warmup(buckets=buckets,
+                                           samples=samples,
+                                           workers=workers)
+
+    def _maybe_aot_warmup(self) -> None:
+        from .compile import warm_buckets_from_env
+        buckets = warm_buckets_from_env()
+        if buckets:
+            self.compile_service.warmup(buckets=buckets)
+
     def start(self) -> None:
         self.running = True
         self._build_fused_chains()
+        # compile every step program for the configured ingest buckets
+        # BEFORE sources connect: traffic arriving the moment the app
+        # deploys hits ready executables instead of a serial lazy
+        # compile queue (north star: start in seconds, not minutes)
+        self._maybe_aot_warmup()
         self.scheduler.start()
         self._start_record_tables()
         for s in self.sources:
@@ -1854,6 +1903,7 @@ class SiddhiAppRuntime:
         :495): run queries but keep sources disconnected."""
         self.running = True
         self._build_fused_chains()
+        self._maybe_aot_warmup()
         self.scheduler.start()
         self._start_record_tables()
         if not self._playback:

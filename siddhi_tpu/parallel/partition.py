@@ -552,12 +552,16 @@ class PartitionBlockRuntime:
             return snap
 
     def restore_state(self, snap: dict) -> None:
+        from ..core.runtime import _fresh_device
         with self._lock:
-            self.slot_tbl = snap["slot_tbl"]
-            self.qstates = snap["qstates"]
-            self._emitted = {k: jnp.asarray(v)
+            # snapshot payloads are host numpy; device_put may alias them
+            # zero-copy, so every restore routes through _fresh_device
+            # before the state re-enters a step (core/runtime.py)
+            self.slot_tbl = _fresh_device(snap["slot_tbl"])
+            self.qstates = _fresh_device(snap["qstates"])
+            self._emitted = {k: jnp.array(v, copy=True)
                              for k, v in snap["emitted"].items()}
-            self._lost = {k: jnp.asarray(v)
+            self._lost = {k: jnp.array(v, copy=True)
                           for k, v in snap["lost"].items()}
             for qn in self._sched_due:
                 self._sched_due[qn] = None

@@ -16,3 +16,22 @@ def mutable_static(x, opts=[]):  # line 12: non-hashable default
 @jax.jit
 def fine(x):
     return jnp.zeros(x.shape)    # shape from a traced arg's .shape: fine
+
+
+def rejit_in_loop(chunks):
+    outs = []
+    for c in chunks:
+        step = jax.jit(lambda x: x + 1)   # line 24: fresh jit per iter
+        outs.append(step(c))
+    return outs
+
+
+def rejit_per_call(x):
+    return jax.jit(lambda v: v * 2)(x)    # line 30: jit rebuilt per call
+
+
+_CACHED = jax.jit(lambda x: x * 3)        # module-level, built once: fine
+
+
+def cached_dispatch(x):
+    return _CACHED(x)                     # reuse: fine

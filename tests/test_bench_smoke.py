@@ -41,6 +41,11 @@ def test_bench_filter_quick_parses():
     d = _run_config("filter")
     assert d["unit"] == "events/s"
     assert d["value"] > 0 and d["events"] > 0
+    # AOT compile phase must be reported (the PR-5 acceptance metric):
+    # compile wall ms + dispatch-ready time-to-first-result
+    assert d["compile_ms"] > 0
+    assert d["ttfr_ms"] > 0
+    assert d["warm_programs"] > 0
 
 
 def test_bench_chain3_quick_parses_fused_vs_unfused():
@@ -51,3 +56,4 @@ def test_bench_chain3_quick_parses_fused_vs_unfused():
     # acceptance metric)
     assert d["fused_eps"] > 0 and d["unfused_eps"] > 0
     assert d["fused_speedup"] > 0
+    assert d["compile_ms"] > 0 and d["ttfr_ms"] > 0

@@ -1,0 +1,166 @@
+"""Donation-safety regression: every snapshot/restore path must route
+restored state through `_fresh_device` (fresh device buffers) before a
+donated step runs.
+
+Snapshot payloads hold host numpy arrays (device_get), and jax may alias
+a numpy buffer ZERO-COPY on device_put. Donating such an aliased buffer
+to a step (`donate_argnums`, PR 4) frees memory numpy still owns — a
+hard crash ("double free or corruption"). The guard is `_fresh_device`
+(core/runtime.py); these tests assert every restore path produces fresh
+device arrays (never raw numpy leaves) and that processing resumes
+through the donated steps afterwards — including the fused-chain and
+partition restore paths.
+"""
+import numpy as np
+
+import jax
+
+from siddhi_tpu import Event, SiddhiManager, StreamCallback
+
+TS0 = 1_700_000_000_000
+
+
+def assert_fresh(tree, label, allow_empty=False):
+    """Every leaf must be a device array (a _fresh_device copy), never a
+    numpy view of the snapshot payload. Stateless operators (filters,
+    projections) legitimately carry empty state tuples."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not allow_empty:
+        assert leaves, f"{label}: no state leaves"
+    for leaf in leaves:
+        assert isinstance(leaf, jax.Array), \
+            f"{label}: restored leaf is {type(leaf).__name__}, " \
+            "not a fresh device array (_fresh_device must run on restore)"
+        assert not isinstance(leaf, np.ndarray), label
+
+
+def _send(rt, stream, rows, ts0=TS0):
+    h = rt.get_input_handler(stream)
+    for i, data in enumerate(rows):
+        h.send(Event(ts0 + i, tuple(data)))
+
+
+def test_query_restore_is_fresh_before_donated_step():
+    app = """
+        @app:playback
+        define stream S (sym string, v int);
+        @info(name = 'q') from S#window.time(2 sec)
+        select sym, sum(v) as total group by sym insert into Out;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("Out", StreamCallback(fn=got.extend))
+    rt.start()
+    _send(rt, "S", [("a", 1), ("b", 2)])
+    snap = rt.snapshot()
+    rt.restore(snap)
+    q = rt.queries["q"]
+    assert_fresh(q.states, "query.states")
+    # the donated step must run cleanly on the restored buffers
+    _send(rt, "S", [("a", 3)], ts0=TS0 + 10)
+    rt.shutdown()
+    assert got
+
+
+def test_fused_chain_restore_is_fresh_before_donated_step():
+    app = """
+        @app:playback
+        define stream S (sym string, v int);
+        @info(name = 'q1') from S#window.time(2 sec)
+        select sym, sum(v) as total group by sym insert into M1;
+        @info(name = 'q2') from M1[total > 0] select sym, total
+        insert into Out;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("Out", StreamCallback(fn=got.extend))
+    rt.start()
+    head = rt.queries["q1"]
+    assert head._fused_chain is not None, "chain must fuse"
+    _send(rt, "S", [("a", 1), ("a", 2)])
+    snap = rt.snapshot()
+    rt.restore(snap)
+    assert_fresh(head.states, "fused head q1")
+    for member in head._fused_chain.queries:
+        assert_fresh(member.states, f"fused member {member.name}",
+                     allow_empty=True)
+    # the fused (donated) chain step runs on the restored buffers
+    _send(rt, "S", [("a", 3)], ts0=TS0 + 10)
+    rt.shutdown()
+    assert got
+
+
+def test_partition_restore_is_fresh():
+    app = """
+        @app:playback
+        define stream S (sym string, v int);
+        partition with (sym of S)
+        begin
+            @info(name = 'pq') from S#window.time(2 sec)
+            select sym, sum(v) as total group by sym insert into POut;
+        end;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("POut", StreamCallback(fn=got.extend))
+    rt.start()
+    _send(rt, "S", [("a", 1), ("b", 2), ("a", 3)])
+    snap = rt.snapshot()
+    rt.restore(snap)
+    block = next(iter(rt.partitions.values()))
+    assert_fresh(block.slot_tbl, "partition.slot_tbl")
+    assert_fresh(block.qstates, "partition.qstates")
+    assert_fresh(block._emitted, "partition.emitted")
+    assert_fresh(block._lost, "partition.lost")
+    _send(rt, "S", [("b", 4)], ts0=TS0 + 10)
+    rt.shutdown()
+    assert got
+
+
+def test_join_restore_is_fresh_before_donated_step():
+    app = """
+        @app:playback
+        define stream L (sym string, price float);
+        define stream R (sym string, tweets int);
+        @info(name = 'jq') @cap(window.size='64', join.pairs='256')
+        from L#window.time(1 sec) join R#window.time(1 sec)
+        on L.sym == R.sym
+        select L.sym, price, tweets insert into Out;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(app)
+    got = []
+    rt.add_callback("Out", StreamCallback(fn=got.extend))
+    rt.start()
+    _send(rt, "L", [("a", 1.0)])
+    _send(rt, "R", [("a", 7)], ts0=TS0 + 1)
+    snap = rt.snapshot()
+    rt.restore(snap)
+    jq = rt.queries["jq"]
+    assert_fresh(jq.states, "join.sel_states", allow_empty=True)
+    assert_fresh(jq.side_states, "join.side_states")
+    _send(rt, "L", [("a", 2.0)], ts0=TS0 + 5)
+    _send(rt, "R", [("a", 9)], ts0=TS0 + 6)
+    rt.shutdown()
+    assert got
+
+
+def test_aggregation_restore_is_fresh():
+    app = """
+        @app:playback
+        define stream T (sym string, p double, ts long);
+        define aggregation Agg from T
+        select sym, sum(p) as tp group by sym
+        aggregate by ts every seconds;
+    """
+    rt = SiddhiManager().create_siddhi_app_runtime(app)
+    rt.start()
+    _send(rt, "T", [("a", 2.0, 1000), ("a", 3.0, 1500)], ts0=100)
+    snap = rt.snapshot()
+    rt.restore(snap)
+    agg = rt.aggregations["Agg"]
+    assert_fresh(agg.states, "aggregation.states")
+    _send(rt, "T", [("a", 5.0, 1600)], ts0=110)
+    rows = rt.query("from Agg within 0L, 10000L per 'seconds' "
+                    "select sym, tp")
+    rt.shutdown()
+    assert rows == [("a", 10.0)]
