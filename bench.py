@@ -110,7 +110,12 @@ def _warm(rt, n, chunk=None, extra_caps=(), samples=None):
     report the compile phase: compile_ms (parallel wall), persistent
     cache hits/misses, and program count. Runs BEFORE the timed first
     send, so `ttfr_ms` below measures dispatch-ready time-to-first-
-    result, not a lazy compile stall."""
+    result, not a lazy compile stall.
+
+    Also enables BASIC statistics: host-boundary counters only (no
+    device syncs — docs/observability.md), so throughput/queue-depth
+    gauges land in the per-config `metrics` snapshot for free."""
+    rt.set_statistics_level("BASIC")
     caps = sorted({bucket_capacity(min(n, chunk or n)),
                    *map(bucket_capacity, extra_caps)})
     wu = rt.warmup(buckets=caps, samples=samples)
@@ -118,6 +123,15 @@ def _warm(rt, n, chunk=None, extra_caps=(), samples=None):
             "warm_programs": wu["programs"],
             "cache_hits": wu["cache_hits"],
             "cache_misses": wu["cache_misses"]}
+
+
+def _metrics_snapshot(rt):
+    """Registry dump for the per-config JSON line (BENCH_r06+ records
+    queue depths and latency histograms alongside events/s)."""
+    try:
+        return rt.metrics.collect()
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail a run
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def _entry(name, events, seconds, extra=None):
@@ -182,9 +196,17 @@ def bench_filter(n=1_000_000):
     # (the r4 driver capture measured 2-6x below the builder's runs)
     dt = min(_timed(lambda: (h.send_arrays(ts, [sym, price, vol]),
                              _drain(outs))) for _ in range(REPS))
+    # AFTER the timed reps: one DETAIL-probed chunk so the registry dump
+    # carries a real per-step latency summary (DETAIL serializes the
+    # pipeline — docs/observability.md — so it must never overlap the
+    # measurement)
+    rt.lat_sample_every = 1
+    rt.set_statistics_level("DETAIL")
+    h.send_arrays(ts[:1024], [sym[:1024], price[:1024], vol[:1024]])
+    met = _metrics_snapshot(rt)
     rt.shutdown()
     return _entry("filter", n, dt, extra={
-        "ttfr_ms": round(ttfr * 1000.0, 1), **cinfo})
+        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met, **cinfo})
 
 
 CHAIN3_APP = """
@@ -224,6 +246,7 @@ def _run_chain3(n: int, fused: bool):
                                outs.drain()))
         dt = min(_timed(lambda: (h.send_arrays(ts, [sym, v, price]),
                                  outs.drain())) for _ in range(REPS))
+        cinfo["metrics"] = _metrics_snapshot(rt)
         rt.shutdown()
         return dt, ttfr, cinfo
     finally:
@@ -276,9 +299,10 @@ def bench_window_agg(n=1_000_000):
                            _drain(outs)))
     dt = min(_timed(lambda: (h.send_arrays(ts, [sym, price, vol]),
                              _drain(outs))) for _ in range(REPS))
+    met = _metrics_snapshot(rt)
     rt.shutdown()
     return _entry("window_agg", n, dt, extra={
-        "ttfr_ms": round(ttfr * 1000.0, 1), **cinfo})
+        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met, **cinfo})
 
 
 def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int):
@@ -344,6 +368,7 @@ def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int):
     dt = min(dts)
     emitted = q.stats()["emitted"]
     dropped = q.overflow
+    cinfo["metrics"] = _metrics_snapshot(rt)
     rt.shutdown()
     cinfo["ttfr_ms"] = round(ttfr * 1000.0, 1)
     return dt, 2 * n_chunks * chunk, emitted, dropped, cinfo
@@ -422,9 +447,10 @@ def bench_seq2(n=262_144, chunk=65_536):
         _drain(outs)
         dts.append(time.perf_counter() - t0)
     dt = min(dts)
+    met = _metrics_snapshot(rt)
     rt.shutdown()
     return _entry("seq2", 2 * n_chunks * chunk, dt, extra={
-        "ttfr_ms": round(ttfr * 1000.0, 1), **cinfo})
+        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met, **cinfo})
 
 
 def bench_kleene(n=262_144, chunk=65_536):
@@ -469,9 +495,10 @@ def bench_kleene(n=262_144, chunk=65_536):
         _drain(outs)
         dts.append(time.perf_counter() - t0)
     dt = min(dts)
+    met = _metrics_snapshot(rt)
     rt.shutdown()
     return _entry("kleene", 2 * n_chunks * chunk, dt, extra={
-        "ttfr_ms": round(ttfr * 1000.0, 1), **cinfo})
+        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met, **cinfo})
 
 
 SEQ5_APP = """
@@ -555,10 +582,12 @@ def bench_seq5(n=1_048_576, chunk=65_536):
         h.send_arrays(*mk(small))
         _drain(outs)
         lat1k.append(time.perf_counter() - c0)
+    met = _metrics_snapshot(rt)
     rt.shutdown()
     lat_ms = np.array(lat) * 1000.0
     lat1k_ms = np.array(lat1k) * 1000.0
     return _entry("seq5", n_chunks * chunk, dt, extra={
+        "metrics": met,
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),
         "chunk": chunk,

@@ -15,6 +15,7 @@ surfaced through ``validate_app``.
 from __future__ import annotations
 
 import dataclasses
+import re
 from typing import Iterator, Optional
 
 from ..lang import ast as A
@@ -53,6 +54,12 @@ _ATTR_FIRST_WINDOWS = {"externaltime", "externaltimebatch"}
 ONERROR_STREAM_ACTIONS = ("LOG", "STREAM", "STORE")
 ONERROR_SINK_ACTIONS = ("RETRY", "WAIT", "STORE", "LOG", "STREAM")
 ONERROR_SOURCE_ACTIONS = ("RETRY", "WAIT")
+
+# @app:statistics(interval=...) time strings — keep in sync with
+# core/runtime.py _time_str_ms (the planner's parser of record)
+_TIME_STR = re.compile(
+    r"(\d+)\s*(millisecond|milliseconds|ms|sec|second|seconds|s|"
+    r"min|minute|minutes|hour|hours|h)?")
 
 # aggregator arity over ops/selector.py AGGREGATOR_NAMES: (min, max)
 AGGREGATOR_ARITY: dict[str, tuple[int, int]] = {
@@ -101,6 +108,7 @@ class PlanValidator:
 
     # -- checks --------------------------------------------------------
     def validate(self) -> list[PlanIssue]:
+        self.check_app_statistics()
         for sid, sd in self.app.stream_definitions.items():
             self.check_on_error_actions(sid, sd)
         qn = 0
@@ -113,6 +121,30 @@ class PlanValidator:
                 self.check_partition(el, f"partition{qn + 1}")
                 qn += len(el.queries)
         return self.issues
+
+    def check_app_statistics(self) -> None:
+        """Unknown ``@app:statistics`` reporter names / unparseable
+        intervals are definite runtime rejections — fail at parse time
+        with the offending value named (same pattern as
+        `on-error-action`; reporter surface in obs/reporters.py)."""
+        sa = A.find_annotation(self.app.annotations, "statistics")
+        if sa is None:
+            return
+        from ..obs.reporters import REPORTER_NAMES
+        rep = sa.element("reporter")
+        if rep is not None and \
+                rep.strip("'\"").lower() not in REPORTER_NAMES:
+            self.add(
+                "statistics-reporter", ERROR, "app",
+                f"unknown @app:statistics reporter '{rep}' (expected "
+                f"one of {', '.join(REPORTER_NAMES)})")
+        interval = sa.element("interval")
+        if interval is not None and \
+                not _TIME_STR.fullmatch(str(interval).strip()):
+            self.add(
+                "statistics-interval", ERROR, "app",
+                f"cannot parse @app:statistics interval '{interval}' "
+                "(expected e.g. '5 sec', '500 ms', '1 min')")
 
     def check_on_error_actions(self, sid: str, sd) -> None:
         """Unknown @OnError / connector `on.error` action values are

@@ -147,6 +147,44 @@ class CompileService:
         self.cache_misses = 0
         self.warmups = 0
         self._lock = threading.Lock()
+        # in-flight warmups: while > 0 the app is compiling and must not
+        # be marked ready (service GET /ready load-balancer semantics)
+        self._inflight = 0
+
+    # -- readiness (service /ready) --------------------------------------
+    @property
+    def ready(self) -> bool:
+        """True when no warmup is in flight. An app that never warms up
+        (no buckets configured) is trivially ready."""
+        with self._lock:
+            return self._inflight == 0
+
+    def _begin(self) -> None:
+        with self._lock:
+            self._inflight += 1
+
+    def _end(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    def warmup_async(self, buckets=None, samples: Optional[dict] = None,
+                     workers: Optional[int] = None) -> threading.Thread:
+        """Run warmup() on a daemon thread. Readiness flips to False
+        SYNCHRONOUSLY (before this returns), so a deploy that kicks off
+        an async warm is observed not-ready until the compiles land."""
+        self._begin()
+
+        def run():
+            try:
+                self.warmup(buckets=buckets, samples=samples,
+                            workers=workers)
+            finally:
+                self._end()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"siddhi-warmup-{self.app.name}")
+        t.start()
+        return t
 
     # -- enumeration -----------------------------------------------------
 
@@ -450,6 +488,14 @@ class CompileService:
         per-step records."""
         if buckets is None:
             buckets = warm_buckets_from_env()
+        self._begin()  # readiness: not ready while compiling
+        try:
+            return self._warmup(buckets, samples, workers)
+        finally:
+            self._end()
+
+    def _warmup(self, buckets, samples: Optional[dict],
+                workers: Optional[int]) -> dict:
         specs = self.specs(buckets, samples=samples)
         before = cache_counts()
         t0 = time.perf_counter()

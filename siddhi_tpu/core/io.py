@@ -20,6 +20,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from ..obs.tracing import maybe_span
 from .stream import Event, StreamCallback
 
 log = logging.getLogger("siddhi_tpu.io")
@@ -295,12 +296,16 @@ class Sink(StreamCallback):
         raise NotImplementedError
 
     def receive(self, events: list[Event]) -> None:
-        for e in events:
-            payload = self.mapper.map(e)
-            try:
-                self._publish_with_retry(payload)
-            except ConnectionUnavailableException as exc:
-                self._on_publish_failure(e, exc)
+        app = getattr(self.junction, "app", None)
+        with maybe_span(app, "sink",
+                        self.stream_id or type(self).__name__,
+                        events=len(events)):
+            for e in events:
+                payload = self.mapper.map(e)
+                try:
+                    self._publish_with_retry(payload)
+                except ConnectionUnavailableException as exc:
+                    self._on_publish_failure(e, exc)
 
     def _publish_with_retry(self, payload) -> None:
         backoff = BackoffRetryCounter(self._backoff_base_ms,

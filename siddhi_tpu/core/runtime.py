@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..lang import ast as A
+from ..obs.profiler import op_scope
 from ..ops.aggregators import AggregateOp
 from ..ops.expr import CompileError, SingleStreamScope, compile_expression
 from ..ops.join import (JoinCombinedScope, JoinCross, JoinSideScope,
@@ -151,11 +152,15 @@ def _chain_body(ops, has_timers: bool):
     def chain(states, tstates, emitted, batch, now):
         new_states = []
         for op, st in zip(ops, states):
-            if op.needs_tables:
-                st, batch, tstates = op.step_tables(st, batch, now,
-                                                    tstates)
-            else:
-                st, batch = op.step(st, batch, now)
+            # op_scope is a nullcontext unless SIDDHI_TPU_PROFILE_SCOPES=1
+            # (named scopes change lowered HLO -> compile-cache keys;
+            # docs/observability.md)
+            with op_scope(type(op).__name__):
+                if op.needs_tables:
+                    st, batch, tstates = op.step_tables(st, batch, now,
+                                                        tstates)
+                else:
+                    st, batch = op.step(st, batch, now)
             new_states.append(st)
         if has_timers:
             dues = [op.next_due(st) for op, st in zip(ops, new_states)
@@ -476,28 +481,29 @@ class QueryRuntime(Receiver):
     def process_packed(self, chunk: PackedChunk) -> None:
         if self._fused_chain is not None:
             return self._fused_chain.process_packed(chunk)
-        lat = self._stats_mark(chunk.n)
-        self._last_now = max(self._last_now, chunk.last_ts)
-        with self._lock:
-            step = self._packed_step_for(chunk.enc, chunk.capacity)
-            with self._table_locks():
-                tstates = {t: self.app.tables[t].state
-                           for t in self.table_deps}
-                (self.states, tstates, self._emitted_dev, out,
-                 due) = step(self.states, tstates, self._emitted_dev,
-                             chunk.buf)
-                for t in self.table_deps:
-                    self.app.tables[t].state = tstates[t]
-        if lat is not None:
-            jax.block_until_ready(out.valid)
-            lat.mark_out()
-        if self._host_due_all and chunk.ts_min is not None:
-            self._dispatch_output(out, chunk.last_ts)
-            self._schedule(min(op.host_due_bound(chunk.ts_min)
-                               for op in self._timer_ops))
-            return
-        self._dispatch_output(out, chunk.last_ts,
-                              due=due if self._has_timers else None)
+        with self.app.tracer.span("step", self.name, rows=chunk.n):
+            lat = self._stats_mark(chunk.n)
+            self._last_now = max(self._last_now, chunk.last_ts)
+            with self._lock:
+                step = self._packed_step_for(chunk.enc, chunk.capacity)
+                with self._table_locks():
+                    tstates = {t: self.app.tables[t].state
+                               for t in self.table_deps}
+                    (self.states, tstates, self._emitted_dev, out,
+                     due) = step(self.states, tstates, self._emitted_dev,
+                                 chunk.buf)
+                    for t in self.table_deps:
+                        self.app.tables[t].state = tstates[t]
+            if lat is not None:
+                jax.block_until_ready(out.valid)
+                lat.mark_out()
+            if self._host_due_all and chunk.ts_min is not None:
+                self._dispatch_output(out, chunk.last_ts)
+                self._schedule(min(op.host_due_bound(chunk.ts_min)
+                                   for op in self._timer_ops))
+                return
+            self._dispatch_output(out, chunk.last_ts,
+                                  due=due if self._has_timers else None)
 
     def stats(self) -> dict:
         """Runtime counters (device-synced on read)."""
@@ -638,26 +644,29 @@ class QueryRuntime(Receiver):
             return self._fused_chain.process_batch(batch, timestamp,
                                                    now=now,
                                                    skip_due=skip_due)
-        if now is None:
-            now = self.app.current_time()
-        lat = self._stats_lat()
-        self._last_now = max(self._last_now, int(now))
-        now_dev = jnp.asarray(now, dtype=jnp.int64)
-        with self._lock:
-            step = self._step_for(batch.capacity)
-            with self._table_locks():
-                tstates = {t: self.app.tables[t].state
-                           for t in self.table_deps}
-                self.states, tstates, self._emitted_dev, out, due = step(
-                    self.states, tstates, self._emitted_dev, batch, now_dev)
-                for t in self.table_deps:
-                    self.app.tables[t].state = tstates[t]
-        if lat is not None:
-            jax.block_until_ready(out.valid)
-            lat.mark_out()
-        self._dispatch_output(
-            out, timestamp,
-            due=due if (self._has_timers and not skip_due) else None)
+        with self.app.tracer.span("step", self.name,
+                                  capacity=int(batch.capacity)):
+            if now is None:
+                now = self.app.current_time()
+            lat = self._stats_lat()
+            self._last_now = max(self._last_now, int(now))
+            now_dev = jnp.asarray(now, dtype=jnp.int64)
+            with self._lock:
+                step = self._step_for(batch.capacity)
+                with self._table_locks():
+                    tstates = {t: self.app.tables[t].state
+                               for t in self.table_deps}
+                    (self.states, tstates, self._emitted_dev, out,
+                     due) = step(self.states, tstates, self._emitted_dev,
+                                 batch, now_dev)
+                    for t in self.table_deps:
+                        self.app.tables[t].state = tstates[t]
+            if lat is not None:
+                jax.block_until_ready(out.valid)
+                lat.mark_out()
+            self._dispatch_output(
+                out, timestamp,
+                due=due if (self._has_timers and not skip_due) else None)
 
     def _table_locks(self):
         stack = contextlib.ExitStack()
@@ -917,32 +926,39 @@ class FusedChain:
         return out, dues
 
     def process_packed(self, chunk: PackedChunk) -> None:
-        lat = self.head._stats_mark(chunk.n)
-        for q in self.queries:
-            q._last_now = max(q._last_now, chunk.last_ts)
-        out, dues = self._run(
-            self._packed_step_for(chunk.enc, chunk.capacity), chunk.buf)
-        if lat is not None:
-            jax.block_until_ready(out.valid)
-            lat.mark_out()
-        self._schedule_dues(dues, chunk.ts_min)
-        self.tail._dispatch_output(out, chunk.last_ts)
+        # ONE span per fused segment (the segment IS one XLA program);
+        # member queries are named in args instead of per-hop spans
+        with self.app.tracer.span("chain", self.name, rows=chunk.n,
+                                  members=[q.name for q in self.queries]):
+            lat = self.head._stats_mark(chunk.n)
+            for q in self.queries:
+                q._last_now = max(q._last_now, chunk.last_ts)
+            out, dues = self._run(
+                self._packed_step_for(chunk.enc, chunk.capacity),
+                chunk.buf)
+            if lat is not None:
+                jax.block_until_ready(out.valid)
+                lat.mark_out()
+            self._schedule_dues(dues, chunk.ts_min)
+            self.tail._dispatch_output(out, chunk.last_ts)
 
     def process_batch(self, batch: EventBatch, timestamp: int,
                       now: Optional[int] = None,
                       skip_due: bool = False) -> None:
-        if now is None:
-            now = self.app.current_time()
-        lat = self.head._stats_lat()
-        for q in self.queries:
-            q._last_now = max(q._last_now, int(now))
-        now_dev = jnp.asarray(now, dtype=jnp.int64)
-        out, dues = self._run(self._step_for(), batch, now_dev)
-        if lat is not None:
-            jax.block_until_ready(out.valid)
-            lat.mark_out()
-        self._schedule_dues(dues, None, skip_head_due=skip_due)
-        self.tail._dispatch_output(out, timestamp)
+        with self.app.tracer.span("chain", self.name,
+                                  members=[q.name for q in self.queries]):
+            if now is None:
+                now = self.app.current_time()
+            lat = self.head._stats_lat()
+            for q in self.queries:
+                q._last_now = max(q._last_now, int(now))
+            now_dev = jnp.asarray(now, dtype=jnp.int64)
+            out, dues = self._run(self._step_for(), batch, now_dev)
+            if lat is not None:
+                jax.block_until_ready(out.valid)
+                lat.mark_out()
+            self._schedule_dues(dues, None, skip_head_due=skip_due)
+            self.tail._dispatch_output(out, timestamp)
 
     def _schedule_dues(self, dues, ts_min,
                        skip_head_due: bool = False) -> None:
@@ -1524,6 +1540,21 @@ class SiddhiAppRuntime:
         self.barrier = threading.RLock()
         self.scheduler = Scheduler(playback=False, barrier=self.barrier)
         self.scheduler.resolve_hook = self._resolve_dues
+        # observability (siddhi_tpu/obs/): metrics registry + chunk-span
+        # tracer. The registry fills at COLLECTION time (scrape /
+        # reporter tick / statistics() call) via _collect_observability;
+        # the per-chunk path records only into the existing host-side
+        # trackers, so BASIC-level metrics stay sync-free.
+        from ..obs.metrics import MetricsRegistry
+        from ..obs.tracing import ChunkTracer
+        self.metrics = MetricsRegistry()
+        self.tracer = ChunkTracer()
+        self.metrics.register_collector(
+            lambda: self._collect_observability()[0])
+        self._checkpoint_supervisor = None  # wired by CheckpointSupervisor
+        self._stats_reporter_conf = None    # (reporter, interval_ms, path)
+        self._reporter = None
+        self._skip_start_warmup = False     # set for async-warm deploys
         Planner(self).plan()
         # AOT compile service (core/compile.py): warmup() lowers and
         # compiles every step program in parallel; start() triggers it
@@ -1757,9 +1788,24 @@ class SiddhiAppRuntime:
 
     def statistics(self) -> dict:
         """Per-query throughput/latency/memory/overflow report
-        (util/statistics trackers)."""
+        (util/statistics trackers) — a VIEW over the metrics registry's
+        collection walk (obs/metrics.py): ``GET /metrics``, periodic
+        reporters and bench dumps read the same numbers as dotted
+        gauges (docs/observability.md)."""
+        return self._collect_observability()[1]
+
+    def _collect_observability(self) -> tuple[dict, dict]:
+        """ONE walk over the runtime, shared by every observability
+        surface. Returns ``(flat, report)``: ``flat`` is the registry
+        snapshot of dotted metrics (``siddhi.<app>.query.<q>.emitted``,
+        ``siddhi.<app>.stream.<sid>.throughput``, ...) and ``report``
+        is the nested ``statistics()`` view. Device reads are batched
+        into single pytree transfers under the app barrier; this never
+        runs on the per-chunk path."""
         from .stats import pytree_nbytes
-        report = {}
+        p = f"siddhi.{self.name}"
+        flat: dict = {}
+        report: dict = {}
         # barrier: with donated state buffers a concurrent step would
         # invalidate the arrays mid-read; the barrier quiesces ingest and
         # timer dispatch for the walk (same guard snapshot() uses)
@@ -1783,6 +1829,35 @@ class SiddhiAppRuntime:
             if n in states_host:
                 entry["state_bytes"] = pytree_nbytes(states_host[n])
             report[n] = entry
+            base = f"{p}.query.{n}"
+            for key, metric in (("emitted", "emitted"),
+                                ("overflow", "overflow"),
+                                ("throughput_eps", "throughput"),
+                                ("state_bytes", "state.bytes")):
+                v = entry.get(key)
+                if isinstance(v, (int, float)):
+                    flat[f"{base}.{metric}"] = v
+            for k, v in (entry.get("latency") or {}).items():
+                flat[f"{base}.latency.{k}"] = v
+        # per-stream gauges: ingest throughput (host boundary, free),
+        # @Async queue depth/backpressure, junction error counters
+        for sid, j in self.junctions.items():
+            sbase = f"{p}.stream.{sid}"
+            tput = getattr(j, "throughput", None)
+            if tput is not None:
+                flat[f"{sbase}.events"] = tput.count
+                eps = tput.events_per_sec()
+                if eps is not None:
+                    flat[f"{sbase}.throughput"] = round(eps, 1)
+            if j.async_conf is not None and j._queue is not None:
+                flat[f"{sbase}.async.depth"] = j._queue.qsize()
+                flat[f"{sbase}.async.pending"] = j._pending
+                flat[f"{sbase}.async.capacity"] = j.async_conf[0]
+        errors = self.error_stats.snapshot()
+        if errors:
+            report["stream_errors"] = errors
+            for sid, c in errors.items():
+                flat[f"{p}.stream.{sid}.errors"] = c
         for tid, rt in self.record_tables.items():
             if hasattr(rt, "cache_complete"):
                 report[f"store:{tid}"] = {
@@ -1790,16 +1865,40 @@ class SiddhiAppRuntime:
                     "completeness_losses": rt.completeness_losses,
                     "compiled_readers": sorted(rt.compiled_readers),
                 }
-        errors = self.error_stats.snapshot()
-        if errors:
-            report["stream_errors"] = errors
+                flat[f"{p}.store.{tid}.cache_complete"] = \
+                    int(bool(rt.cache_complete))
+                flat[f"{p}.store.{tid}.completeness_losses"] = \
+                    rt.completeness_losses
+        # error-store backlog (resilience): events awaiting replay
+        try:
+            flat[f"{p}.errorstore.backlog"] = \
+                self._error_store().size(self.name)
+        except Exception:  # noqa: BLE001 — store backends may be remote
+            pass
+        # checkpoint freshness (resilience/supervisor.py), when supervised
+        sup = self._checkpoint_supervisor
+        if sup is not None:
+            flat[f"{p}.checkpoint.count"] = sup.checkpoints
+            flat[f"{p}.checkpoint.failures"] = sup.failures
+            if sup.last_checkpoint_wall is not None:
+                flat[f"{p}.checkpoint.age_ms"] = round(
+                    (time.time() - sup.last_checkpoint_wall) * 1000.0, 1)
+        # scheduler timer backlog / lag
+        flat[f"{p}.scheduler.pending"] = self.scheduler.pending()
+        flat[f"{p}.scheduler.lag_ms"] = \
+            self.scheduler.lag_ms(self.current_time())
         # AOT compile telemetry (only once a warmup ran): program count,
         # compile wall ms, persistent-cache hits/misses; DETAIL level
-        # adds the per-step timing list
+        # adds the per-step timing list (view only)
         if self.compile_service.warmups:
             report["compile"] = self.compile_service.summary(
                 detail=self.stats_level >= 2)
-        return report
+            for k in ("warmups", "programs", "compile_ms", "cache_hits",
+                      "cache_misses"):
+                flat[f"{p}.compile.{k}"] = report["compile"][k]
+        flat[f"{p}.app.running"] = int(self.running)
+        flat[f"{p}.app.ready"] = int(self.ready)
+        return flat, report
 
     def debug(self):
         """Attach a step debugger (SiddhiAppRuntimeImpl.debug():657)."""
@@ -1828,11 +1927,70 @@ class SiddhiAppRuntime:
                                            samples=samples,
                                            workers=workers)
 
+    def warmup_async(self, buckets=None, samples=None, workers=None):
+        """warmup() on a daemon thread; readiness (`self.ready`,
+        service ``GET /ready``) flips False before this returns and
+        True when the compiles land — deploys return immediately while
+        the load balancer holds traffic (docs/observability.md)."""
+        if not self.running:
+            self._build_fused_chains()
+        return self.compile_service.warmup_async(
+            buckets=buckets, samples=samples, workers=workers)
+
+    @property
+    def ready(self) -> bool:
+        """Load-balancer readiness: running AND no AOT warmup in
+        flight (core/compile.py)."""
+        return self.running and self.compile_service.ready
+
     def _maybe_aot_warmup(self) -> None:
+        if self._skip_start_warmup:
+            # an async warmup was (or will be) launched by the deployer
+            # (core/service.py): don't also compile inline
+            return
         from .compile import warm_buckets_from_env
         buckets = warm_buckets_from_env()
         if buckets:
             self.compile_service.warmup(buckets=buckets)
+
+    # -- tracing / profiling (siddhi_tpu/obs/, docs/observability.md) -----
+    def trace_start(self) -> None:
+        """Start recording chunk spans (ingest -> junction -> step ->
+        sink) into the tracer ring buffer."""
+        self.tracer.start()
+
+    def trace_stop(self) -> None:
+        self.tracer.stop()
+
+    def trace_export(self, path: str) -> str:
+        """Write buffered chunk spans as Chrome ``trace_event`` JSON
+        (chrome://tracing / Perfetto loadable); returns ``path``."""
+        return self.tracer.export(path)
+
+    def profile(self, path: str):
+        """Context manager capturing a device profile of the enclosed
+        block via ``jax.profiler.start_trace/stop_trace``::
+
+            with rt.profile('/tmp/prof'):
+                handler.send_arrays(ts, cols)
+        """
+        from ..obs.profiler import profile
+        return profile(path)
+
+    def _start_reporter(self) -> None:
+        """Launch the @app:statistics periodic reporter, if configured."""
+        if self._stats_reporter_conf is None or self.stats_level <= 0 \
+                or self._reporter is not None:
+            return
+        from ..obs.reporters import build_reporter
+        name, interval_ms, path = self._stats_reporter_conf
+        self._reporter = build_reporter(self, name, interval_ms,
+                                        path=path).start()
+
+    def _stop_reporter(self) -> None:
+        if self._reporter is not None:
+            self._reporter.stop()
+            self._reporter = None
 
     def start(self) -> None:
         self.running = True
@@ -1842,6 +2000,7 @@ class SiddhiAppRuntime:
         # deploys hits ready executables instead of a serial lazy
         # compile queue (north star: start in seconds, not minutes)
         self._maybe_aot_warmup()
+        self._start_reporter()
         self.scheduler.start()
         self._start_record_tables()
         for s in self.sources:
@@ -1904,6 +2063,7 @@ class SiddhiAppRuntime:
         self.running = True
         self._build_fused_chains()
         self._maybe_aot_warmup()
+        self._start_reporter()
         self.scheduler.start()
         self._start_record_tables()
         if not self._playback:
@@ -2058,6 +2218,7 @@ class SiddhiAppRuntime:
 
     def shutdown(self) -> None:
         self.running = False  # reject new sends before draining
+        self._stop_reporter()
         flush_errors = []
         for j in self.junctions.values():
             if j.async_conf is not None:
@@ -2217,12 +2378,39 @@ class Planner:
                 Attribute("triggered_time", AttrType.LONG),))
             tj = app.junction_for(tid, schema)
             app.triggers[tid] = TriggerRuntime(app, td, tj)
-        # @app:statistics level (SiddhiAppParser.java:116-141)
+        # @app:statistics(level, reporter, interval, file)
+        # (SiddhiAppParser.java:116-141: level + Dropwizard reporter
+        # config; statics validated at parse time by plan_rules
+        # `statistics-reporter`/`statistics-interval`, planner backstop
+        # here for validate=False / hand-built ASTs)
         sa = A.find_annotation(ast.annotations, "statistics")
         if sa is not None:
             from .stats import parse_level
-            lvl = sa.element() or sa.element("level") or "BASIC"
-            app.stats_level = parse_level(lvl)
+            from ..obs.reporters import DEFAULT_INTERVAL_MS, REPORTER_NAMES
+            config_keys = ("reporter", "interval", "file")
+            lvl = sa.element("level")
+            if lvl is None and sa.positional:
+                lvl = sa.positional[0]
+            if lvl is None and len(sa.elements) == 1 and not any(
+                    k.lower() in config_keys for k in sa.elements):
+                lvl = next(iter(sa.elements.values()))
+            app.stats_level = parse_level(lvl or "BASIC")
+            rep = sa.element("reporter")
+            interval = sa.element("interval")
+            if rep is not None:
+                rname = rep.strip("'\"").lower()
+                if rname not in REPORTER_NAMES:
+                    raise CompileError(
+                        f"unknown @app:statistics reporter '{rep}' "
+                        f"(expected one of {', '.join(REPORTER_NAMES)})")
+            elif interval is not None:
+                rname = "console"  # interval alone: reference default
+            else:
+                rname = None
+            if rname is not None:
+                ms = _time_str_ms(interval, "@app:statistics interval") \
+                    if interval is not None else DEFAULT_INTERVAL_MS
+                app._stats_reporter_conf = (rname, ms, sa.element("file"))
         # playback mode (+ optional idle-advance: SiddhiAppParser.java
         # :171-210 wires EventTimeBasedMillisTimestampGenerator so the
         # virtual clock advances by `increment` whenever sources stay
@@ -2237,8 +2425,10 @@ class Planner:
                     "@app:playback needs BOTH idle.time and increment "
                     "(or neither)")
             if idle is not None:
-                app._playback_idle_ms = _time_str_ms(idle, "idle.time")
-                app._playback_increment_ms = _time_str_ms(inc, "increment")
+                app._playback_idle_ms = _time_str_ms(
+                    idle, "@app:playback idle.time")
+                app._playback_increment_ms = _time_str_ms(
+                    inc, "@app:playback increment")
         # 2. queries in order; inferred output streams defined as we go
         qcount = 0
         pcount = 0
@@ -3192,8 +3382,7 @@ def _time_str_ms(s, role: str) -> int:
         r"(\d+)\s*(millisecond|milliseconds|ms|sec|second|seconds|s|"
         r"min|minute|minutes|hour|hours|h)?", s)
     if not m:
-        raise CompileError(
-            f"@app:playback {role}: cannot parse time '{s}'")
+        raise CompileError(f"{role}: cannot parse time '{s}'")
     n = int(m.group(1))
     unit = m.group(2) or "ms"
     mult = {"millisecond": 1, "milliseconds": 1, "ms": 1,

@@ -64,6 +64,19 @@ class Scheduler:
             heapq.heappush(self._heap, (int(due_ms), next(self._seq), callback))
             self._cv.notify_all()
 
+    def pending(self) -> int:
+        """Armed timers not yet fired (obs: scheduler backlog gauge)."""
+        with self._cv:
+            return len(self._heap)
+
+    def lag_ms(self, now_ms: int) -> int:
+        """How far the earliest armed timer is overdue relative to
+        ``now_ms`` (0 when idle or on time) — the obs timer-lag gauge."""
+        with self._cv:
+            if not self._heap:
+                return 0
+            return max(0, int(now_ms) - int(self._heap[0][0]))
+
     def advance_to(self, now_ms: int) -> None:
         """Playback mode: fire every timer due at or before now_ms,
         synchronously, in due order (deterministic replay)."""

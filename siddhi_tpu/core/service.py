@@ -6,6 +6,19 @@ Reference mapping: modules/siddhi-service/ —
 (SiddhiApi.java:31,37-52; impl SiddhiApiServiceImpl.java:51,100)
 plus GET /siddhi/artifacts (list deployed app names).
 
+Observability endpoints (docs/observability.md):
+- GET /metrics — Prometheus text exposition over every deployed app's
+  MetricsRegistry (auth-protected when a token is set: metric names
+  describe app internals).
+- GET /health — liveness: 200 whenever the service loop is up. Never
+  auth-protected (load-balancer probes don't carry tokens).
+- GET /ready  — readiness: 200 only when every deployed app is running
+  AND its CompileService has no AOT warmup in flight; 503 otherwise.
+  With SIDDHI_TPU_WARM_BUCKETS configured, deploy() returns
+  immediately and compiles in the background — the LB holds traffic on
+  503 until the step programs are executable (PR 5 warmup wired into
+  rollout semantics). Never auth-protected.
+
 A stdlib http.server on a daemon thread fronting a SiddhiManager — the
 reference uses MSF4J, the role is identical: remote lifecycle control.
 
@@ -31,7 +44,7 @@ class DuplicateAppError(ValueError):
 class SiddhiService:
     def __init__(self, manager=None, host: str = "127.0.0.1",
                  port: int = 0, auth_token: Optional[str] = None,
-                 allow_scripts: bool = False):
+                 allow_scripts: bool = False, warm_async: bool = True):
         from .manager import SiddhiManager
         if host not in ("127.0.0.1", "localhost") and not auth_token:
             raise ValueError(
@@ -40,6 +53,10 @@ class SiddhiService:
         self.manager = manager or SiddhiManager()
         self.auth_token = auth_token
         self.allow_scripts = allow_scripts
+        # warm_async: with SIDDHI_TPU_WARM_BUCKETS set, deploy() compiles
+        # in the background and GET /ready gates traffic instead of the
+        # deploy call blocking for the whole AOT phase
+        self.warm_async = warm_async
         self._deployed: dict = {}
         service = self
 
@@ -51,6 +68,16 @@ class SiddhiService:
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _send_text(self, code: int, text: str):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -77,8 +104,19 @@ class SiddhiService:
                 self._send(200, {"status": "deployed", "app": name})
 
             def do_GET(self):
+                # LB probes first: liveness/readiness carry no secrets
+                # and no tokens
+                if self.path == "/health":
+                    return self._send(200, {"status": "up",
+                                            "apps": len(service._deployed)})
+                if self.path == "/ready":
+                    ready, apps = service.readiness()
+                    return self._send(200 if ready else 503,
+                                      {"ready": ready, "apps": apps})
                 if not self._authorized():
                     return self._send(401, {"error": "unauthorized"})
+                if self.path == "/metrics":
+                    return self._send_text(200, service.metrics_text())
                 if self.path.startswith("/siddhi/artifact/undeploy/"):
                     name = self.path.rsplit("/", 1)[-1]
                     if service.undeploy(name):
@@ -108,6 +146,20 @@ class SiddhiService:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
 
+    # -- observability -----------------------------------------------------
+    def readiness(self) -> tuple:
+        """(all_ready, {app: ready}) — an app is ready when running and
+        its CompileService has no warmup in flight (core/compile.py)."""
+        apps = {name: rt.ready for name, rt in self._deployed.items()}
+        return all(apps.values()), apps
+
+    def metrics_text(self) -> str:
+        """One Prometheus scrape over every deployed app's registry."""
+        parts = [rt.metrics.prometheus_text()
+                 for rt in list(self._deployed.values())]
+        text = "".join(p for p in parts if p)
+        return text or "# no metrics (no apps deployed)\n"
+
     # -- operations -------------------------------------------------------
     def deploy(self, siddhi_ql: str) -> str:
         # both checks run on the PARSED app before any runtime is built:
@@ -125,8 +177,23 @@ class SiddhiService:
                 f"app '{app_ast.name}' is already deployed — undeploy it "
                 "first")
         rt = self.manager.create_siddhi_app_runtime(siddhi_ql)
+        from .compile import warm_buckets_from_env
+        warm = warm_buckets_from_env() if self.warm_async else ()
+        if warm:
+            # AOT-compile in the background: deploy returns immediately,
+            # GET /ready stays 503 until every step program is
+            # executable. Readiness is reserved BEFORE the app becomes
+            # visible in _deployed, so no probe can observe a
+            # ready->unready flap between deploy and the warm thread.
+            rt._skip_start_warmup = True
+            rt.compile_service._begin()
         rt.start()
         self._deployed[rt.name] = rt
+        if warm:
+            try:
+                rt.warmup_async(buckets=warm)
+            finally:
+                rt.compile_service._end()
         return rt.name
 
     def undeploy(self, name: str) -> bool:

@@ -77,9 +77,13 @@ class LatencyTracker:
             self.samples.append(dt)
 
     def summary(self) -> Optional[dict]:
-        if not self.samples:
-            return None
-        s = sorted(self.samples)
+        # snapshot under the lock: mark_out mutates samples (del + append)
+        # concurrently, and sorting a list mid-mutation drops/duplicates
+        # entries (or raises on the resize)
+        with self._lock:
+            if not self.samples:
+                return None
+            s = sorted(self.samples)
         n = len(s)
         return {"avg_ms": round(sum(s) / n, 3),
                 "p50_ms": round(s[n // 2], 3),

@@ -25,6 +25,8 @@ import logging
 import threading
 from typing import Any, Callable, Optional, Sequence
 
+from ..obs.tracing import maybe_span
+
 log = logging.getLogger("siddhi_tpu.stream")
 
 
@@ -72,6 +74,11 @@ class StreamJunction:
         # store resolution) and the app-wide per-stream error counters
         self.app = None
         self.error_stats = None
+        # per-stream ingest throughput (obs registry
+        # siddhi.<app>.stream.<id>.throughput); lazily created when
+        # statistics are enabled — marked at the host boundary, so the
+        # numbers are free (no device syncs)
+        self.throughput = None
         self._lock = threading.Lock()
         # @Async state (None = synchronous junction)
         self.async_conf: Optional[tuple[int, int]] = None  # (buffer, batch)
@@ -173,6 +180,17 @@ class StreamJunction:
         # dead queue (sends are already rejected by the running check)
         self._queue = None
 
+    def mark_ingest(self, n: int) -> None:
+        """Host-boundary stream throughput mark (obs); no-op with
+        statistics OFF."""
+        app = self.app
+        if app is None or app.stats_level <= 0:
+            return
+        if self.throughput is None:
+            from .stats import ThroughputTracker
+            self.throughput = ThroughputTracker()
+        self.throughput.mark(n)
+
     def count_error(self, n: int = 1) -> None:
         if self.error_stats is not None:
             self.error_stats.increment(self.stream_id, n)
@@ -262,11 +280,13 @@ class StreamJunction:
         self._publish_sync(events)
 
     def _publish_sync(self, events: list[Event]) -> None:
-        for r in list(self.receivers):
-            try:
-                r.receive(events)
-            except Exception as exc:  # noqa: BLE001 — fault-stream contract
-                self._handle_error(events, exc)
+        with maybe_span(self.app, "junction", self.stream_id,
+                        events=len(events)):
+            for r in list(self.receivers):
+                try:
+                    r.receive(events)
+                except Exception as exc:  # noqa: BLE001 — fault-stream
+                    self._handle_error(events, exc)  # contract
 
     def publish_batch(self, batch, last_ts: int) -> None:
         """Columnar fast path: receivers that implement process_batch get
@@ -280,21 +300,23 @@ class StreamJunction:
             return [Event(ts, vals, is_expired=(kind == EXPIRED))
                     for ts, kind, vals in rows]
 
-        for r in list(self.receivers):
-            try:
-                if hasattr(r, "process_batch"):
-                    r.process_batch(batch, last_ts)
-                else:
-                    if decoded is None:
-                        decoded = decode()
-                    r.receive(decoded)
-            except Exception as exc:  # noqa: BLE001 — fault-stream contract
-                if decoded is None:
-                    try:
-                        decoded = decode()
-                    except Exception:  # noqa: BLE001
-                        decoded = []
-                self._handle_error(decoded, exc)
+        with maybe_span(self.app, "junction", self.stream_id,
+                        capacity=int(batch.capacity)):
+            for r in list(self.receivers):
+                try:
+                    if hasattr(r, "process_batch"):
+                        r.process_batch(batch, last_ts)
+                    else:
+                        if decoded is None:
+                            decoded = decode()
+                        r.receive(decoded)
+                except Exception as exc:  # noqa: BLE001 — fault-stream
+                    if decoded is None:  # contract
+                        try:
+                            decoded = decode()
+                        except Exception:  # noqa: BLE001
+                            decoded = []
+                    self._handle_error(decoded, exc)
 
 
 class InputHandler:
@@ -324,19 +346,24 @@ class InputHandler:
             events = [Event(timestamp=now(), data=tuple(d)) for d in data]
         else:
             events = [Event(timestamp=now(), data=tuple(data))]
-        if self.junction._queue is not None:
-            # @Async: hand off to the junction's worker, which advances
-            # the clock when the batch is actually dispatched
-            self.junction.publish(events)
-            return
-        with self.app.barrier:
-            self.app.on_ingest(self.stream_id, events)
-            self.junction.publish(events)
-            # timers armed DURING processing (e.g. hop boundaries the
-            # chunk's own event-time jump crossed) fire now, not at the
-            # next external tick
-            if self.app._playback and self.app._playback_time is not None:
-                self.app.scheduler.advance_to(self.app._playback_time)
+        self.junction.mark_ingest(len(events))
+        with maybe_span(self.app, "ingest", self.stream_id,
+                        events=len(events)):
+            if self.junction._queue is not None:
+                # @Async: hand off to the junction's worker, which
+                # advances the clock when the batch is actually
+                # dispatched
+                self.junction.publish(events)
+                return
+            with self.app.barrier:
+                self.app.on_ingest(self.stream_id, events)
+                self.junction.publish(events)
+                # timers armed DURING processing (e.g. hop boundaries
+                # the chunk's own event-time jump crossed) fire now, not
+                # at the next external tick
+                if self.app._playback and \
+                        self.app._playback_time is not None:
+                    self.app.scheduler.advance_to(self.app._playback_time)
 
     def send_arrays(self, ts, cols) -> None:
         """Columnar ingest: numpy timestamp + data column arrays
@@ -384,7 +411,9 @@ class InputHandler:
             t = ts[start:start + max_cap]
             c = [col[start:start + max_cap] for col in cols]
             last_ts = int(t[-1])
-            with self.app.barrier:
+            self.junction.mark_ingest(len(t))
+            with maybe_span(self.app, "ingest", self.stream_id,
+                            rows=len(t)), self.app.barrier:
                 # columnar fast path: fire only dues STRICTLY BEFORE
                 # the chunk's span now — in-span window expiry happens
                 # inside the chunk's own step at exact per-row points, so

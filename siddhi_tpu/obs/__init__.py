@@ -1,0 +1,19 @@
+"""Observability subsystem: metrics registry + reporters, chunk-span
+tracing, and profiler hooks.
+
+Reference mapping:
+- util/statistics/* (SiddhiStatisticsManager, Dropwizard trackers,
+  periodic reporters configured via
+  ``@app:statistics(reporter='console', interval='5 sec')``)
+- the per-event trace hooks of SiddhiAppRuntimeImpl.setStatisticsLevel.
+
+Design rule for an async device pipeline (docs/observability.md): the
+hot path RECORDS into host-side trackers and ring buffers only — no
+device syncs, no locks beyond what the runtime already holds. All
+device reads (state bytes, emitted counters) happen at COLLECTION time
+(a scrape, a reporter tick, a ``statistics()`` call), batched into one
+pytree transfer under the app barrier. BASIC-level metrics therefore
+cost nothing per chunk.
+"""
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
+from .tracing import ChunkTracer, maybe_span  # noqa: F401

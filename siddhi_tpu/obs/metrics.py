@@ -1,0 +1,221 @@
+"""Metrics registry: counters / gauges / histograms under reference-style
+dotted names (``siddhi.<app>.stream.<id>.throughput``,
+``siddhi.<app>.query.<q>.latency`` ...), with Prometheus text-format
+exposition.
+
+Reference mapping: util/statistics/metrics/* — SiddhiStatisticsManager
+holds one Dropwizard MetricRegistry per app; trackers register
+themselves under dotted names and reporters/exposition read the
+registry. Here the runtime's existing trackers (core/stats.py
+QueryStats / StreamErrorStats, compile telemetry, junction queue
+depths, checkpoint age, scheduler lag) publish into this registry via
+pull-at-collection-time collectors: ``collect()`` runs every registered
+collector (one batched walk over the runtime, under the app barrier)
+and returns a flat ``{dotted_name: number}`` snapshot. The hot path
+never touches the registry — see the package docstring.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+import time
+from typing import Callable, Optional
+
+_PROM_NAME = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prom_name(dotted: str) -> str:
+    """Sanitize a dotted metric name into a Prometheus metric name."""
+    name = _PROM_NAME.sub("_", dotted)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+class Counter:
+    """Monotonic counter (Dropwizard Counter / Meter count)."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Point-in-time value; either set directly or backed by a callable
+    evaluated at collection time (so the instrumented path pays
+    nothing)."""
+
+    __slots__ = ("name", "_value", "_fn")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: float = math.nan
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value) -> None:
+        self._value = value
+
+    def set_fn(self, fn: Callable[[], float]) -> None:
+        self._fn = fn
+
+    @property
+    def value(self):
+        if self._fn is not None:
+            try:
+                return self._fn()
+            except Exception:  # noqa: BLE001 — a broken gauge must not
+                return math.nan  # kill a scrape
+        return self._value
+
+
+class Histogram:
+    """Bounded-reservoir summary (avg / p50 / p99 / count), the same
+    windowed model as core/stats.LatencyTracker. Exposed in Prometheus
+    summary format (pre-computed quantiles, not cumulative buckets)."""
+
+    CAP = 4096
+
+    __slots__ = ("name", "_samples", "_count", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._samples: list[float] = []
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            if len(self._samples) >= self.CAP:
+                del self._samples[: self.CAP // 2]
+            self._samples.append(float(value))
+            self._count += 1
+
+    def summary(self) -> Optional[dict]:
+        with self._lock:
+            if not self._samples:
+                return None
+            s = sorted(self._samples)
+            count = self._count
+        n = len(s)
+        return {"avg": round(sum(s) / n, 3),
+                "p50": round(s[n // 2], 3),
+                "p99": round(s[min(n - 1, (n * 99) // 100)], 3),
+                "count": count}
+
+
+class MetricsRegistry:
+    """One registry per app runtime. Instruments are created lazily by
+    dotted name; ``register_collector(fn)`` adds a pull-time source
+    whose ``fn() -> {name: number}`` output lands as gauges on every
+    ``collect()``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+        self._collectors: list[Callable[[], dict]] = []
+
+    # -- instruments -----------------------------------------------------
+    def _get(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric '{name}' already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def set(self, name: str, value) -> None:
+        self.gauge(name).set(value)
+
+    def register_collector(self, fn: Callable[[], dict]) -> None:
+        with self._lock:
+            self._collectors.append(fn)
+
+    # -- collection ------------------------------------------------------
+    def collect(self) -> dict:
+        """Run every collector, fold the results into gauges, and return
+        a flat JSON-serializable ``{dotted_name: number}`` snapshot
+        (histograms flatten to ``<name>.avg/.p50/.p99/.count``)."""
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                for name, value in (fn() or {}).items():
+                    self.gauge(name).set(value)
+            except Exception:  # noqa: BLE001 — one broken collector must
+                continue  # not take down the scrape
+        out: dict = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            if isinstance(m, Histogram):
+                s = m.summary()
+                if s is not None:
+                    for k, v in s.items():
+                        out[f"{m.name}.{k}"] = v
+            else:
+                v = m.value
+                if isinstance(v, float) and math.isnan(v):
+                    continue
+                out[m.name] = v
+        return out
+
+    # -- exposition ------------------------------------------------------
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (version 0.0.4). Counters and
+        gauges one sample each; histograms as summaries
+        (``{quantile="..."}`` samples + ``_count``)."""
+        ts_ms = int(time.time() * 1000)
+        lines: list[str] = []
+        # refresh collector-backed gauges first
+        self.collect()
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        for m in metrics:
+            name = prom_name(m.name)
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {name} counter")
+                lines.append(f"{name} {m.value} {ts_ms}")
+            elif isinstance(m, Histogram):
+                s = m.summary()
+                if s is None:
+                    continue
+                lines.append(f"# TYPE {name} summary")
+                lines.append(f'{name}{{quantile="0.5"}} {s["p50"]}')
+                lines.append(f'{name}{{quantile="0.99"}} {s["p99"]}')
+                lines.append(f"{name}_count {s['count']}")
+            else:
+                v = m.value
+                if v is None or (isinstance(v, float) and math.isnan(v)):
+                    continue
+                if isinstance(v, bool):
+                    v = int(v)
+                if not isinstance(v, (int, float)):
+                    continue
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name} {v} {ts_ms}")
+        return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,48 @@
+"""Profiler hooks: ``runtime.profile(path)`` around
+``jax.profiler.start_trace/stop_trace``, and opt-in ``jax.named_scope``
+labels inside step traces.
+
+Named scopes are STRICTLY opt-in behind ``SIDDHI_TPU_PROFILE_SCOPES=1``:
+scope metadata changes the lowered HLO, which changes the persistent
+compile-cache key (docs/compile_cache.md cache-key rules) — flipping
+the default would invalidate every existing ``.jax_cache`` entry. The
+env var is read at trace time (traces are rare; dispatches are not), so
+enabling it recompiles the steps exactly once per process.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+SCOPES_ENV = "SIDDHI_TPU_PROFILE_SCOPES"
+
+
+def scopes_enabled() -> bool:
+    return os.environ.get(SCOPES_ENV, "") == "1"
+
+
+def op_scope(name: str):
+    """``jax.named_scope(name)`` when profiling scopes are enabled, else
+    a nullcontext — used around each operator inside step traces so
+    device profiles attribute time to operators instead of one opaque
+    fused computation."""
+    if not scopes_enabled():
+        return contextlib.nullcontext()
+    import jax
+    return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def profile(path: str):
+    """Capture a device profile of the enclosed block into ``path``
+    (TensorBoard/XProf trace directory)::
+
+        with runtime.profile('/tmp/prof'):
+            handler.send_arrays(ts, cols)
+    """
+    import jax
+    jax.profiler.start_trace(path)
+    try:
+        yield path
+    finally:
+        jax.profiler.stop_trace()

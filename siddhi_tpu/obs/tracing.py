@@ -1,0 +1,123 @@
+"""Chunk-span tracing: host-side spans around the chunk pipeline
+(ingest -> junction -> step dispatch -> sink), recorded into a bounded
+ring buffer and exportable as Chrome ``trace_event`` JSON
+(``chrome://tracing`` / Perfetto loadable).
+
+Span semantics on an async device pipeline: a span measures HOST wall
+time around a dispatch, not device execution time (the step may still
+be running when the span closes — that is the pipeline working as
+designed). Device-side timing comes from ``runtime.profile(path)``
+(obs/profiler.py), which captures the XLA device trace. Fused chains
+emit ONE span per segment (``chain/<q1+q2+...>``) with the member query
+names in ``args`` — mirroring that the whole segment is a single XLA
+program.
+
+Recording is gated on ``tracer.enabled`` (default off; opt in via
+``runtime.trace_start()`` or ``SIDDHI_TPU_TRACE=1``): a disabled span
+is one attribute check, so the hot path stays free.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "cat", "args", "t0")
+
+    def __init__(self, tracer: "ChunkTracer", name: str, cat: str, args):
+        self.tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur_us = (time.perf_counter_ns() - self.t0) // 1000
+        self.tracer.record(self.name, self.cat, self.t0 // 1000, dur_us,
+                           self.args)
+        return False
+
+
+class ChunkTracer:
+    """Ring buffer of completed spans (newest CAP kept)."""
+
+    CAP = 8192
+
+    def __init__(self, capacity: int = CAP):
+        self.enabled = os.environ.get("SIDDHI_TPU_TRACE", "") == "1"
+        self._events = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self) -> None:
+        """Enable recording (clears previously buffered spans)."""
+        with self._lock:
+            self._events.clear()
+        self.enabled = True
+
+    def stop(self) -> None:
+        self.enabled = False
+
+    # -- recording -------------------------------------------------------
+    def span(self, kind: str, name: str, **args):
+        """Context manager timing one pipeline stage; event name is
+        ``<kind>/<name>`` (e.g. ``step/q1``, ``chain/q1+q2``)."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return _Span(self, f"{kind}/{name}", kind, args)
+
+    def record(self, name: str, cat: str, ts_us: int, dur_us: int,
+               args) -> None:
+        with self._lock:
+            self._events.append(
+                (name, cat, ts_us, dur_us, threading.get_ident(), args))
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._events)
+
+    # -- export ----------------------------------------------------------
+    def export(self, path: str) -> str:
+        """Write buffered spans as Chrome ``trace_event`` JSON ('X'
+        complete events, microsecond timestamps); returns ``path``."""
+        trace = {
+            "displayTimeUnit": "ms",
+            "traceEvents": [
+                {"name": name, "cat": cat, "ph": "X", "ts": ts_us,
+                 "dur": dur_us, "pid": os.getpid(), "tid": tid,
+                 "args": dict(args)}
+                for name, cat, ts_us, dur_us, tid, args in self.events()
+            ],
+        }
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+
+def maybe_span(app, kind: str, name: str, **args):
+    """Span against ``app.tracer`` when the owner is wired to an app
+    runtime (junctions/sinks can exist standalone), else a no-op."""
+    tracer = getattr(app, "tracer", None) if app is not None else None
+    if tracer is None:
+        return NOOP_SPAN
+    return tracer.span(kind, name, **args)

@@ -62,6 +62,7 @@ import numpy as np
 from ..core.event import (CURRENT, EventBatch, StreamSchema, TIMER,
                           rows_from_batch)
 from ..core.stream import Event, Receiver
+from ..obs.tracing import maybe_span
 from ..ops.expr import CompiledExpr, env_from_batch
 from ..ops.keyed import hash_columns, lookup_or_insert
 from ..ops.windows import POS_INF, WindowOp
@@ -478,22 +479,24 @@ class PartitionBlockRuntime:
         self._run(("stream", stream_id), batch, timestamp, now)
 
     def _run(self, trigger, batch, timestamp, now=None):
-        if now is None:
-            now = self.app.current_time()
-        now_dev = jnp.asarray(now, dtype=jnp.int64)
-        with self._lock:
-            step = self._step_for(trigger, batch.capacity)
-            (self.slot_tbl, self.qstates, self._emitted, self._lost,
-             flat_outs, dues) = step(self.slot_tbl, self.qstates,
-                                     self._emitted, self._lost, batch,
-                                     now_dev)
-        for qn, out in flat_outs.items():
-            self._dispatch(qn, out, timestamp)
-        if dues:
-            # one pytree transfer for every query's due, not one sync per
-            # query (docs/tpu_hygiene.md host-sync-in-loop)
-            for qn, due in jax.device_get(dues).items():
-                self._schedule(qn, int(due))
+        with maybe_span(self.app, "partition", self.name,
+                        trigger=str(trigger)):
+            if now is None:
+                now = self.app.current_time()
+            now_dev = jnp.asarray(now, dtype=jnp.int64)
+            with self._lock:
+                step = self._step_for(trigger, batch.capacity)
+                (self.slot_tbl, self.qstates, self._emitted, self._lost,
+                 flat_outs, dues) = step(self.slot_tbl, self.qstates,
+                                         self._emitted, self._lost, batch,
+                                         now_dev)
+            for qn, out in flat_outs.items():
+                self._dispatch(qn, out, timestamp)
+            if dues:
+                # one pytree transfer for every query's due, not one sync
+                # per query (docs/tpu_hygiene.md host-sync-in-loop)
+                for qn, due in jax.device_get(dues).items():
+                    self._schedule(qn, int(due))
 
     def _dispatch(self, qname: str, out: EventBatch, timestamp: int):
         port = self.ports[qname]

@@ -37,7 +37,12 @@ class CheckpointSupervisor:
         self.last_revision: Optional[str] = None
         self.checkpoints = 0              # successful periodic persists
         self.failures = 0                 # persist attempts that raised
+        # wall-clock time of the last successful persist, for the obs
+        # registry's siddhi.<app>.checkpoint.age_ms gauge (a stale
+        # checkpoint is a recovery-window alarm)
+        self.last_checkpoint_wall: Optional[float] = None
         self._stopped = False
+        app._checkpoint_supervisor = self
 
     # -- periodic persist -------------------------------------------------
     def start(self, base_ms: Optional[int] = None
@@ -64,6 +69,8 @@ class CheckpointSupervisor:
         try:
             self.last_revision = self.app.persist()
             self.checkpoints += 1
+            import time
+            self.last_checkpoint_wall = time.time()
         except Exception:  # noqa: BLE001 — a failed persist must not
             # kill the scheduler; the next interval tries again
             self.failures += 1

@@ -1,0 +1,37 @@
+"""Seeded antipattern: metric recording that syncs the device per chunk.
+
+Observability contract (docs/observability.md): BASIC-level metrics
+record at the host boundary only — a gauge/counter update must NEVER
+device_get inside the chunk loop. The host-sync-in-loop rule covers the
+metric-recording paths; collection-time reads batch into one pytree
+transfer instead.
+"""
+import jax
+
+
+def record_throughput_per_chunk(registry, chunks, emitted_dev):
+    for c in chunks:
+        # line 15: per-chunk device sync to feed a metric — forbidden
+        registry.set("siddhi.app.query.q.emitted",
+                     int(jax.device_get(emitted_dev)))
+
+
+def record_latency_per_chunk(hist, chunks, out):
+    for c in chunks:
+        jax.block_until_ready(out)
+        hist.observe(float(jax.device_get(out)))   # line 22: sync per iter
+
+
+def fine_record_host_counts(registry, chunks):
+    # the blessed pattern: count at the host boundary (free), read
+    # device values once at collection time
+    n = 0
+    for c in chunks:
+        n += len(c)
+    registry.set("siddhi.app.stream.S.events", n)
+
+
+def fine_collect_once(registry, emitted_dev, states):
+    # ONE batched pytree transfer at scrape time, outside any loop
+    host = jax.device_get({"emitted": emitted_dev, "states": states})
+    registry.set("siddhi.app.query.q.emitted", int(host["emitted"]))

@@ -46,6 +46,10 @@ def test_bench_filter_quick_parses():
     assert d["compile_ms"] > 0
     assert d["ttfr_ms"] > 0
     assert d["warm_programs"] > 0
+    # per-config registry dump (BENCH_r06+): must parse as a dict of
+    # dotted siddhi.* metrics (docs/observability.md)
+    assert isinstance(d["metrics"], dict)
+    assert any(k.startswith("siddhi.") for k in d["metrics"])
 
 
 def test_bench_chain3_quick_parses_fused_vs_unfused():
@@ -57,3 +61,5 @@ def test_bench_chain3_quick_parses_fused_vs_unfused():
     assert d["fused_eps"] > 0 and d["unfused_eps"] > 0
     assert d["fused_speedup"] > 0
     assert d["compile_ms"] > 0 and d["ttfr_ms"] > 0
+    assert isinstance(d["metrics"], dict)
+    assert any(k.startswith("siddhi.") for k in d["metrics"])

@@ -40,6 +40,17 @@ def test_host_sync_in_loop_fires_with_anchor():
     assert all(f.line < 24 for f in fs)
 
 
+def test_host_sync_in_loop_covers_metric_recording_paths():
+    """Observability contract: metrics must never add per-chunk device
+    syncs at BASIC level (docs/observability.md) — the rule must fire
+    on registry/histogram updates that device_get inside a chunk loop,
+    and stay quiet on host-boundary counts + batched collection."""
+    fs = findings_for("bad_metrics_loop.py")
+    assert lines_of(fs, "host-sync-in-loop") == [16, 22]
+    # fine_record_host_counts / fine_collect_once stay clean
+    assert all(f.line < 25 for f in fs)
+
+
 def test_host_sync_in_jit_fires_for_decorated_and_wrapped():
     fs = findings_for("bad_jit_sync.py")
     assert lines_of(fs, "host-sync-in-jit") == [8, 13]

@@ -1,0 +1,603 @@
+"""Observability layer (siddhi_tpu/obs/, docs/observability.md):
+
+- MetricsRegistry instruments + Prometheus exposition
+- statistics() == registry-view equivalence (plain, fused chains,
+  partitions, DETAIL latency)
+- BASIC-level overhead bound (<=5% wall on the filter microbench shape)
+- @app:statistics(reporter, interval) parsing + parse-time validation
+- periodic reporters (console/jsonl)
+- service GET /metrics / /health / /ready (readiness tied to
+  CompileService warmup)
+- chunk-span tracing -> Chrome trace JSON; profiler hooks
+- LatencyTracker.summary() thread-safety regression
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.types import GLOBAL_STRINGS
+from siddhi_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                    MetricsRegistry, prom_name)
+from siddhi_tpu.ops.expr import CompileError
+
+TS0 = 1_700_000_000_000
+
+CHAIN_APP = """
+    @app:playback
+    define stream S (v int);
+    @info(name = 'q1') from S[v > 0] select v insert into M;
+    @info(name = 'q2') from M[v < 1000000] select v insert into Out;
+"""
+
+
+def _playback_app(ql, level=None):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    if level is not None:
+        rt.set_statistics_level(level)
+    rt.start()
+    return rt
+
+
+def _send_ramp(rt, stream, n, base=TS0):
+    h = rt.get_input_handler(stream)
+    h.send_arrays(base + np.arange(n, dtype=np.int64),
+                  [np.arange(1, n + 1, dtype=np.int32)])
+
+
+# ---------------------------------------------------------------------------
+# registry instruments + exposition
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_instruments(self):
+        m = MetricsRegistry()
+        m.counter("siddhi.a.events").inc(3)
+        m.counter("siddhi.a.events").inc(2)
+        m.gauge("siddhi.a.depth").set(7)
+        for v in (1.0, 2.0, 100.0):
+            m.histogram("siddhi.a.lat").observe(v)
+        snap = m.collect()
+        assert snap["siddhi.a.events"] == 5
+        assert snap["siddhi.a.depth"] == 7
+        assert snap["siddhi.a.lat.count"] == 3
+        assert snap["siddhi.a.lat.p50"] == 2.0
+        # same-name different-kind is a programming error
+        with pytest.raises(TypeError):
+            m.gauge("siddhi.a.events")
+
+    def test_collector_backed_gauges(self):
+        m = MetricsRegistry()
+        m.register_collector(lambda: {"siddhi.x.live": 42})
+        assert m.collect()["siddhi.x.live"] == 42
+
+    def test_prometheus_text(self):
+        m = MetricsRegistry()
+        m.counter("siddhi.app-1.stream.S.events").inc(9)
+        m.gauge("siddhi.app-1.queue.depth").set(2)
+        m.histogram("siddhi.app-1.lat").observe(5.0)
+        text = m.prometheus_text()
+        assert "# TYPE siddhi_app_1_stream_S_events counter" in text
+        assert "siddhi_app_1_stream_S_events 9" in text
+        assert "# TYPE siddhi_app_1_queue_depth gauge" in text
+        assert "# TYPE siddhi_app_1_lat summary" in text
+        assert 'siddhi_app_1_lat{quantile="0.5"} 5.0' in text
+        assert "siddhi_app_1_lat_count 1" in text
+
+    def test_prom_name_sanitization(self):
+        assert prom_name("siddhi.my app.q-1.latency") == \
+            "siddhi_my_app_q_1_latency"
+        assert prom_name("0weird")[0] == "_"
+
+    def test_broken_collector_does_not_kill_scrape(self):
+        m = MetricsRegistry()
+        m.register_collector(lambda: 1 / 0)
+        m.gauge("siddhi.ok").set(1)
+        assert m.collect()["siddhi.ok"] == 1
+
+
+# ---------------------------------------------------------------------------
+# statistics() <-> registry equivalence
+# ---------------------------------------------------------------------------
+
+
+class TestStatisticsRegistryEquivalence:
+    def _assert_query_equiv(self, rt):
+        """Every numeric per-query statistics() value must appear in the
+        registry dump under siddhi.<app>.query.<q>.* with the same
+        value."""
+        flat, report = rt._collect_observability()
+        prefix = f"siddhi.{rt.name}.query."
+        for qname, entry in report.items():
+            if not isinstance(entry, dict) or qname.startswith("store:") \
+                    or qname in ("stream_errors", "compile"):
+                continue
+            base = f"{prefix}{qname}"
+            for key, metric in (("emitted", "emitted"),
+                                ("overflow", "overflow"),
+                                ("throughput_eps", "throughput"),
+                                ("state_bytes", "state.bytes")):
+                if isinstance(entry.get(key), (int, float)):
+                    assert flat[f"{base}.{metric}"] == entry[key], \
+                        (qname, key)
+            for k, v in (entry.get("latency") or {}).items():
+                assert flat[f"{base}.latency.{k}"] == v
+
+    def test_fused_chain(self):
+        rt = _playback_app(CHAIN_APP, level="BASIC")
+        assert rt.queries["q1"]._fused_chain is not None
+        _send_ramp(rt, "S", 512)
+        _send_ramp(rt, "S", 512, base=TS0 + 512)
+        self._assert_query_equiv(rt)
+        flat = rt.metrics.collect()
+        assert flat[f"siddhi.{rt.name}.query.q1.emitted"] == 1024
+        assert flat[f"siddhi.{rt.name}.query.q2.emitted"] == 1024
+        # stream-level ingest throughput (the ISSUE's canonical name)
+        assert flat[f"siddhi.{rt.name}.stream.S.events"] == 1024
+        assert flat[f"siddhi.{rt.name}.stream.S.throughput"] > 0
+        rt.shutdown()
+
+    def test_partition(self):
+        rt = _playback_app("""
+            @app:playback
+            define stream S (sym string, v long);
+            partition with (sym of S) begin
+              @info(name = 'pq')
+              from S select sym, sum(v) as total insert into POut;
+            end;
+        """, level="BASIC")
+        h = rt.get_input_handler("S")
+        n = 256
+        codes = np.array([GLOBAL_STRINGS.encode(f"K{i % 7}")
+                          for i in range(n)], np.int32)
+        h.send_arrays(TS0 + np.arange(n, dtype=np.int64),
+                      [codes, np.ones(n, np.int64)])
+        self._assert_query_equiv(rt)
+        flat = rt.metrics.collect()
+        assert flat[f"siddhi.{rt.name}.query.pq.emitted"] == n
+        rt.shutdown()
+
+    def test_detail_latency(self):
+        rt = _playback_app(CHAIN_APP)
+        rt.lat_sample_every = 1
+        rt.set_statistics_level("DETAIL")
+        _send_ramp(rt, "S", 64)
+        _send_ramp(rt, "S", 64, base=TS0 + 64)
+        stats = rt.statistics()
+        lat = stats["q1"]["latency"]
+        assert lat["samples"] == 2
+        flat = rt.metrics.collect()
+        base = f"siddhi.{rt.name}.query.q1.latency"
+        assert flat[f"{base}.p99_ms"] == lat["p99_ms"]
+        assert flat[f"{base}.samples"] == 2
+        rt.shutdown()
+
+    def test_scheduler_and_app_gauges_present(self):
+        rt = _playback_app(CHAIN_APP, level="BASIC")
+        flat = rt.metrics.collect()
+        p = f"siddhi.{rt.name}"
+        assert flat[f"{p}.scheduler.pending"] >= 0
+        assert flat[f"{p}.scheduler.lag_ms"] >= 0
+        assert flat[f"{p}.app.running"] == 1
+        assert flat[f"{p}.app.ready"] == 1
+        assert flat[f"{p}.errorstore.backlog"] == 0
+        rt.shutdown()
+
+    def test_async_queue_depth_gauges(self):
+        rt = _playback_app("""
+            @app:playback
+            @Async(buffer.size='64', batch.size.max='16')
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """, level="BASIC")
+        _send_ramp(rt, "S", 128)
+        rt.junctions["S"].flush_async()
+        flat = rt.metrics.collect()
+        p = f"siddhi.{rt.name}.stream.S.async"
+        assert flat[f"{p}.capacity"] == 64
+        assert flat[f"{p}.depth"] == 0      # drained
+        assert flat[f"{p}.pending"] == 0
+        rt.shutdown()
+
+    def test_checkpoint_age_gauge(self):
+        from siddhi_tpu.resilience.supervisor import CheckpointSupervisor
+        rt = _playback_app(CHAIN_APP, level="BASIC")
+        sup = CheckpointSupervisor(rt, interval_ms=1000).start(
+            base_ms=TS0)
+        _send_ramp(rt, "S", 16)
+        # advance the virtual clock past several checkpoint intervals
+        _send_ramp(rt, "S", 16, base=TS0 + 5_000)
+        assert sup.checkpoints >= 1
+        flat = rt.metrics.collect()
+        p = f"siddhi.{rt.name}.checkpoint"
+        assert flat[f"{p}.count"] == sup.checkpoints
+        assert flat[f"{p}.age_ms"] >= 0
+        sup.stop()
+        rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# BASIC-level overhead bound
+# ---------------------------------------------------------------------------
+
+
+def test_basic_stats_overhead_under_5pct_on_filter_shape():
+    """BASIC metrics are host-boundary counters only: on the filter
+    microbench shape they must add <=5% wall time vs stats OFF. Same
+    process, same compiled steps, alternating min-of-N runs so compile
+    and host-contention variance cancel."""
+    rt = _playback_app("""
+        @app:playback
+        define stream S (sym string, price float, volume long);
+        @info(name = 'q')
+        from S[price > 100.0] select sym, price insert into Out;
+    """)
+    import jax
+    last = [None]
+    rt.queries["q"].batch_callbacks.append(lambda out: last.__setitem__(
+        0, out))
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(7)
+    chunk, chunks = 65_536, 8
+    syms = np.array([GLOBAL_STRINGS.encode(s)
+                     for s in ("A", "B", "C", "D")], np.int32)
+    clock = [TS0]
+
+    def run():
+        for _ in range(chunks):
+            ts = clock[0] + np.arange(chunk, dtype=np.int64)
+            clock[0] += chunk
+            h.send_arrays(ts, [syms[rng.integers(0, 4, chunk)],
+                               rng.uniform(0, 200, chunk)
+                               .astype(np.float32),
+                               rng.integers(1, 1000, chunk,
+                                            dtype=np.int64)])
+        jax.block_until_ready(last[0].valid)
+
+    run()  # warm every step/encoding before timing
+    reps = 5
+    t_off, t_basic = float("inf"), float("inf")
+    for _ in range(reps):
+        rt.set_statistics_level("OFF")
+        t0 = time.perf_counter()
+        run()
+        t_off = min(t_off, time.perf_counter() - t0)
+        rt.set_statistics_level("BASIC")
+        t0 = time.perf_counter()
+        run()
+        t_basic = min(t_basic, time.perf_counter() - t0)
+    rt.shutdown()
+    # 10 ms absolute floor absorbs scheduler jitter on sub-100ms runs
+    assert t_basic <= t_off * 1.05 + 0.010, (t_off, t_basic)
+
+
+# ---------------------------------------------------------------------------
+# @app:statistics annotation surface
+# ---------------------------------------------------------------------------
+
+
+class TestStatisticsAnnotation:
+    def test_reporter_and_interval_parsed(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+            @app:statistics(level='DETAIL', reporter='file',
+                            interval='100 ms')
+            define stream S (v int);
+            from S select v insert into Out;
+        """)
+        assert rt.stats_level == 2
+        assert rt._stats_reporter_conf == ("file", 100, None)
+
+    def test_interval_alone_defaults_console(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+            @app:statistics(interval='2 sec')
+            define stream S (v int);
+            from S select v insert into Out;
+        """)
+        assert rt.stats_level == 1          # annotation present -> BASIC
+        assert rt._stats_reporter_conf == ("console", 2000, None)
+
+    def test_level_only_compat(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime("""
+            @app:statistics('DETAIL')
+            define stream S (v int);
+            from S select v insert into Out;
+        """)
+        assert rt.stats_level == 2
+        assert rt._stats_reporter_conf is None
+
+    def test_unknown_reporter_rejected_at_parse(self):
+        with pytest.raises(CompileError, match="statistics-reporter"):
+            SiddhiManager().create_siddhi_app_runtime("""
+                @app:statistics(reporter='graphite')
+                define stream S (v int);
+                from S select v insert into Out;
+            """)
+
+    def test_bad_interval_rejected_at_parse(self):
+        with pytest.raises(CompileError, match="statistics-interval"):
+            SiddhiManager().create_siddhi_app_runtime("""
+                @app:statistics(reporter='console', interval='soon')
+                define stream S (v int);
+                from S select v insert into Out;
+            """)
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
+
+
+class TestReporters:
+    def test_jsonl_reporter_writes_lines(self, tmp_path):
+        path = tmp_path / "metrics.jsonl"
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(f"""
+            @app:playback
+            @app:statistics(reporter='jsonl', interval='50 ms',
+                            file='{path}')
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """)
+        rt.start()
+        assert rt._reporter is not None
+        _send_ramp(rt, "S", 32)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if path.exists() and path.read_text().strip():
+                break
+            time.sleep(0.05)
+        rt.shutdown()
+        assert rt._reporter is None        # shutdown stops the reporter
+        lines = [json.loads(x) for x in
+                 path.read_text().strip().splitlines()]
+        assert lines, "reporter never ticked"
+        snap = lines[-1]
+        assert snap["app"] == rt.name
+        assert any(k.startswith("siddhi.") for k in snap)
+
+    def test_console_reporter_emits_json(self):
+        import logging
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        logger = logging.getLogger("siddhi_tpu.metrics")
+        h = Capture()
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+        try:
+            rt = _playback_app(CHAIN_APP, level="BASIC")
+            from siddhi_tpu.obs.reporters import ConsoleReporter
+            rep = ConsoleReporter(rt, interval_ms=30).start()
+            _send_ramp(rt, "S", 16)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not records:
+                time.sleep(0.03)
+            rep.stop()
+            rt.shutdown()
+        finally:
+            logger.removeHandler(h)
+        assert records, "console reporter never ticked"
+        snap = json.loads(records[-1])
+        assert snap["app"] == rt.name
+
+
+# ---------------------------------------------------------------------------
+# service endpoints
+# ---------------------------------------------------------------------------
+
+SERVICE_APP = """
+@app:name('obsapp')
+@app:playback
+@app:statistics('BASIC')
+define stream S (v int);
+@info(name = 'q') from S[v > 0] select v insert into Out;
+"""
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestServiceEndpoints:
+    def test_metrics_health_ready(self):
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService()
+        svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        code, body = _get(f"{base}/health")
+        assert code == 200 and json.loads(body)["status"] == "up"
+        code, body = _get(f"{base}/ready")
+        assert code == 200          # nothing deployed: trivially ready
+        svc.deploy(SERVICE_APP)
+        code, text = _get(f"{base}/metrics")
+        assert code == 200
+        assert "# TYPE siddhi_obsapp_app_ready gauge" in text
+        assert "siddhi_obsapp_app_running 1" in text
+        code, body = _get(f"{base}/ready")
+        assert code == 200 and json.loads(body)["apps"] == {
+            "obsapp": True}
+        svc.stop()
+
+    def test_ready_flips_with_warmup_in_flight(self):
+        """GET /ready must be 503 exactly while a CompileService warmup
+        is in flight (the deterministic core of 'ready flips only after
+        warmup completes')."""
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService()
+        svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        name = svc.deploy(SERVICE_APP)
+        rt = svc._deployed[name]
+        assert _get(f"{base}/ready")[0] == 200
+        rt.compile_service._begin()     # a warmup is now in flight
+        code, body = _get(f"{base}/ready")
+        assert code == 503
+        assert json.loads(body) == {"ready": False,
+                                    "apps": {"obsapp": False}}
+        rt.compile_service._end()       # ... and it completed
+        assert _get(f"{base}/ready")[0] == 200
+        svc.stop()
+
+    def test_async_warm_deploy_becomes_ready(self, monkeypatch):
+        """End to end: with SIDDHI_TPU_WARM_BUCKETS configured, deploy
+        returns immediately, the warmup runs in the background, and
+        /ready flips to 200 with warmup telemetry recorded."""
+        monkeypatch.setenv("SIDDHI_TPU_WARM_BUCKETS", "16")
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService()
+        svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        name = svc.deploy(SERVICE_APP)
+        deadline = time.monotonic() + 120.0
+        code = 503
+        while time.monotonic() < deadline:
+            code, _ = _get(f"{base}/ready")
+            if code == 200:
+                break
+            time.sleep(0.05)
+        assert code == 200, "async warmup never completed"
+        rt = svc._deployed[name]
+        assert rt.compile_service.warmups >= 1
+        assert rt.statistics()["compile"]["programs"] > 0
+        svc.stop()
+
+    def test_health_unauthenticated_metrics_authenticated(self):
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService(auth_token="s3cret")
+        svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        assert _get(f"{base}/health")[0] == 200    # LB probe: no token
+        assert _get(f"{base}/ready")[0] == 200
+        assert _get(f"{base}/metrics")[0] == 401   # internals: token
+        req = urllib.request.Request(
+            f"{base}/metrics",
+            headers={"Authorization": "Bearer s3cret"})
+        assert urllib.request.urlopen(req).status == 200
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# tracing + profiler
+# ---------------------------------------------------------------------------
+
+
+class TestTracing:
+    def test_trace_export_chrome_json(self, tmp_path):
+        rt = _playback_app(CHAIN_APP)
+        rt.trace_start()
+        _send_ramp(rt, "S", 128)
+        path = rt.trace_export(str(tmp_path / "trace.json"))
+        rt.shutdown()
+        trace = json.load(open(path))
+        events = trace["traceEvents"]
+        assert events, "no spans recorded"
+        names = {e["name"] for e in events}
+        assert "ingest/S" in names
+        # fused segment: ONE span, member queries annotated
+        assert "chain/q1+q2" in names
+        chain = next(e for e in events if e["name"] == "chain/q1+q2")
+        assert chain["args"]["members"] == ["q1", "q2"]
+        for e in events:
+            assert e["ph"] == "X"
+            assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+            assert e["dur"] >= 0
+
+    def test_tracer_disabled_by_default(self):
+        rt = _playback_app(CHAIN_APP)
+        _send_ramp(rt, "S", 32)
+        assert rt.tracer.events() == []
+        rt.shutdown()
+
+    def test_step_and_junction_spans_unfused(self, tmp_path):
+        import os
+        os.environ["SIDDHI_TPU_FUSE"] = "0"
+        try:
+            rt = _playback_app(CHAIN_APP)
+        finally:
+            os.environ.pop("SIDDHI_TPU_FUSE", None)
+        rt.trace_start()
+        _send_ramp(rt, "S", 64)
+        path = rt.trace_export(str(tmp_path / "t.json"))
+        rt.shutdown()
+        names = {e["name"] for e in
+                 json.load(open(path))["traceEvents"]}
+        assert "step/q1" in names and "step/q2" in names
+        assert "junction/M" in names    # per-hop publish
+
+    def test_profile_context_manager(self, tmp_path):
+        rt = _playback_app(CHAIN_APP)
+        prof_dir = tmp_path / "prof"
+        try:
+            with rt.profile(str(prof_dir)):
+                _send_ramp(rt, "S", 64)
+        except Exception as e:  # noqa: BLE001 — backend-dependent
+            rt.shutdown()
+            pytest.skip(f"jax profiler unavailable: {e}")
+        rt.shutdown()
+        assert prof_dir.exists() and any(prof_dir.rglob("*"))
+
+    def test_named_scopes_gated_off_by_default(self, monkeypatch):
+        import contextlib
+        from siddhi_tpu.obs.profiler import op_scope
+        monkeypatch.delenv("SIDDHI_TPU_PROFILE_SCOPES", raising=False)
+        assert isinstance(op_scope("FilterOp"), contextlib.nullcontext)
+        monkeypatch.setenv("SIDDHI_TPU_PROFILE_SCOPES", "1")
+        scope = op_scope("FilterOp")
+        assert not isinstance(scope, contextlib.nullcontext)
+
+
+# ---------------------------------------------------------------------------
+# stats race regression
+# ---------------------------------------------------------------------------
+
+
+def test_latency_summary_concurrent_with_mark_out():
+    """Regression: summary() used to sort self.samples without the lock
+    while mark_out deletes+appends under it — a torn read raised or
+    returned garbage. Hammer both concurrently."""
+    from siddhi_tpu.core.stats import LatencyTracker
+    lt = LatencyTracker()
+    lt.CAP = 64            # force constant del/append churn
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        while not stop.is_set():
+            lt.mark_in()
+            lt.mark_out()
+
+    threads = [threading.Thread(target=writer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            try:
+                s = lt.summary()
+            except Exception as e:  # noqa: BLE001 — the regression
+                errors.append(e)
+                break
+            if s is not None:
+                assert s["samples"] > 0
+                assert s["p99_ms"] >= s["p50_ms"] >= 0
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors, errors
