@@ -134,6 +134,61 @@ def _metrics_snapshot(rt):
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _stage_breakdown(rt, send):
+    """Per-step cost attribution (obs/costmodel.py), run AFTER the timed
+    reps — every sampled chunk serializes the pipeline, so it must never
+    overlap a measurement. Stride 1: the single `send()` pass times every
+    step once; the ranked report lands in the config's `stage_breakdown`
+    field and merges into ./.jax_cache/costs.json for the cost-aware DAG
+    optimizer (ROADMAP item 5)."""
+    try:
+        rt.cost_start(every=1)
+        send()
+        report = rt.cost_report()
+        rt.cost_stop()
+        rt.cost_save()
+        return report
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail a run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
+FRONTIER_CHUNKS = (64, 256, 1024)
+FRONTIER_ITERS = int(_env("SIDDHI_BENCH_FRONTIER_ITERS", "32") or 32)
+
+
+def _frontier(send_chunk, events_per_iter, chunks=FRONTIER_CHUNKS,
+              iters=FRONTIER_ITERS):
+    """Latency/throughput frontier (ROADMAP item 3's acceptance
+    artifact; the TiLT-style time-centric batching trade-off): per-chunk
+    synchronous send->drain latency at small/medium/large chunk sizes.
+    Each row is {chunk, events_per_s, p50_ms, p95_ms, p99_ms} — small
+    chunks buy match latency, large chunks buy events/s; the recorded
+    curve makes the dial's cost explicit per config."""
+    rows = []
+    for c in chunks:
+        try:
+            send_chunk(c)   # warm this bucket's programs off the clock
+            ms = []
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                c0 = time.perf_counter()
+                send_chunk(c)
+                ms.append((time.perf_counter() - c0) * 1000.0)
+            total = time.perf_counter() - t0
+            arr = np.array(ms)
+            rows.append({
+                "chunk": c,
+                "events_per_s": round(events_per_iter(c) * iters / total,
+                                      1),
+                "p50_ms": round(float(np.percentile(arr, 50)), 3),
+                "p95_ms": round(float(np.percentile(arr, 95)), 3),
+                "p99_ms": round(float(np.percentile(arr, 99)), 3)})
+        except Exception as e:  # noqa: BLE001 — telemetry must not fail
+            rows.append({"chunk": c,
+                         "error": f"{type(e).__name__}: {e}"})
+    return rows
+
+
 def _entry(name, events, seconds, extra=None):
     eps = events / seconds
     d = {"value": round(eps, 1), "unit": "events/s",
@@ -203,10 +258,14 @@ def bench_filter(n=1_000_000):
     rt.lat_sample_every = 1
     rt.set_statistics_level("DETAIL")
     h.send_arrays(ts[:1024], [sym[:1024], price[:1024], vol[:1024]])
+    sb = _stage_breakdown(rt, lambda: (
+        h.send_arrays(ts[:8192], [sym[:8192], price[:8192], vol[:8192]]),
+        _drain(outs)))
     met = _metrics_snapshot(rt)
     rt.shutdown()
     return _entry("filter", n, dt, extra={
-        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met, **cinfo})
+        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
+        "stage_breakdown": sb, **cinfo})
 
 
 CHAIN3_APP = """
@@ -246,6 +305,13 @@ def _run_chain3(n: int, fused: bool):
                                outs.drain()))
         dt = min(_timed(lambda: (h.send_arrays(ts, [sym, v, price]),
                                  outs.drain())) for _ in range(REPS))
+        if fused:
+            # fused run only: the breakdown names the chain/q1+q2+q3
+            # center (one XLA program — docs/observability.md)
+            cinfo["stage_breakdown"] = _stage_breakdown(rt, lambda: (
+                h.send_arrays(ts[:8192], [sym[:8192], v[:8192],
+                                          price[:8192]]),
+                outs.drain()))
         cinfo["metrics"] = _metrics_snapshot(rt)
         rt.shutdown()
         return dt, ttfr, cinfo
@@ -299,13 +365,18 @@ def bench_window_agg(n=1_000_000):
                            _drain(outs)))
     dt = min(_timed(lambda: (h.send_arrays(ts, [sym, price, vol]),
                              _drain(outs))) for _ in range(REPS))
+    sb = _stage_breakdown(rt, lambda: (
+        h.send_arrays(ts[:8192], [sym[:8192], price[:8192], vol[:8192]]),
+        _drain(outs)))
     met = _metrics_snapshot(rt)
     rt.shutdown()
     return _entry("window_agg", n, dt, extra={
-        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met, **cinfo})
+        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
+        "stage_breakdown": sb, **cinfo})
 
 
-def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int):
+def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int,
+              frontier: bool = False):
     """Shared join driver. Honest emission: every surviving pair is
     built and emitted (the r3 bench capped output at 1024 pairs/step,
     silently dropping >99% on the 4-symbol workload and measuring only
@@ -368,6 +439,24 @@ def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int):
     dt = min(dts)
     emitted = q.stats()["emitted"]
     dropped = q.overflow
+    if frontier:
+        # frontier + breakdown run AFTER the timed reps on a clock past
+        # every measurement pass (the playback clock must stay monotone)
+        fclock = [TS0 + (3 + REPS * n_chunks) * chunk]
+
+        def send_pair(c):
+            fts = fclock[0] + np.arange(c, dtype=np.int64)
+            fclock[0] += c
+            fsym = syms[rng.integers(0, len(syms), c)]
+            hs.send_arrays(fts, [fsym, rng.uniform(0, 200, c)
+                                 .astype(np.float32)])
+            ht.send_arrays(fts, [fsym, rng.integers(0, 50, c)
+                                 .astype(np.int32)])
+            outs.drain()
+
+        cinfo["frontier"] = _frontier(send_pair, lambda c: 2 * c)
+        cinfo["stage_breakdown"] = _stage_breakdown(
+            rt, lambda: send_pair(2048))
     cinfo["metrics"] = _metrics_snapshot(rt)
     rt.shutdown()
     cinfo["ttfr_ms"] = round(ttfr * 1000.0, 1)
@@ -379,7 +468,8 @@ def bench_join():
     ~1 matching pair per event — what a 'join throughput' baseline guess
     plausibly describes)."""
     dt, events, emitted, dropped, cinfo = _run_join(
-        n_symbols=1024, chunk=8192, join_pairs=131_072, n_side=131_072)
+        n_symbols=1024, chunk=8192, join_pairs=131_072, n_side=131_072,
+        frontier=True)
     return _entry("join", events, dt, extra={
         "symbols": 1024, "pairs_emitted": emitted,
         "pairs_dropped": dropped, **cinfo})
@@ -447,10 +537,13 @@ def bench_seq2(n=262_144, chunk=65_536):
         _drain(outs)
         dts.append(time.perf_counter() - t0)
     dt = min(dts)
+    sb = _stage_breakdown(rt, lambda: (send(2 + REPS * n_chunks, chunk),
+                                       _drain(outs)))
     met = _metrics_snapshot(rt)
     rt.shutdown()
     return _entry("seq2", 2 * n_chunks * chunk, dt, extra={
-        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met, **cinfo})
+        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
+        "stage_breakdown": sb, **cinfo})
 
 
 def bench_kleene(n=262_144, chunk=65_536):
@@ -495,10 +588,13 @@ def bench_kleene(n=262_144, chunk=65_536):
         _drain(outs)
         dts.append(time.perf_counter() - t0)
     dt = min(dts)
+    sb = _stage_breakdown(rt, lambda: (send(2 + REPS * n_chunks, chunk),
+                                       _drain(outs)))
     met = _metrics_snapshot(rt)
     rt.shutdown()
     return _entry("kleene", 2 * n_chunks * chunk, dt, extra={
-        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met, **cinfo})
+        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
+        "stage_breakdown": sb, **cinfo})
 
 
 SEQ5_APP = """
@@ -582,12 +678,20 @@ def bench_seq5(n=1_048_576, chunk=65_536):
         h.send_arrays(*mk(small))
         _drain(outs)
         lat1k.append(time.perf_counter() - c0)
+    # latency/throughput frontier + per-step breakdown, AFTER every
+    # timed pass (both serialize the pipeline); mk() keeps the playback
+    # clock monotone across all of it
+    fr = _frontier(lambda c: (h.send_arrays(*mk(c)), _drain(outs)),
+                   lambda c: c)
+    sb = _stage_breakdown(rt, lambda: (h.send_arrays(*mk(chunk)),
+                                       _drain(outs)))
     met = _metrics_snapshot(rt)
     rt.shutdown()
     lat_ms = np.array(lat) * 1000.0
     lat1k_ms = np.array(lat1k) * 1000.0
     return _entry("seq5", n_chunks * chunk, dt, extra={
         "metrics": met,
+        "frontier": fr, "stage_breakdown": sb,
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),
         "chunk": chunk,

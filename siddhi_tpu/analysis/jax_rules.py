@@ -6,9 +6,11 @@ Static versions of the invariants this codebase already paid to learn
 - a module-level jax array captured as a constant by a jitted step knocks
   the process off the fast dispatch path (~2.4 ms added to EVERY
   dispatch, measured on TPU v5-lite);
-- host syncs (``jax.device_get``, ``.item()``, ``int()``/``float()`` on
-  device values) inside Python loops serialize the device pipeline once
-  per iteration instead of once per batch;
+- host syncs (``jax.device_get``, ``jax.block_until_ready``,
+  ``.item()``, ``int()``/``float()`` on device values) inside Python
+  loops serialize the device pipeline once per iteration instead of
+  once per batch — timing probes must gate the sync on a sampling
+  stride (the obs/costmodel.py probe pattern);
 - Python control flow on traced values inside ``@jax.jit`` bodies either
   crashes at trace time or silently forces a concretization;
 - Python scalars feeding shapes and non-hashable static args recompile
@@ -125,6 +127,10 @@ def _host_sync_reason(ctx: ModuleContext, call: ast.Call):
     c = ctx.canon(call.func)
     if c == ("jax", "device_get"):
         return "jax.device_get"
+    if c == ("jax", "block_until_ready"):
+        # the cost-profiler/DETAIL-latency sync: legal only on a SAMPLED
+        # branch outside the chunk loop (obs/costmodel.py probe pattern)
+        return "jax.block_until_ready"
     if isinstance(call.func, ast.Attribute) and call.func.attr == "item" \
             and not call.args and not call.keywords:
         return f"{_src(call.func.value)}.item()"

@@ -481,6 +481,8 @@ class QueryRuntime(Receiver):
     def process_packed(self, chunk: PackedChunk) -> None:
         if self._fused_chain is not None:
             return self._fused_chain.process_packed(chunk)
+        cost = self.app.cost
+        probe = cost.probe("query", self.name) if cost.enabled else None
         with self.app.tracer.span("step", self.name, rows=chunk.n):
             lat = self._stats_mark(chunk.n)
             self._last_now = max(self._last_now, chunk.last_ts)
@@ -494,9 +496,13 @@ class QueryRuntime(Receiver):
                                  chunk.buf)
                     for t in self.table_deps:
                         self.app.tables[t].state = tstates[t]
-            if lat is not None:
+            if lat is not None or probe is not None:
+                # sampled branch only: the sync serializes the pipeline
                 jax.block_until_ready(out.valid)
-                lat.mark_out()
+                if lat is not None:
+                    lat.mark_out()
+                if probe is not None:
+                    probe.done(rows=chunk.n)
             if self._host_due_all and chunk.ts_min is not None:
                 self._dispatch_output(out, chunk.last_ts)
                 self._schedule(min(op.host_due_bound(chunk.ts_min)
@@ -644,6 +650,8 @@ class QueryRuntime(Receiver):
             return self._fused_chain.process_batch(batch, timestamp,
                                                    now=now,
                                                    skip_due=skip_due)
+        cost = self.app.cost
+        probe = cost.probe("query", self.name) if cost.enabled else None
         with self.app.tracer.span("step", self.name,
                                   capacity=int(batch.capacity)):
             if now is None:
@@ -661,9 +669,13 @@ class QueryRuntime(Receiver):
                                  batch, now_dev)
                     for t in self.table_deps:
                         self.app.tables[t].state = tstates[t]
-            if lat is not None:
+            if lat is not None or probe is not None:
+                # sampled branch only: the sync serializes the pipeline
                 jax.block_until_ready(out.valid)
-                lat.mark_out()
+                if lat is not None:
+                    lat.mark_out()
+                if probe is not None:
+                    probe.done(rows=int(batch.capacity))
             self._dispatch_output(
                 out, timestamp,
                 due=due if (self._has_timers and not skip_due) else None)
@@ -927,7 +939,10 @@ class FusedChain:
 
     def process_packed(self, chunk: PackedChunk) -> None:
         # ONE span per fused segment (the segment IS one XLA program);
-        # member queries are named in args instead of per-hop spans
+        # member queries are named in args instead of per-hop spans —
+        # and ONE cost center, for the same reason (obs/costmodel.py)
+        cost = self.app.cost
+        probe = cost.probe("chain", self.name) if cost.enabled else None
         with self.app.tracer.span("chain", self.name, rows=chunk.n,
                                   members=[q.name for q in self.queries]):
             lat = self.head._stats_mark(chunk.n)
@@ -936,15 +951,20 @@ class FusedChain:
             out, dues = self._run(
                 self._packed_step_for(chunk.enc, chunk.capacity),
                 chunk.buf)
-            if lat is not None:
+            if lat is not None or probe is not None:
                 jax.block_until_ready(out.valid)
-                lat.mark_out()
+                if lat is not None:
+                    lat.mark_out()
+                if probe is not None:
+                    probe.done(rows=chunk.n)
             self._schedule_dues(dues, chunk.ts_min)
             self.tail._dispatch_output(out, chunk.last_ts)
 
     def process_batch(self, batch: EventBatch, timestamp: int,
                       now: Optional[int] = None,
                       skip_due: bool = False) -> None:
+        cost = self.app.cost
+        probe = cost.probe("chain", self.name) if cost.enabled else None
         with self.app.tracer.span("chain", self.name,
                                   members=[q.name for q in self.queries]):
             if now is None:
@@ -954,9 +974,12 @@ class FusedChain:
                 q._last_now = max(q._last_now, int(now))
             now_dev = jnp.asarray(now, dtype=jnp.int64)
             out, dues = self._run(self._step_for(), batch, now_dev)
-            if lat is not None:
+            if lat is not None or probe is not None:
                 jax.block_until_ready(out.valid)
-                lat.mark_out()
+                if lat is not None:
+                    lat.mark_out()
+                if probe is not None:
+                    probe.done(rows=int(batch.capacity))
             self._schedule_dues(dues, None, skip_head_due=skip_due)
             self.tail._dispatch_output(out, timestamp)
 
@@ -1099,11 +1122,18 @@ class PatternQueryRuntime(QueryRuntime):
         self._sched_due = None
         if not self.app.running:
             return
+        cost = self.app.cost
+        probe = cost.probe("pattern", f"{self.name}.timer") \
+            if cost.enabled else None
         self._timer_step_for()
         with self._lock:
             (self.nfa_state, self.states, self._emitted_dev,
              out) = self._timer_step(self.nfa_state, self.states,
                                      self._emitted_dev, np.int64(due))
+        if probe is not None:
+            # sampled branch only: the sync serializes the pipeline
+            jax.block_until_ready(out.valid)
+            probe.done()
         self._dispatch_output(out, due)
         self._schedule_absent()
 
@@ -1150,6 +1180,9 @@ class PatternQueryRuntime(QueryRuntime):
 
     def process_pattern_packed(self, stream_id: str,
                                chunk: PackedChunk) -> None:
+        cost = self.app.cost
+        probe = cost.probe("pattern", f"{self.name}.{stream_id}") \
+            if cost.enabled else None
         self._last_now = max(self._last_now, chunk.last_ts)
         with self._lock:
             step = self._step_for_stream(stream_id,
@@ -1162,6 +1195,10 @@ class PatternQueryRuntime(QueryRuntime):
                              self._emitted_dev, chunk.buf)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
+        if probe is not None:
+            # sampled branch only: the sync serializes the pipeline
+            jax.block_until_ready(out.valid)
+            probe.done(rows=chunk.n)
         self._dispatch_output(out, chunk.last_ts)
         self._schedule_absent()
 
@@ -1179,6 +1216,9 @@ class PatternQueryRuntime(QueryRuntime):
                 self.process_pattern_batch(stream_id, sub, timestamp)
             return
         now_host = self.app.current_time()
+        cost = self.app.cost
+        probe = cost.probe("pattern", f"{self.name}.{stream_id}") \
+            if cost.enabled else None
         self._last_now = max(self._last_now, int(now_host))
         now = jnp.asarray(now_host, dtype=jnp.int64)
         with self._lock:
@@ -1191,6 +1231,10 @@ class PatternQueryRuntime(QueryRuntime):
                              self._emitted_dev, batch, now)
                 for t in self.table_deps:
                     self.app.tables[t].state = tstates[t]
+        if probe is not None:
+            # sampled branch only: the sync serializes the pipeline
+            jax.block_until_ready(out.valid)
+            probe.done(rows=int(batch.capacity))
         self._dispatch_output(out, timestamp)
         # arm the scheduler at the earliest live absent deadline so the
         # pattern fires on clock advance even when no further events come
@@ -1385,8 +1429,13 @@ class JoinQueryRuntime(QueryRuntime):
             self._side_steps[(side, packed_key)] = fn
         return fn
 
+    _SIDE_NAMES = {"L": "left", "R": "right"}
+
     def process_side_packed(self, side: str, chunk: PackedChunk) -> None:
         opp = "R" if side == "L" else "L"
+        cost = self.app.cost
+        probe = cost.probe("join", f"{self.name}.{self._SIDE_NAMES[side]}") \
+            if cost.enabled else None
         self._last_now = max(self._last_now, chunk.last_ts)
         with self._lock:
             step = self._step_for_side(side, (chunk.enc, chunk.capacity))
@@ -1402,6 +1451,10 @@ class JoinQueryRuntime(QueryRuntime):
             self.side_states[side] = my
             self.states = sel
             self._overflow_dev = self._overflow_dev + lost
+        if probe is not None:
+            # sampled branch only: the sync serializes the pipeline
+            jax.block_until_ready(out.valid)
+            probe.done(rows=chunk.n)
         if self._join_host_due and chunk.ts_min is not None:
             self._dispatch_output(out, chunk.last_ts)
             self._schedule(min(op.host_due_bound(chunk.ts_min)
@@ -1432,6 +1485,9 @@ class JoinQueryRuntime(QueryRuntime):
             self._last_now = max(self._last_now, int(timestamp))
         if now is None:
             now = self.app.current_time()
+        cost = self.app.cost
+        probe = cost.probe("join", f"{self.name}.{self._SIDE_NAMES[side]}") \
+            if cost.enabled else None
         now_dev = jnp.asarray(now, dtype=jnp.int64)
         opp = "R" if side == "L" else "L"
         with self._lock:
@@ -1450,6 +1506,10 @@ class JoinQueryRuntime(QueryRuntime):
             # join pairs beyond join_cap are dropped by JoinCross.cross —
             # counted here, never silent (join.py design contract)
             self._overflow_dev = self._overflow_dev + lost
+        if probe is not None:
+            # sampled branch only: the sync serializes the pipeline
+            jax.block_until_ready(out.valid)
+            probe.done(rows=int(batch.capacity))
         self._dispatch_output(
             out, timestamp,
             due=due if (self._has_timers and not skip_due) else None)
@@ -1545,10 +1605,16 @@ class SiddhiAppRuntime:
         # reporter tick / statistics() call) via _collect_observability;
         # the per-chunk path records only into the existing host-side
         # trackers, so BASIC-level metrics stay sync-free.
+        from ..obs.costmodel import CostProfiler
         from ..obs.metrics import MetricsRegistry
         from ..obs.tracing import ChunkTracer
         self.metrics = MetricsRegistry()
         self.tracer = ChunkTracer()
+        # sampled per-step cost attribution (obs/costmodel.py): default
+        # OFF — every dispatch site pays one attribute check; enabled
+        # via cost_start() / SIDDHI_TPU_COST_PROFILE=1 it syncs every
+        # SIDDHI_TPU_COST_EVERY'th chunk per step to measure wall ms
+        self.cost = CostProfiler(self)
         self.metrics.register_collector(
             lambda: self._collect_observability()[0])
         self._checkpoint_supervisor = None  # wired by CheckpointSupervisor
@@ -1896,6 +1962,11 @@ class SiddhiAppRuntime:
             for k in ("warmups", "programs", "compile_ms", "cache_hits",
                       "cache_misses"):
                 flat[f"{p}.compile.{k}"] = report["compile"][k]
+        # sampled per-step cost attribution (obs/costmodel.py): the
+        # step_ms histograms live natively in the registry; the ranked
+        # rollup rides the statistics() view like 'compile'
+        if self.cost.samples:
+            report["cost"] = self.cost.report()
         flat[f"{p}.app.running"] = int(self.running)
         flat[f"{p}.app.ready"] = int(self.ready)
         return flat, report
@@ -1964,8 +2035,36 @@ class SiddhiAppRuntime:
 
     def trace_export(self, path: str) -> str:
         """Write buffered chunk spans as Chrome ``trace_event`` JSON
-        (chrome://tracing / Perfetto loadable); returns ``path``."""
-        return self.tracer.export(path)
+        (chrome://tracing / Perfetto loadable), timestamp-ordered and —
+        when the cost profiler has samples — annotated with measured
+        per-step device time (``cost_ms_per_event`` etc. in span args);
+        returns ``path``."""
+        return self.tracer.export(
+            path, annotations=self.cost.trace_annotations())
+
+    # -- cost profiling (obs/costmodel.py, docs/observability.md) ---------
+    def cost_start(self, every: Optional[int] = None) -> None:
+        """Enable sampled per-step cost attribution: every Nth chunk per
+        step is timed synchronously (``block_until_ready`` on the
+        sampled branch only — the same serialization caveat as DETAIL
+        latency probes). Zero jit-option changes: compile-cache keys are
+        identical with profiling on or off."""
+        self.cost.start(every=every)
+
+    def cost_stop(self) -> None:
+        self.cost.stop()
+
+    def cost_report(self) -> dict:
+        """Ranked per-step cost table (ms/event, share of total,
+        queue-depth trend -> bottleneck verdict) from the sampled
+        timings accumulated since ``cost_start()``."""
+        return self.cost.report()
+
+    def cost_save(self, path: Optional[str] = None) -> str:
+        """Persist the measured cost table into
+        ``<SIDDHI_TPU_CACHE_DIR>/costs.json`` (merge-on-write; the DAG
+        optimizer's planned input). Returns the path written."""
+        return self.cost.save(path)
 
     def profile(self, path: str):
         """Context manager capturing a device profile of the enclosed

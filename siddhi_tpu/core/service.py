@@ -149,8 +149,10 @@ class SiddhiService:
     # -- observability -----------------------------------------------------
     def readiness(self) -> tuple:
         """(all_ready, {app: ready}) — an app is ready when running and
-        its CompileService has no warmup in flight (core/compile.py)."""
-        apps = {name: rt.ready for name, rt in self._deployed.items()}
+        its CompileService has no warmup in flight (core/compile.py).
+        Snapshots the deploy map first: probes race deploy/undeploy."""
+        apps = {name: rt.ready
+                for name, rt in list(self._deployed.items())}
         return all(apps.values()), apps
 
     def metrics_text(self) -> str:

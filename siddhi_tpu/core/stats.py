@@ -87,6 +87,7 @@ class LatencyTracker:
         n = len(s)
         return {"avg_ms": round(sum(s) / n, 3),
                 "p50_ms": round(s[n // 2], 3),
+                "p95_ms": round(s[min(n - 1, (n * 95) // 100)], 3),
                 "p99_ms": round(s[min(n - 1, (n * 99) // 100)], 3),
                 "samples": n}
 

@@ -15,5 +15,6 @@ device reads (state bytes, emitted counters) happen at COLLECTION time
 pytree transfer under the app barrier. BASIC-level metrics therefore
 cost nothing per chunk.
 """
+from .costmodel import CostProfiler, load_costs  # noqa: F401
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
 from .tracing import ChunkTracer, maybe_span  # noqa: F401

@@ -81,18 +81,21 @@ class Gauge:
 
 
 class Histogram:
-    """Bounded-reservoir summary (avg / p50 / p99 / count), the same
-    windowed model as core/stats.LatencyTracker. Exposed in Prometheus
-    summary format (pre-computed quantiles, not cumulative buckets)."""
+    """Bounded-reservoir summary (avg / p50 / p95 / p99, plus CUMULATIVE
+    count and sum), the same windowed model as core/stats.LatencyTracker.
+    Exposed in Prometheus summary format: pre-computed quantiles over the
+    reservoir window, with ``_count``/``_sum`` monotonic so scrapers can
+    ``rate()`` them."""
 
     CAP = 4096
 
-    __slots__ = ("name", "_samples", "_count", "_lock")
+    __slots__ = ("name", "_samples", "_count", "_sum", "_lock")
 
     def __init__(self, name: str):
         self.name = name
         self._samples: list[float] = []
         self._count = 0
+        self._sum = 0.0
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -101,6 +104,7 @@ class Histogram:
                 del self._samples[: self.CAP // 2]
             self._samples.append(float(value))
             self._count += 1
+            self._sum += float(value)
 
     def summary(self) -> Optional[dict]:
         with self._lock:
@@ -108,11 +112,14 @@ class Histogram:
                 return None
             s = sorted(self._samples)
             count = self._count
+            total = self._sum
         n = len(s)
         return {"avg": round(sum(s) / n, 3),
                 "p50": round(s[n // 2], 3),
+                "p95": round(s[min(n - 1, (n * 95) // 100)], 3),
                 "p99": round(s[min(n - 1, (n * 99) // 100)], 3),
-                "count": count}
+                "count": count,
+                "sum": round(total, 3)}
 
 
 class MetricsRegistry:
@@ -122,7 +129,9 @@ class MetricsRegistry:
     ``collect()``."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # RLock: collection walks hold it end to end while instruments
+        # created inside the walk re-enter _get
+        self._lock = threading.RLock()
         self._metrics: dict[str, object] = {}
         self._collectors: list[Callable[[], dict]] = []
 
@@ -159,17 +168,27 @@ class MetricsRegistry:
     def collect(self) -> dict:
         """Run every collector, fold the results into gauges, and return
         a flat JSON-serializable ``{dotted_name: number}`` snapshot
-        (histograms flatten to ``<name>.avg/.p50/.p99/.count``)."""
+        (histograms flatten to ``<name>.avg/.p50/.p95/.p99/.count/.sum``).
+
+        Thread model: collector callables run OUTSIDE the registry lock
+        (they take the app barrier — holding both here would deadlock
+        against dispatch threads that record histograms under the
+        barrier), then the fold + instrument walk happens in ONE lock
+        acquisition so a concurrent deploy registering collectors or
+        creating instruments can never interleave a half-folded
+        scrape."""
         with self._lock:
             collectors = list(self._collectors)
+        updates: dict = {}
         for fn in collectors:
             try:
-                for name, value in (fn() or {}).items():
-                    self.gauge(name).set(value)
+                updates.update(fn() or {})
             except Exception:  # noqa: BLE001 — one broken collector must
                 continue  # not take down the scrape
         out: dict = {}
-        with self._lock:
+        with self._lock:  # the full registry walk is atomic
+            for name, value in updates.items():
+                self.gauge(name).set(value)
             metrics = list(self._metrics.values())
         for m in metrics:
             if isinstance(m, Histogram):
@@ -187,8 +206,9 @@ class MetricsRegistry:
     # -- exposition ------------------------------------------------------
     def prometheus_text(self) -> str:
         """Prometheus text exposition (version 0.0.4). Counters and
-        gauges one sample each; histograms as summaries
-        (``{quantile="..."}`` samples + ``_count``)."""
+        gauges one sample each; histograms as summaries (quantile
+        samples plus cumulative ``_sum``/``_count`` so scrapers can
+        ``rate()`` them)."""
         ts_ms = int(time.time() * 1000)
         lines: list[str] = []
         # refresh collector-backed gauges first
@@ -206,7 +226,9 @@ class MetricsRegistry:
                     continue
                 lines.append(f"# TYPE {name} summary")
                 lines.append(f'{name}{{quantile="0.5"}} {s["p50"]}')
+                lines.append(f'{name}{{quantile="0.95"}} {s["p95"]}')
                 lines.append(f'{name}{{quantile="0.99"}} {s["p99"]}')
+                lines.append(f"{name}_sum {s['sum']}")
                 lines.append(f"{name}_count {s['count']}")
             else:
                 v = m.value

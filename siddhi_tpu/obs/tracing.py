@@ -97,16 +97,26 @@ class ChunkTracer:
             return list(self._events)
 
     # -- export ----------------------------------------------------------
-    def export(self, path: str) -> str:
+    def export(self, path: str, annotations=None) -> str:
         """Write buffered spans as Chrome ``trace_event`` JSON ('X'
-        complete events, microsecond timestamps); returns ``path``."""
+        complete events, microsecond timestamps); returns ``path``.
+
+        Events are sorted by ``ts`` before writing — the ring buffer
+        holds completion order, and Chrome/Perfetto only nest 'X' spans
+        correctly from start-time-ordered input (an enclosing span
+        completes AFTER its children, so buffer order is exactly
+        wrong). ``annotations`` maps span names to extra ``args``
+        entries — ``runtime.trace_export`` merges the cost profiler's
+        measured device-time attribution here (obs/costmodel.py)."""
+        ann = annotations or {}
+        events = sorted(self.events(), key=lambda e: e[2])
         trace = {
             "displayTimeUnit": "ms",
             "traceEvents": [
                 {"name": name, "cat": cat, "ph": "X", "ts": ts_us,
                  "dur": dur_us, "pid": os.getpid(), "tid": tid,
-                 "args": dict(args)}
-                for name, cat, ts_us, dur_us, tid, args in self.events()
+                 "args": {**dict(args), **ann.get(name, {})}}
+                for name, cat, ts_us, dur_us, tid, args in events
             ],
         }
         with open(path, "w") as f:

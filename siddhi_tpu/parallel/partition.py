@@ -479,6 +479,9 @@ class PartitionBlockRuntime:
         self._run(("stream", stream_id), batch, timestamp, now)
 
     def _run(self, trigger, batch, timestamp, now=None):
+        cost = getattr(self.app, "cost", None)
+        probe = cost.probe("partition", self.name) \
+            if cost is not None and cost.enabled else None
         with maybe_span(self.app, "partition", self.name,
                         trigger=str(trigger)):
             if now is None:
@@ -490,6 +493,10 @@ class PartitionBlockRuntime:
                  flat_outs, dues) = step(self.slot_tbl, self.qstates,
                                          self._emitted, self._lost, batch,
                                          now_dev)
+            if probe is not None:
+                # sampled branch only: the sync serializes the pipeline
+                jax.block_until_ready(flat_outs)
+                probe.done(rows=int(batch.capacity))
             for qn, out in flat_outs.items():
                 self._dispatch(qn, out, timestamp)
             if dues:

@@ -31,7 +31,27 @@ def fine_record_host_counts(registry, chunks):
     registry.set("siddhi.app.stream.S.events", n)
 
 
+def time_every_step_in_loop(profiler, chunks, step):
+    # line 39: unconditional block_until_ready per chunk — the timing
+    # antipattern the sampled cost profiler exists to avoid
+    for c in chunks:
+        out = step(c)
+        jax.block_until_ready(out)
+        profiler.record(("query", "q"), 0.0, len(c))
+
+
 def fine_collect_once(registry, emitted_dev, states):
     # ONE batched pytree transfer at scrape time, outside any loop
     host = jax.device_get({"emitted": emitted_dev, "states": states})
     registry.set("siddhi.app.query.q.emitted", int(host["emitted"]))
+
+
+def fine_sampled_probe(app, step, chunk):
+    # the blessed timing pattern (obs/costmodel.py): the dispatch site
+    # is not a loop, and the sync lives on the SAMPLED branch only —
+    # probe() returns None for all but every Nth chunk per step
+    probe = app.cost.probe("query", "q") if app.cost.enabled else None
+    out = step(chunk)
+    if probe is not None:
+        jax.block_until_ready(out)
+        probe.done(rows=len(chunk))

@@ -24,10 +24,11 @@ def _run_config(name: str) -> dict:
         JAX_PLATFORMS="cpu",
         SIDDHI_BENCH_SCALE="0.008",   # ~8k events: smoke, not a benchmark
         SIDDHI_BENCH_REPS="1",
+        SIDDHI_BENCH_FRONTIER_ITERS="8",   # frontier smoke, not a curve
     )
     proc = subprocess.run(
         [sys.executable, BENCH, "--quick", name],
-        capture_output=True, text=True, env=env, timeout=240)
+        capture_output=True, text=True, env=env, timeout=300)
     assert proc.returncode == 0, \
         f"bench.py {name} rc={proc.returncode}\n{proc.stderr[-2000:]}"
     lines = [ln for ln in proc.stdout.splitlines() if ln.startswith("{")]
@@ -63,3 +64,49 @@ def test_bench_chain3_quick_parses_fused_vs_unfused():
     assert d["compile_ms"] > 0 and d["ttfr_ms"] > 0
     assert isinstance(d["metrics"], dict)
     assert any(k.startswith("siddhi.") for k in d["metrics"])
+    # cost attribution of the fused run: ONE chain center, members named
+    _assert_breakdown(d, top_kind="chain")
+
+
+def _assert_breakdown(d: dict, top_kind=None):
+    """Per-config `stage_breakdown` (obs/costmodel.py cost_report shape):
+    ranked steps whose shares sum to ~100."""
+    sb = d["stage_breakdown"]
+    assert "error" not in sb, sb
+    assert sb["steps"], "no cost centers measured"
+    assert abs(sum(s["share_pct"] for s in sb["steps"]) - 100.0) < 1.0
+    assert sb["bottleneck"]["step"] == sb["steps"][0]["step"]
+    if top_kind is not None:
+        assert sb["steps"][0]["kind"] == top_kind, sb["steps"]
+
+
+def _assert_frontier(d: dict):
+    """The recorded latency/throughput frontier (ROADMAP item 3's
+    acceptance artifact): one row per chunk size with events/s and
+    p50/p95/p99 latency."""
+    fr = d["frontier"]
+    assert [row["chunk"] for row in fr] == [64, 256, 1024]
+    for row in fr:
+        assert "error" not in row, row
+        assert row["events_per_s"] > 0
+        assert row["p99_ms"] >= row["p95_ms"] >= row["p50_ms"] > 0
+
+
+def test_bench_seq5_quick_parses_frontier_and_breakdown():
+    d = _run_config("seq5")
+    assert d["unit"] == "events/s"
+    assert d["value"] > 0
+    assert d["p99_ms"] > 0 and d["p99_ms_1k"] > 0
+    _assert_frontier(d)
+    _assert_breakdown(d, top_kind="pattern")
+
+
+def test_bench_join_quick_parses_frontier_and_breakdown():
+    d = _run_config("join")
+    assert d["unit"] == "events/s"
+    assert d["value"] > 0
+    assert d["pairs_dropped"] == 0
+    _assert_frontier(d)
+    # the join [B,W] grid side steps must top the join config's ranking
+    _assert_breakdown(d, top_kind="join")
+    assert d["stage_breakdown"]["steps"][0]["step"].startswith("join/q.")

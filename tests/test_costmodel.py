@@ -1,0 +1,428 @@
+"""Pipeline cost profiler (obs/costmodel.py, docs/observability.md):
+
+- sampled synchronous step timing: per-query / fused-chain / join-side /
+  pattern-step / partition-block cost centers
+- cost_report() ranking: shares sum to ~100%, join [B,W] grid tops the
+  join workload, bottleneck verdict
+- registry step_ms histograms + statistics()['cost'] view
+- default-OFF contract (zero samples, zero step_ms metrics) and the
+  <=5% wall-overhead bound at the default stride (the PR 6 BASIC bound,
+  applied to profiling ON)
+- persisted cost table (costs.json merge-on-write) for the DAG optimizer
+- Chrome trace export carries measured cost annotations
+- tools/profile_report.py end to end (--config join ranks the grid top)
+"""
+import json
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.types import GLOBAL_STRINGS
+
+TS0 = 1_700_000_000_000
+
+FILTER_JOIN_APP = """
+    @app:playback
+    define stream StockStream (symbol string, price float);
+    define stream TwitterStream (symbol string, tweets int);
+    @info(name = 'qf')
+    from StockStream[price > 0.0] select symbol, price insert into FOut;
+    @info(name = 'qj') @cap(window.size='1024', join.pairs='65536')
+    from StockStream#window.time(1 sec)
+    join TwitterStream#window.time(1 sec)
+    on StockStream.symbol == TwitterStream.symbol
+    select StockStream.symbol, price, tweets
+    insert into JOut;
+"""
+
+CHAIN_APP = """
+    @app:playback
+    define stream S (v int);
+    @info(name = 'q1') from S[v > 0] select v insert into M;
+    @info(name = 'q2') from S[v < 1000000] select v insert into Out2;
+"""
+
+
+def _start(ql):
+    rt = SiddhiManager().create_siddhi_app_runtime(ql)
+    rt.start()
+    return rt
+
+
+def _send_join_traffic(rt, n=1024, chunks=4, n_syms=64, seed=0):
+    hs = rt.get_input_handler("StockStream")
+    ht = rt.get_input_handler("TwitterStream")
+    rng = np.random.default_rng(seed)
+    syms = np.array([GLOBAL_STRINGS.encode(f"S{i}") for i in
+                     range(n_syms)], np.int32)
+    for i in range(chunks):
+        ts = TS0 + np.arange(n, dtype=np.int64) + i * n
+        sym = syms[rng.integers(0, n_syms, n)]
+        hs.send_arrays(ts, [sym,
+                            rng.uniform(0, 200, n).astype(np.float32)])
+        ht.send_arrays(ts, [sym,
+                            rng.integers(0, 50, n).astype(np.int32)])
+
+
+# ---------------------------------------------------------------------------
+# default-OFF contract
+# ---------------------------------------------------------------------------
+
+
+class TestDefaultOff:
+    def test_no_samples_and_no_step_ms_metrics_without_cost_start(self):
+        rt = _start(FILTER_JOIN_APP)
+        _send_join_traffic(rt, n=256, chunks=2)
+        assert rt.cost.samples == 0
+        report = rt.cost_report()
+        assert report["steps"] == []
+        assert report["total_ms"] == 0
+        assert "bottleneck" not in report
+        flat = rt.metrics.collect()
+        assert not any("step_ms" in k for k in flat)
+        assert "cost" not in rt.statistics()
+        rt.shutdown()
+
+    def test_stop_disables_further_sampling(self):
+        rt = _start(CHAIN_APP)
+        rt.cost_start(every=1)
+        h = rt.get_input_handler("S")
+        h.send_arrays(TS0 + np.arange(64, dtype=np.int64),
+                      [np.ones(64, np.int32)])
+        n = rt.cost.samples
+        assert n > 0
+        rt.cost_stop()
+        h.send_arrays(TS0 + 64 + np.arange(64, dtype=np.int64),
+                      [np.ones(64, np.int32)])
+        assert rt.cost.samples == n
+        rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# attribution + ranking
+# ---------------------------------------------------------------------------
+
+
+class TestCostReport:
+    def test_shares_sum_to_100_ranked_and_join_grid_tops(self):
+        """The acceptance shape: on a join workload the [B,W] grid side
+        steps are the top cost center, shares sum to ~100%, and the
+        ranking is descending by measured wall ms."""
+        rt = _start(FILTER_JOIN_APP)
+        _send_join_traffic(rt, n=1024, chunks=1)   # warm compiles
+        rt.cost_start(every=1)
+        _send_join_traffic(rt, n=1024, chunks=4, seed=1)
+        report = rt.cost_report()
+        rt.shutdown()
+        steps = report["steps"]
+        names = {s["step"] for s in steps}
+        assert {"join/qj.left", "join/qj.right", "query/qf"} <= names
+        # ranked descending, shares sum to ~100
+        totals = [s["ms_total"] for s in steps]
+        assert totals == sorted(totals, reverse=True)
+        assert sum(s["share_pct"] for s in steps) == \
+            pytest.approx(100.0, abs=0.5)
+        # the join grid dominates the trivial filter
+        assert steps[0]["kind"] == "join"
+        assert report["bottleneck"]["step"].startswith("join/qj.")
+        assert report["bottleneck"]["step"] in \
+            report["bottleneck"]["verdict"]
+        for s in steps:
+            assert s["samples"] > 0
+            assert s["ms_per_event"] >= 0
+            assert s["p99_ms"] >= s["p95_ms"] >= s["p50_ms"] >= 0
+
+    def test_fused_chain_is_one_center_with_members(self):
+        rt = _start(CHAIN_APP)
+        # q1 -> M has one subscriber? CHAIN_APP's q2 reads S, so both
+        # queries dispatch separately: use per-query centers here
+        rt.cost_start(every=1)
+        h = rt.get_input_handler("S")
+        h.send_arrays(TS0 + np.arange(128, dtype=np.int64),
+                      [np.arange(1, 129, dtype=np.int32)])
+        report = rt.cost_report()
+        names = {s["step"] for s in report["steps"]}
+        assert {"query/q1", "query/q2"} <= names
+        rt.shutdown()
+        # and the fused variant: one chain center naming its members
+        rt2 = _start("""
+            @app:playback
+            define stream S (v int);
+            @info(name = 'q1') from S[v > 0] select v insert into M;
+            @info(name = 'q2') from M[v < 9] select v insert into Out;
+        """)
+        assert rt2.queries["q1"]._fused_chain is not None
+        rt2.cost_start(every=1)
+        h2 = rt2.get_input_handler("S")
+        h2.send_arrays(TS0 + np.arange(128, dtype=np.int64),
+                       [np.arange(1, 129, dtype=np.int32)])
+        report2 = rt2.cost_report()
+        rt2.shutdown()
+        chain = [s for s in report2["steps"] if s["kind"] == "chain"]
+        assert len(chain) == 1
+        assert chain[0]["step"] == "chain/q1+q2"
+        assert chain[0]["members"] == ["q1", "q2"]
+
+    def test_pattern_and_partition_centers(self):
+        rt = _start("""
+            @app:playback
+            define stream T (sym string, stage int);
+            @info(name = 'qp')
+            from every e1=T[stage == 1]
+              -> e2=T[stage == 2 and sym == e1.sym] within 10 sec
+            select e1.sym as sym insert into POut;
+            partition with (sym of T) begin
+              @info(name = 'pq')
+              from T select sym, count() as c insert into PC;
+            end;
+        """)
+        rt.cost_start(every=1)
+        h = rt.get_input_handler("T")
+        rng = np.random.default_rng(3)
+        syms = np.array([GLOBAL_STRINGS.encode(f"K{i}") for i in
+                         range(8)], np.int32)
+        for i in range(2):
+            ts = TS0 + np.arange(256, dtype=np.int64) + i * 256
+            h.send_arrays(ts, [syms[rng.integers(0, 8, 256)],
+                               rng.integers(1, 3, 256).astype(np.int32)])
+        report = rt.cost_report()
+        rt.shutdown()
+        names = {s["step"] for s in report["steps"]}
+        assert "pattern/qp.T" in names
+        assert any(n.startswith("partition/") for n in names)
+
+    def test_sampling_stride(self):
+        """every=4 over 8 chunks -> exactly 2 samples per center (the
+        first chunk always samples, then every 4th)."""
+        rt = _start(CHAIN_APP)
+        rt.cost_start(every=4)
+        h = rt.get_input_handler("S")
+        for i in range(8):
+            h.send_arrays(TS0 + np.arange(64, dtype=np.int64) + i * 64,
+                          [np.ones(64, np.int32)])
+        report = rt.cost_report()
+        rt.shutdown()
+        for s in report["steps"]:
+            assert s["samples"] == 2, s
+
+    def test_registry_histograms_and_statistics_view(self):
+        rt = _start(CHAIN_APP)
+        rt.cost_start(every=1)
+        h = rt.get_input_handler("S")
+        h.send_arrays(TS0 + np.arange(64, dtype=np.int64),
+                      [np.ones(64, np.int32)])
+        flat = rt.metrics.collect()
+        base = f"siddhi.{rt.name}.query.q1.step_ms"
+        for suffix in (".p50", ".p95", ".p99", ".count", ".sum"):
+            assert base + suffix in flat, base + suffix
+        stats = rt.statistics()
+        assert stats["cost"]["steps"], "cost view missing"
+        assert stats["cost"]["bottleneck"]["step"].startswith("query/")
+        rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# persistence
+# ---------------------------------------------------------------------------
+
+
+class TestCostPersistence:
+    def test_save_merges_and_load_roundtrips(self, tmp_path):
+        from siddhi_tpu.obs.costmodel import load_costs
+        path = str(tmp_path / "costs.json")
+        rt = _start(CHAIN_APP)
+        rt.cost_start(every=1)
+        h = rt.get_input_handler("S")
+        h.send_arrays(TS0 + np.arange(64, dtype=np.int64),
+                      [np.ones(64, np.int32)])
+        assert rt.cost_save(path) == path
+        table = load_costs(path)
+        assert "query/q1" in table[rt.name]
+        entry = table[rt.name]["query/q1"]
+        assert entry["samples"] > 0 and entry["ms_per_event"] >= 0
+        # second save merges (same app key, centers updated not lost)
+        h.send_arrays(TS0 + 64 + np.arange(64, dtype=np.int64),
+                      [np.ones(64, np.int32)])
+        rt.cost_save(path)
+        table2 = load_costs(path)
+        assert table2[rt.name]["query/q1"]["samples"] >= entry["samples"]
+        rt.shutdown()
+
+    def test_load_missing_and_corrupt_read_as_empty(self, tmp_path):
+        from siddhi_tpu.obs.costmodel import load_costs
+        assert load_costs(str(tmp_path / "nope.json")) == {}
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        assert load_costs(str(bad)) == {}
+
+
+# ---------------------------------------------------------------------------
+# trace annotations
+# ---------------------------------------------------------------------------
+
+
+def test_trace_export_carries_cost_annotations(tmp_path):
+    rt = _start(CHAIN_APP)
+    rt.cost_start(every=1)
+    rt.trace_start()
+    h = rt.get_input_handler("S")
+    h.send_arrays(TS0 + np.arange(64, dtype=np.int64),
+                  [np.ones(64, np.int32)])
+    path = rt.trace_export(str(tmp_path / "trace.json"))
+    rt.shutdown()
+    events = json.load(open(path))["traceEvents"]
+    steps = [e for e in events if e["name"] == "step/q1"]
+    assert steps, "no step spans recorded"
+    assert steps[0]["args"]["cost_ms_total"] >= 0
+    assert steps[0]["args"]["cost_samples"] >= 1
+    assert "cost_ms_per_event" in steps[0]["args"]
+
+
+# ---------------------------------------------------------------------------
+# overhead bound (the PR 6 BASIC bound, applied to profiling ON)
+# ---------------------------------------------------------------------------
+
+
+def test_cost_profiling_overhead_under_5pct_on_filter_shape():
+    """Profiling ON at the default stride must stay within <=5% wall
+    time of profiling OFF on the filter microbench shape — the sampled
+    sync may serialize at most 1-in-SIDDHI_TPU_COST_EVERY chunks. Same
+    alternating min-of-N structure as the PR 6 BASIC bound."""
+    import jax
+    rt = _start("""
+        @app:playback
+        define stream S (sym string, price float, volume long);
+        @info(name = 'q')
+        from S[price > 100.0] select sym, price insert into Out;
+    """)
+    last = [None]
+    rt.queries["q"].batch_callbacks.append(
+        lambda out: last.__setitem__(0, out))
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(7)
+    chunk, chunks = 65_536, 8
+    syms = np.array([GLOBAL_STRINGS.encode(s)
+                     for s in ("A", "B", "C", "D")], np.int32)
+    clock = [TS0]
+
+    def run():
+        for _ in range(chunks):
+            ts = clock[0] + np.arange(chunk, dtype=np.int64)
+            clock[0] += chunk
+            h.send_arrays(ts, [syms[rng.integers(0, 4, chunk)],
+                               rng.uniform(0, 200, chunk)
+                               .astype(np.float32),
+                               rng.integers(1, 1000, chunk,
+                                            dtype=np.int64)])
+        jax.block_until_ready(last[0].valid)
+
+    run()  # warm every step/encoding before timing
+    reps = 5
+    t_off, t_on = float("inf"), float("inf")
+    for _ in range(reps):
+        rt.cost_stop()
+        t0 = time.perf_counter()
+        run()
+        t_off = min(t_off, time.perf_counter() - t0)
+        rt.cost.enabled = True      # keep accumulated counters: the
+        t0 = time.perf_counter()    # steady-state stride, not the
+        run()                       # first-chunk-always resample
+        t_on = min(t_on, time.perf_counter() - t0)
+    rt.shutdown()
+    assert rt.cost.every == 64      # the documented default stride
+    # 10 ms absolute floor absorbs scheduler jitter on sub-100ms runs
+    assert t_on <= t_off * 1.05 + 0.010, (t_off, t_on)
+
+
+# ---------------------------------------------------------------------------
+# compile-cache key stability: profiling changes no jit options
+# ---------------------------------------------------------------------------
+
+
+def test_profiling_triggers_zero_new_compiles(monkeypatch):
+    """cost_start() must not change any jit option: the steps compiled
+    before profiling serve identically after (cache-key stability rule,
+    docs/compile_cache.md)."""
+    import jax
+    real_jit = jax.jit
+    count = [0]
+
+    def counting_jit(*a, **kw):
+        count[0] += 1
+        return real_jit(*a, **kw)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+    rt = _start(CHAIN_APP)
+    h = rt.get_input_handler("S")
+    h.send_arrays(TS0 + np.arange(64, dtype=np.int64),
+                  [np.ones(64, np.int32)])
+    before = count[0]
+    rt.cost_start(every=1)
+    h.send_arrays(TS0 + 64 + np.arange(64, dtype=np.int64),
+                  [np.ones(64, np.int32)])
+    assert rt.cost.samples > 0
+    assert count[0] == before, "profiling built new jit wrappers"
+    rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# tools/profile_report.py
+# ---------------------------------------------------------------------------
+
+
+class TestProfileReportTool:
+    def _main(self, argv, capsys):
+        import os
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import profile_report
+        rc = profile_report.main(argv)
+        return rc, capsys.readouterr().out
+
+    def test_config_join_ranks_grid_top_json(self, capsys):
+        rc, out = self._main(["--config", "join", "--events", "2048",
+                              "--chunk", "1024", "--json", "--no-save"],
+                             capsys)
+        assert rc == 0
+        report = json.loads(out)
+        assert report["steps"], "no cost centers measured"
+        # the acceptance criterion: the join [B,W] grid step ranks top
+        assert report["steps"][0]["kind"] == "join"
+        assert report["bottleneck"]["step"].startswith("join/q.")
+        assert sum(s["share_pct"] for s in report["steps"]) == \
+            pytest.approx(100.0, abs=0.5)
+        assert report["saved"] is None   # --no-save honored
+
+    def test_config_filter_human_report(self, capsys, tmp_path,
+                                        monkeypatch):
+        monkeypatch.setenv("SIDDHI_TPU_CACHE_DIR", str(tmp_path))
+        rc, out = self._main(["--config", "filter", "--events", "1024",
+                              "--chunk", "512"], capsys)
+        assert rc == 0
+        assert "pipeline cost report" in out
+        assert "query/q" in out
+        assert "bottleneck:" in out
+        # the persisted table landed next to the compile cache
+        from siddhi_tpu.obs.costmodel import load_costs
+        table = load_costs(str(tmp_path / "costs.json"))
+        assert any("query/q" in centers for centers in table.values())
+
+    def test_app_file_mode(self, capsys, tmp_path):
+        app = tmp_path / "probe.siddhi"
+        app.write_text("""
+            @app:name('cost_probe')
+            @app:playback
+            define stream S (v int);
+            @info(name = 'q') from S[v > 0] select v insert into Out;
+        """)
+        rc, out = self._main([str(app), "--events", "512", "--chunk",
+                              "256", "--json", "--no-save"], capsys)
+        assert rc == 0
+        report = json.loads(out)
+        assert report["app"] == "cost_probe"
+        assert any(s["step"] == "query/q" for s in report["steps"])

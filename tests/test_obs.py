@@ -91,6 +91,86 @@ class TestRegistry:
         assert 'siddhi_app_1_lat{quantile="0.5"} 5.0' in text
         assert "siddhi_app_1_lat_count 1" in text
 
+    def test_histogram_p95_and_prometheus_summary_conventions(self):
+        """Summaries expose p50/p95/p99 quantile samples PLUS cumulative
+        _sum/_count (proper Prometheus summary conventions, so scrapers
+        can rate() them)."""
+        m = MetricsRegistry()
+        h = m.histogram("siddhi.a.step_ms")
+        for v in range(1, 101):
+            h.observe(float(v))
+        s = h.summary()
+        assert s["p50"] == 51.0
+        assert s["p95"] == 96.0
+        assert s["p99"] == 100.0
+        assert s["count"] == 100
+        assert s["sum"] == 5050.0
+        snap = m.collect()
+        assert snap["siddhi.a.step_ms.p95"] == 96.0
+        assert snap["siddhi.a.step_ms.sum"] == 5050.0
+        text = m.prometheus_text()
+        assert 'siddhi_a_step_ms{quantile="0.5"} 51.0' in text
+        assert 'siddhi_a_step_ms{quantile="0.95"} 96.0' in text
+        assert 'siddhi_a_step_ms{quantile="0.99"} 100.0' in text
+        assert "siddhi_a_step_ms_sum 5050.0" in text
+        assert "siddhi_a_step_ms_count 100" in text
+
+    def test_histogram_count_sum_cumulative_across_reservoir_wrap(self):
+        """_count/_sum are monotonic even after the bounded reservoir
+        drops old samples — the rate() contract."""
+        m = MetricsRegistry()
+        h = m.histogram("siddhi.a.lat")
+        old_cap = Histogram.CAP
+        Histogram.CAP = 8           # force reservoir churn (slots class)
+        try:
+            for v in range(100):
+                h.observe(1.0)
+        finally:
+            Histogram.CAP = old_cap
+        s = h.summary()
+        assert s["count"] == 100
+        assert s["sum"] == 100.0
+
+    def test_collect_safe_against_concurrent_registration(self):
+        """Regression (ISSUE 7): a /metrics scrape walking the registry
+        while another thread deploys an app (registering collectors and
+        creating instruments) must neither crash nor return a torn
+        snapshot. Hammer both concurrently."""
+        m = MetricsRegistry()
+        m.counter("siddhi.base.events").inc(1)
+        stop = threading.Event()
+        errors = []
+
+        def deployer():
+            # bounded: each registered collector runs on EVERY later
+            # collect(), so an unbounded register loop would make the
+            # scrape side quadratically slow
+            for i in range(150):
+                if stop.is_set():
+                    return
+                name = f"siddhi.app{i % 31}.q.depth"
+                m.register_collector(lambda name=name: {name: 1})
+                m.histogram(f"siddhi.app{i % 17}.lat").observe(1.0)
+
+        threads = [threading.Thread(target=deployer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(100):
+                try:
+                    snap = m.collect()
+                    text = m.prometheus_text()
+                except Exception as e:  # noqa: BLE001 — the regression
+                    errors.append(e)
+                    break
+                assert snap["siddhi.base.events"] == 1
+                assert "siddhi_base_events 1" in text
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+
     def test_prom_name_sanitization(self):
         assert prom_name("siddhi.my app.q-1.latency") == \
             "siddhi_my_app_q_1_latency"
@@ -478,6 +558,25 @@ class TestServiceEndpoints:
         assert rt.statistics()["compile"]["programs"] > 0
         svc.stop()
 
+    def test_metrics_dump_wait_ready_with_background_warmup(
+            self, monkeypatch, capsys):
+        """tools/metrics_dump.py --wait-ready polls /ready before
+        scraping, so the CI smoke probe can't race a background
+        SIDDHI_TPU_WARM_BUCKETS warmup (deploy returns while the AOT
+        compiles are still in flight)."""
+        import os
+        import sys
+        monkeypatch.setenv("SIDDHI_TPU_WARM_BUCKETS", "16")
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import metrics_dump
+        rc = metrics_dump.main(["--wait-ready", "--events", "64"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "siddhi_metrics_probe_app_ready 1" in out
+
     def test_health_unauthenticated_metrics_authenticated(self):
         from siddhi_tpu.core.service import SiddhiService
         svc = SiddhiService(auth_token="s3cret")
@@ -518,6 +617,37 @@ class TestTracing:
             assert e["ph"] == "X"
             assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
             assert e["dur"] >= 0
+
+    def test_trace_export_sorted_by_ts(self, tmp_path):
+        """The ring buffer holds spans in COMPLETION order — an
+        enclosing span (ingest) completes after its children (step), so
+        buffer order is start-time-reversed for nests and
+        Chrome/Perfetto renders them wrong. Export must sort by ts."""
+        from siddhi_tpu.obs.tracing import ChunkTracer
+        tracer = ChunkTracer()
+        tracer.start()
+        # completion order: child first, parent (earlier ts) second —
+        # exactly what nested `with` spans produce
+        tracer.record("step/q", "step", ts_us=2000, dur_us=10, args={})
+        tracer.record("ingest/S", "ingest", ts_us=1000, dur_us=1500,
+                      args={})
+        tracer.record("sink/out", "sink", ts_us=3000, dur_us=5, args={})
+        path = tracer.export(str(tmp_path / "t.json"))
+        events = json.load(open(path))["traceEvents"]
+        ts = [e["ts"] for e in events]
+        assert ts == sorted(ts)
+        assert [e["name"] for e in events] == \
+            ["ingest/S", "step/q", "sink/out"]
+
+    def test_runtime_trace_export_is_ts_ordered(self, tmp_path):
+        rt = _playback_app(CHAIN_APP)
+        rt.trace_start()
+        _send_ramp(rt, "S", 256)
+        _send_ramp(rt, "S", 256, base=TS0 + 256)
+        path = rt.trace_export(str(tmp_path / "t.json"))
+        rt.shutdown()
+        ts = [e["ts"] for e in json.load(open(path))["traceEvents"]]
+        assert ts and ts == sorted(ts)
 
     def test_tracer_disabled_by_default(self):
         rt = _playback_app(CHAIN_APP)

@@ -4,6 +4,7 @@ observability layer (docs/observability.md).
     python tools/metrics_dump.py                 # built-in demo app
     python tools/metrics_dump.py app.siddhi      # your app, no traffic
     python tools/metrics_dump.py --events 0      # skip synthetic traffic
+    python tools/metrics_dump.py --wait-ready    # poll /ready first
 
 Spins up a loopback SiddhiService, deploys the app, optionally pushes a
 few synthetic events into its first defined stream (int/long/float
@@ -53,6 +54,29 @@ def _synthetic_traffic(rt, n: int) -> bool:
     return False
 
 
+def _wait_ready(port: int, timeout_s: float) -> bool:
+    """Poll GET /ready until 200 (or the deadline): with
+    SIDDHI_TPU_WARM_BUCKETS set, deploy returns while the AOT warmup is
+    still compiling in the background, and a scrape racing it reads an
+    app that is not serving yet."""
+    import time
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/ready", timeout=5) as r:
+                if r.status == 200:
+                    return True
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                raise
+        except OSError:
+            pass
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.05)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("app", nargs="?", help="path to a .siddhi app file "
@@ -60,6 +84,12 @@ def main(argv=None) -> int:
     ap.add_argument("--events", type=int, default=256,
                     help="synthetic events to push before the scrape "
                     "(0 = none)")
+    ap.add_argument("--wait-ready", action="store_true",
+                    help="poll GET /ready until 200 before scraping "
+                    "(don't race a background SIDDHI_TPU_WARM_BUCKETS "
+                    "warmup)")
+    ap.add_argument("--ready-timeout", type=float, default=120.0,
+                    help="--wait-ready deadline in seconds")
     args = ap.parse_args(argv)
 
     from siddhi_tpu.core.service import SiddhiService
@@ -68,6 +98,11 @@ def main(argv=None) -> int:
     svc.start()
     try:
         name = svc.deploy(ql)
+        if args.wait_ready and not _wait_ready(svc.port,
+                                               args.ready_timeout):
+            sys.stderr.write("metrics_dump: /ready never returned 200 "
+                             f"within {args.ready_timeout}s\n")
+            return 1
         rt = svc._deployed[name]
         if args.events > 0:
             _synthetic_traffic(rt, args.events)
