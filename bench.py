@@ -64,6 +64,9 @@ ASSUMED = {
     # 3-query insert-into chain: per-hop dispatch costs put the Java
     # figure below the single-filter guess
     "chain3": 500_000.0,
+    # same workload class as `join` (single-thread Java hash-join guess
+    # is cardinality-insensitive at these sizes)
+    "join_eq": 400_000.0,
 }
 
 # ---------------------------------------------------------------------------
@@ -376,11 +379,30 @@ def bench_window_agg(n=1_000_000):
 
 
 def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int,
-              frontier: bool = False):
+              frontier: bool = False, kernel: str = None):
     """Shared join driver. Honest emission: every surviving pair is
     built and emitted (the r3 bench capped output at 1024 pairs/step,
     silently dropping >99% on the 4-symbol workload and measuring only
-    the condition grid); pairs_dropped in the result must be 0."""
+    the condition grid); pairs_dropped in the result must be 0.
+
+    kernel pins SIDDHI_TPU_JOIN_KERNEL for the app build (None = the
+    planner's auto pick — the banded probe for this equi ON condition);
+    the kernel that actually ran is recorded in the result."""
+    prev = os.environ.get("SIDDHI_TPU_JOIN_KERNEL")
+    if kernel:
+        os.environ["SIDDHI_TPU_JOIN_KERNEL"] = kernel
+    try:
+        return _run_join_inner(n_symbols, chunk, join_pairs, n_side,
+                               frontier)
+    finally:
+        if kernel:
+            if prev is None:
+                os.environ.pop("SIDDHI_TPU_JOIN_KERNEL", None)
+            else:
+                os.environ["SIDDHI_TPU_JOIN_KERNEL"] = prev
+
+
+def _run_join_inner(n_symbols, chunk, join_pairs, n_side, frontier):
     n_side = _scaled(n_side, chunk)
     mgr = SiddhiManager()
     rt = mgr.create_siddhi_app_runtime(f"""
@@ -458,21 +480,51 @@ def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int,
         cinfo["stage_breakdown"] = _stage_breakdown(
             rt, lambda: send_pair(2048))
     cinfo["metrics"] = _metrics_snapshot(rt)
+    # which kernel actually ran (grid vs banded probe) + the planner's
+    # reason — the acceptance artifact must name it
+    kernels = rt.statistics().get("compile", {}).get("join_kernels", {})
+    if kernels:
+        cinfo["join_kernel"] = kernels.get("q.left", {}).get("kernel")
+        cinfo["join_kernels"] = kernels
     rt.shutdown()
     cinfo["ttfr_ms"] = round(ttfr * 1000.0, 1)
     return dt, 2 * n_chunks * chunk, emitted, dropped, cinfo
+
+
+def _join_entry(name, n_symbols):
+    """One join bench config measured on BOTH kernels: the full replay
+    on the planner's auto pick (the banded probe for this equi ON) and
+    a quarter-length comparison pass pinned to the [B,W] grid, each
+    with its own latency/throughput frontier — the ROADMAP item 3
+    acceptance artifact records p99 vs events/s per kernel."""
+    dt, events, emitted, dropped, cinfo = _run_join(
+        n_symbols=n_symbols, chunk=8192, join_pairs=131_072,
+        n_side=131_072, frontier=True)
+    gdt, gevents, _, _, gcinfo = _run_join(
+        n_symbols=n_symbols, chunk=8192, join_pairs=131_072,
+        n_side=32_768, frontier=True, kernel="grid")
+    eps, geps = events / dt, gevents / gdt
+    return _entry(name, events, dt, extra={
+        "symbols": n_symbols, "pairs_emitted": emitted,
+        "pairs_dropped": dropped,
+        "grid_eps": round(geps, 1),
+        "probe_speedup_vs_grid": round(eps / geps, 3),
+        "frontier_grid": gcinfo.get("frontier"), **cinfo})
 
 
 def bench_join():
     """BASELINE config 3 at realistic key cardinality (1024 symbols,
     ~1 matching pair per event — what a 'join throughput' baseline guess
     plausibly describes)."""
-    dt, events, emitted, dropped, cinfo = _run_join(
-        n_symbols=1024, chunk=8192, join_pairs=131_072, n_side=131_072,
-        frontier=True)
-    return _entry("join", events, dt, extra={
-        "symbols": 1024, "pairs_emitted": emitted,
-        "pairs_dropped": dropped, **cinfo})
+    return _join_entry("join", 1024)
+
+
+def bench_join_eq():
+    """High-cardinality equi key (symbols=8192, ~0.125 expected matches
+    per event): the banded probe kernel's acceptance config — band
+    sizes stay tiny while the grid would still pay the full [B, W]
+    product, so this is the cleanest probe-vs-grid separation."""
+    return _join_entry("join_eq", 8192)
 
 
 def bench_join_fanout():
@@ -796,7 +848,7 @@ def bench_warmstart():
 # warmstart (cold-vs-warm deploy probes at 1024 rows) runs third: cheap,
 # and the cold/warm split is the PR-5 acceptance metric.
 BENCHES = ("seq5", "chain3", "warmstart", "filter", "window_agg", "seq2",
-           "kleene", "join", "join_fanout")
+           "kleene", "join", "join_eq", "join_fanout")
 
 
 def main():

@@ -309,6 +309,72 @@ def recompile_hazard(ctx: ModuleContext) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------
+# rule: quadratic-grid-hazard
+# ---------------------------------------------------------------------
+
+
+def _broadcast_axis(sl: ast.AST):
+    """Classify a subscript slice as a 2-D broadcast reshape:
+    ``[:, None]`` -> "col" ([N,1] lanes), ``[None, :]`` -> "row"
+    ([1,N] lanes), else None."""
+    if not isinstance(sl, ast.Tuple) or len(sl.elts) != 2:
+        return None
+    a, b = sl.elts
+
+    def is_none(x):
+        return isinstance(x, ast.Constant) and x.value is None
+
+    def is_full_slice(x):
+        return isinstance(x, ast.Slice) and x.lower is None \
+            and x.upper is None and x.step is None
+
+    if is_full_slice(a) and is_none(b):
+        return "col"
+    if is_none(a) and is_full_slice(b):
+        return "row"
+    return None
+
+
+@register(
+    "quadratic-grid-hazard", WARNING,
+    "an x[:, None] <op> y[None, :] broadcast materializes an [N, M] "
+    "cross-product grid — O(B*W) device work/memory per step; use the "
+    "banded searchsorted probe (ops/table.py sorted_key_view / the "
+    "ops/join.py probe kernel) unless this is the blessed grid fallback")
+def quadratic_grid_hazard(ctx: ModuleContext) -> Iterator[Finding]:
+    """Flags expressions combining a ``[:, None]`` operand with a
+    ``[None, :]`` operand — the broadcast [B, W]-style cross product
+    whose cost grows with the PRODUCT of batch and buffer sizes. The
+    intentional grid paths (the join grid fallback for non-equi ON
+    conditions, table full-scan conditions, the cap-bounded NFA pending
+    grids) are grandfathered via the checked-in baseline / inline
+    pragmas; any NEW cross product must justify itself the same way."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.BinOp, ast.Compare, ast.BoolOp)):
+            continue
+        # report the OUTERMOST expression of a grid chain once (an
+        # inner BinOp nested through a Call, e.g. jnp.abs(a - b), still
+        # belongs to its enclosing compare)
+        if any(isinstance(anc, (ast.BinOp, ast.Compare, ast.BoolOp))
+               for anc in ctx.ancestors(node)):
+            continue
+        axes = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Subscript):
+                kind = _broadcast_axis(sub.slice)
+                if kind:
+                    axes.add(kind)
+        if {"col", "row"} <= axes:
+            yield _finding(
+                "quadratic-grid-hazard", WARNING, ctx, node,
+                "broadcast cross product ([:, None] against [None, :]) "
+                "builds an [N, M] grid — quadratic in window/table "
+                "size; probe a sorted key view (two searchsorteds + "
+                "interval prefix sums) instead, or baseline/pragma the "
+                "intentional grid fallback")
+
+
+# ---------------------------------------------------------------------
 # rule: float64-literal
 # ---------------------------------------------------------------------
 

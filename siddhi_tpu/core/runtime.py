@@ -105,6 +105,49 @@ def bucket_capacity(n: int) -> int:
     return BATCH_BUCKETS[i]
 
 
+JOIN_KERNEL_ENV = "SIDDHI_TPU_JOIN_KERNEL"
+
+
+def _pick_join_kernel(app_name: str, qname: str, cross) -> tuple[str, str]:
+    """Join kernel for one JoinCross: ``(kernel, reason)``.
+
+    Policy (docs/performance.md "join kernels"): the banded searchsorted
+    probe whenever the ON condition carries an ``L == R`` equi conjunct,
+    the [B, W] broadcast grid otherwise. ``SIDDHI_TPU_JOIN_KERNEL=
+    grid|probe`` overrides (probe silently falls back to grid when no
+    equi conjunct exists). The persisted PR 7 cost table
+    (``.jax_cache/costs.json``, obs/costmodel.load_costs) is consulted:
+    when a prior profile shows this join's grid centers dominating the
+    app's measured step time, the probe pick is recorded as
+    evidence-backed rather than heuristic."""
+    env = os.environ.get(JOIN_KERNEL_ENV, "").strip().lower()
+    eligible = cross.equi is not None
+    if env == "grid":
+        return "grid", "SIDDHI_TPU_JOIN_KERNEL=grid override"
+    if env == "probe":
+        if eligible:
+            return "probe", "SIDDHI_TPU_JOIN_KERNEL=probe override"
+        return "grid", ("SIDDHI_TPU_JOIN_KERNEL=probe requested but the "
+                        "ON condition has no equi conjunct — grid "
+                        "fallback")
+    if not eligible:
+        return "grid", ("no equi conjunct in ON condition (the banded "
+                        "probe needs one)")
+    try:
+        from ..obs.costmodel import load_costs
+        tbl = load_costs().get(app_name) or {}
+    except Exception:  # noqa: BLE001 — costs are advisory
+        tbl = {}
+    if tbl:
+        key, costs = max(tbl.items(),
+                         key=lambda kv: kv[1].get("ms_total", 0.0))
+        if key.startswith(f"join/{qname}.") and "[probe]" not in key:
+            return "probe", (
+                f"cost table: grid-dominated center {key} "
+                f"({costs.get('ms_total', 0)} ms_total) — probe selected")
+    return "probe", "equi ON condition (banded searchsorted probe)"
+
+
 def _donate(*argnums):
     """donate_argnums kwargs for the state-carrying arguments of a step:
     XLA aliases the output state buffers onto the input ones, so large
@@ -1431,10 +1474,21 @@ class JoinQueryRuntime(QueryRuntime):
 
     _SIDE_NAMES = {"L": "left", "R": "right"}
 
+    def _side_center(self, side: str) -> str:
+        """Cost-center name for one side step: ``<q>.left[probe]`` —
+        the kernel suffix makes the persisted cost table name WHICH
+        join kernel was measured (the planner's cost-table consultation
+        reads it back; tools/profile_report.py asserts it)."""
+        nm = f"{self.name}.{self._SIDE_NAMES[side]}"
+        cross = self.crosses.get(side)
+        if cross is not None:
+            nm += f"[{cross.kernel}]"
+        return nm
+
     def process_side_packed(self, side: str, chunk: PackedChunk) -> None:
         opp = "R" if side == "L" else "L"
         cost = self.app.cost
-        probe = cost.probe("join", f"{self.name}.{self._SIDE_NAMES[side]}") \
+        probe = cost.probe("join", self._side_center(side)) \
             if cost.enabled else None
         self._last_now = max(self._last_now, chunk.last_ts)
         with self._lock:
@@ -1486,7 +1540,7 @@ class JoinQueryRuntime(QueryRuntime):
         if now is None:
             now = self.app.current_time()
         cost = self.app.cost
-        probe = cost.probe("join", f"{self.name}.{self._SIDE_NAMES[side]}") \
+        probe = cost.probe("join", self._side_center(side)) \
             if cost.enabled else None
         now_dev = jnp.asarray(now, dtype=jnp.int64)
         opp = "R" if side == "L" else "L"
@@ -1558,6 +1612,9 @@ class SiddhiAppRuntime:
         self.schemas: dict[str, StreamSchema] = {}
         self.input_handlers: dict[str, InputHandler] = {}
         self.queries: dict[str, QueryRuntime] = {}
+        # planner's per-join-side kernel picks: {"<q>.left": {"kernel":
+        # "grid"|"probe", "reason": ...}} — statistics()['compile']
+        self._join_kernels: dict[str, dict] = {}
         self.tables: dict[str, TableRuntime] = {}
         self.record_tables: dict = {}  # tid -> RecordTableRuntime (@Store)
         self.named_windows: dict[str, QueryRuntime] = {}
@@ -1956,12 +2013,21 @@ class SiddhiAppRuntime:
         # AOT compile telemetry (only once a warmup ran): program count,
         # compile wall ms, persistent-cache hits/misses; DETAIL level
         # adds the per-step timing list (view only)
+        comp: dict = {}
         if self.compile_service.warmups:
-            report["compile"] = self.compile_service.summary(
+            comp = self.compile_service.summary(
                 detail=self.stats_level >= 2)
             for k in ("warmups", "programs", "compile_ms", "cache_hits",
                       "cache_misses"):
-                flat[f"{p}.compile.{k}"] = report["compile"][k]
+                flat[f"{p}.compile.{k}"] = comp[k]
+        if self._join_kernels:
+            # the planner's grid-vs-probe picks per join side, with the
+            # reason (env override / equi heuristic / cost-table
+            # evidence) — docs/performance.md "join kernels"
+            comp = {**comp, "join_kernels": {
+                k: dict(v) for k, v in sorted(self._join_kernels.items())}}
+        if comp:
+            report["compile"] = comp
         # sampled per-step cost attribution (obs/costmodel.py): the
         # step_ms histograms live natively in the registry; the ranked
         # rollup rides the statistics() view like 'compile'
@@ -3013,7 +3079,7 @@ class Planner:
         (= SingleInputStreamParser.parseInputStream + SelectorParser)."""
         app = self.app
         needs_agg = selector_needs_aggregation(q.selector)
-        cap_window, _ = self._cap_annotation(q)
+        cap_window, _, _ = self._cap_annotation(q)
         operators: list[Operator] = []
         window_op: Optional[WindowOp] = None
         for h in sin.handlers:
@@ -3229,10 +3295,11 @@ class Planner:
         device buffers, so capacity is an explicit per-query dial).
         window.size: rows a time-based window retains; join.pairs: max
         joined pairs emitted per step (overflow is counted, never
-        silent)."""
+        silent); join.candidates: probe-kernel band-candidate expansion
+        capacity before residual filtering (default 4x join.pairs)."""
         ca = A.find_annotation(q.annotations, "cap")
         if ca is None:
-            return None, None
+            return None, None, None
 
         def to_int(v, key):
             if v is None:
@@ -3248,13 +3315,14 @@ class Planner:
             return n
 
         return (to_int(ca.element("window.size"), "window.size"),
-                to_int(ca.element("join.pairs"), "join.pairs"))
+                to_int(ca.element("join.pairs"), "join.pairs"),
+                to_int(ca.element("join.candidates"), "join.candidates"))
 
     def plan_join_query(self, q: A.Query, name: str) -> None:
         app = self.app
         jin: A.JoinInputStream = q.input
         out = q.output
-        cap_window, cap_pairs = self._cap_annotation(q)
+        cap_window, cap_pairs, cap_cands = self._cap_annotation(q)
         if isinstance(out, (A.InsertIntoStream, A.ReturnStream)):
             out_type = out.output_event_type
         else:
@@ -3357,12 +3425,26 @@ class Planner:
             crosses["L"] = JoinCross(True, l_schema, r_schema, jin.on,
                                      side_scope, jin.join_type,
                                      join_cap=join_cap,
-                                     opp_window_ms=_win_ms(r_ops))
+                                     opp_window_ms=_win_ms(r_ops),
+                                     cand_cap=cap_cands)
         if jin.unidirectional != "left" and not r_is_table:
             crosses["R"] = JoinCross(False, l_schema, r_schema, jin.on,
                                      side_scope, jin.join_type,
                                      join_cap=join_cap,
-                                     opp_window_ms=_win_ms(l_ops))
+                                     opp_window_ms=_win_ms(l_ops),
+                                     cand_cap=cap_cands)
+        # kernel selection (docs/performance.md "join kernels"): banded
+        # searchsorted probe for equi joins, [B,W] grid otherwise;
+        # SIDDHI_TPU_JOIN_KERNEL overrides, the PR 7 cost table backs
+        # the pick with measured evidence when present
+        for key, side_name in (("L", "left"), ("R", "right")):
+            cross = crosses[key]
+            if cross is None:
+                continue
+            kernel, reason = _pick_join_kernel(app.name, name, cross)
+            cross.kernel = kernel
+            app._join_kernels[f"{name}.{side_name}"] = {
+                "kernel": kernel, "reason": reason}
 
         sel_scope = JoinCombinedScope(side_scope, len(l_schema.types))
         if needs_agg:

@@ -22,7 +22,11 @@ an XLA program per dispatch, never finer:
                          one XLA program; per-member split needs a
                          device profile with SIDDHI_TPU_PROFILE_SCOPES=1
                          — members are listed in the report instead)
-- ``join/<q>.left|right``  one join side step (the [B,W] grid)
+- ``join/<q>.left|right[grid|probe]``  one join side step; the suffix
+                         names the kernel that ran (the [B,W] broadcast
+                         grid or the banded searchsorted probe — the
+                         planner's cost-table consultation and
+                         tools/profile_report.py read it back)
 - ``pattern/<q>.<sid>``  one NFA stream step; ``pattern/<q>.timer`` the
                          absent-deadline timer step
 - ``partition/<name>``   one K-vmapped partition block step

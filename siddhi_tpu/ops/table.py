@@ -81,8 +81,14 @@ class TableRuntime:
             tkeys = hash_columns([state["cols"][i] for i in self.pk],
                                  [state["nulls"][i] for i in self.pk])
             # match each adding row to an existing row with the same key
-            eq = (bkeys[:, None] == tkeys[None, :]) & adding[:, None] & \
-                state["valid"][None, :]
+            # primary-key upsert match: an intentional [B, T] grid —
+            # in-place replacement needs per-(event,row) hits, which the
+            # banded probe's interval trick cannot provide (same reason
+            # updates keep the grid below)
+            eq = (
+                (bkeys[:, None] == tkeys[None, :])  # lint: disable=quadratic-grid-hazard
+                & adding[:, None]
+                & state["valid"][None, :])
             hit_row = jnp.where(jnp.any(eq, axis=1),
                                 jnp.argmax(eq, axis=1), T)
             replaces = hit_row < T
@@ -252,7 +258,10 @@ class TableOutputOp(Operator):
             else:
                 grid = jnp.ones((batch.capacity, self.table.cap),
                                 jnp.bool_)
-            grid = grid & acting[:, None] & tstate["valid"][None, :]
+            # blessed full-scan fallback: conditions that defeated
+            # analyze_index_probe (non-indexed attrs, multi-attr forms)
+            grid = (
+                grid & acting[:, None] & tstate["valid"][None, :])  # lint: disable=quadratic-grid-hazard
             touched = jnp.any(grid, axis=0)  # table rows hit by any event
             if self.kind == "delete":
                 tstate = {**tstate, "valid": tstate["valid"] & ~touched}
@@ -361,6 +370,60 @@ def analyze_index_probe(on_ast, table: "TableRuntime",
     return IndexProbe(attr, op, ce)
 
 
+def sorted_key_view(keys, live):
+    """Stable key-sorted view of a buffer's key column: live rows first
+    (ascending key, ORIGINAL POSITION order within equal keys — an
+    explicit position tiebreak, not a stability assumption), dead/padded
+    rows last. Returns ``(order, sorted_keys, n_live)`` where ``order``
+    maps sorted position -> original buffer position.
+
+    Shared by the table IndexProbe and the banded equi-join probe in
+    ops/join.py (the promoted hot-path use): both answer per-event
+    probes with two searchsorteds over this view instead of a [B, T]
+    condition grid."""
+    T = keys.shape[0]
+    if jnp.issubdtype(keys.dtype, jnp.floating):
+        big = jnp.asarray(jnp.inf, keys.dtype)
+    else:
+        import numpy as _np
+        big = _np.asarray(_np.iinfo(_np.dtype(keys.dtype.name)).max,
+                          keys.dtype.name)
+    # pad-last LEXSORT (pad flag primary): a live row whose key equals the
+    # padding sentinel (dtype max / +inf) must sort BEFORE the padding so
+    # the n_live clamp cannot cut it off
+    ks = jnp.where(live, keys, big)
+    order = jnp.lexsort((jnp.arange(T, dtype=jnp.int32), ks,
+                         (~live).astype(jnp.int8)))
+    return order, ks[order], jnp.sum(live.astype(jnp.int32))
+
+
+def band_bounds(sorted_keys, n_live, values, op, act):
+    """Per-probe-value ``[lo, hi)`` positional bands over a
+    ``sorted_key_view``: the contiguous run of live rows satisfying
+    ``row_key OP value``. Inactive probes get empty bands."""
+    sk = sorted_keys
+    v = values
+    if op == "==":
+        lo = jnp.searchsorted(sk, v, side="left")
+        hi = jnp.searchsorted(sk, v, side="right")
+    elif op == "<":
+        lo = jnp.zeros_like(act, jnp.int32)
+        hi = jnp.searchsorted(sk, v, side="left")
+    elif op == "<=":
+        lo = jnp.zeros_like(act, jnp.int32)
+        hi = jnp.searchsorted(sk, v, side="right")
+    elif op == ">":
+        lo = jnp.searchsorted(sk, v, side="right")
+        hi = jnp.broadcast_to(n_live, act.shape)
+    else:  # '>='
+        lo = jnp.searchsorted(sk, v, side="left")
+        hi = jnp.broadcast_to(n_live, act.shape)
+    lo = jnp.minimum(lo.astype(jnp.int32), n_live)
+    hi = jnp.minimum(hi.astype(jnp.int32), n_live)
+    hi = jnp.where(act, hi, lo)
+    return lo, hi
+
+
 def probe_touched(table: "TableRuntime", tstate: dict, probe: IndexProbe,
                   env: dict, acting):
     """-> (touched [T] bool: rows matched by ANY acting event,
@@ -369,41 +432,13 @@ def probe_touched(table: "TableRuntime", tstate: dict, probe: IndexProbe,
     knull = tstate["nulls"][probe.attr]
     live = tstate["valid"] & ~knull
     T = table.cap
-    if jnp.issubdtype(keys.dtype, jnp.floating):
-        big = jnp.asarray(jnp.inf, keys.dtype)
-    else:
-        import numpy as _np
-        big = _np.asarray(_np.iinfo(_np.dtype(keys.dtype.name)).max,
-                          keys.dtype.name)
-    ks = jnp.where(live, keys, big)
-    # pad-last LEXSORT (pad flag primary): a live row whose key equals the
-    # padding sentinel (dtype max / +inf) must sort BEFORE the padding so
-    # the n_live clamp cannot cut it off
-    order = jnp.lexsort((ks, (~live).astype(jnp.int8)))
-    sk = ks[order]
-    n_live = jnp.sum(live.astype(jnp.int32))
+    order, sk, n_live = sorted_key_view(keys, live)
 
     vc = probe.value.fn(env)
     v = jnp.broadcast_to(vc.values, acting.shape).astype(keys.dtype)
     vnull = jnp.broadcast_to(vc.nulls, acting.shape)
     act = acting & ~vnull
-    if probe.op == "==":
-        lo = jnp.searchsorted(sk, v, side="left")
-        hi = jnp.searchsorted(sk, v, side="right")
-    elif probe.op == "<":
-        lo = jnp.zeros_like(acting, jnp.int32)
-        hi = jnp.searchsorted(sk, v, side="left")
-    elif probe.op == "<=":
-        lo = jnp.zeros_like(acting, jnp.int32)
-        hi = jnp.searchsorted(sk, v, side="right")
-    elif probe.op == ">":
-        lo = jnp.searchsorted(sk, v, side="right")
-        hi = jnp.broadcast_to(n_live, acting.shape)
-    else:  # '>='
-        lo = jnp.searchsorted(sk, v, side="left")
-        hi = jnp.broadcast_to(n_live, acting.shape)
-    lo = jnp.minimum(lo.astype(jnp.int32), n_live)
-    hi = jnp.minimum(hi.astype(jnp.int32), n_live)
+    lo, hi = band_bounds(sk, n_live, v, probe.op, act)
     any_hit = act & (hi > lo)
     # interval coverage via +1/-1 prefix sums over sorted positions
     lo_m = jnp.where(any_hit, lo, T)

@@ -33,6 +33,7 @@ state['overflow'] counts them — no silent loss.
 """
 from __future__ import annotations
 
+import os
 from typing import Optional
 
 import jax
@@ -98,16 +99,46 @@ def _rel32(seq):
     return jnp.clip(seq - smax, I32_LO, 0).astype(jnp.int32)
 
 
-def keep_newest(pool: dict, keep_mask, cap: int):
+# sort-free region compaction ("Streaming Computations with Region-Based
+# State on SIMD Architectures" — docs/performance.md): the buffer is two
+# regions, the seq-sorted base ++ the chunk's ragged arrivals, so
+# compaction is rank arithmetic (one prefix sum + one searchsorted
+# GATHER), never a sort. SIDDHI_TPU_WINDOW_COMPACTION=sort restores the
+# argsort path everywhere (read once at import — per-call flapping would
+# flap compiled-program identities, docs/compile_cache.md).
+_REGION_COMPACTION = os.environ.get(
+    "SIDDHI_TPU_WINDOW_COMPACTION", "region").strip().lower() != "sort"
+
+
+def keep_newest(pool: dict, keep_mask, cap: int, presorted: bool = False):
     """Retain the newest (by seq) `cap` rows where keep_mask; returns
     (buffer dict of size cap in seq order, overflow_count).
 
-    Implemented with one int32 argsort + gather. (A sort-free variant —
-    reversed prefix count + scatter into [cap] — was measured SLOWER on
-    TPU v5-lite: dynamic-index scatters lower worse than the native
-    int32 sort, 271k vs 316k ev/s on the window_agg bench.)"""
+    presorted=True: the caller guarantees the pool's KEPT rows already
+    appear in ascending-seq order (every make_pool-style pool — base
+    buffer segment then arrivals — qualifies). Compaction then needs NO
+    sort: one prefix sum ranks the kept rows and one searchsorted
+    gather places the newest `cap` of them, keeping the layout contract
+    (valid tail in seq order) bit-compatible with the argsort path.
+    Note the earlier sort-free attempt that measured SLOWER on TPU
+    v5-lite (271k vs 316k ev/s on window_agg) was SCATTER-based —
+    dynamic-index scatters lower worse than the native int32 sort; this
+    path is pure gathers.
+
+    The argsort path remains for pools without an ordering guarantee
+    (comparator/frequency-evicting windows) and as the
+    SIDDHI_TPU_WINDOW_COMPACTION=sort fallback."""
     n = pool["seq"].shape[0]
     keep = keep_mask & pool["valid"]
+    if presorted and _REGION_COMPACTION:
+        c = jnp.cumsum(keep.astype(jnp.int32))       # kept-rank prefix
+        total = c[n - 1]
+        j = jnp.arange(cap, dtype=jnp.int32)
+        r = total - cap + j          # kept-rank landing in output slot j
+        take = jnp.clip(jnp.searchsorted(c, r + 1, side="left"), 0, n - 1)
+        new_valid = r >= 0
+        overflow = jnp.maximum(total - cap, 0).astype(jnp.int64)
+        return _gather_buffer(pool, take, new_valid), overflow
     key = _rel32(jnp.where(keep, pool["seq"], NEG_INF))
     idx = jnp.argsort(key)          # dropped/invalid first, then kept by seq
     kept_count = jnp.sum(keep.astype(jnp.int64))
@@ -280,7 +311,7 @@ class TimeWindowOp(WindowOp):
         valid = jnp.concatenate([exp_valid, cur])
         result = emission_sort(out, emit_row, phase, oseq, valid, P + B)
 
-        buf, overflow = keep_newest(pool, ~expires_here, W)
+        buf, overflow = keep_newest(pool, ~expires_here, W, presorted=True)
         return ({"buf": buf, "next_seq": next_seq,
                  "overflow": state["overflow"] + overflow}, result)
 
@@ -369,7 +400,7 @@ class LengthWindowOp(WindowOp):
         exp_valid = evicted if self.expired_enabled else jnp.zeros_like(evicted)
         valid = jnp.concatenate([exp_valid, cur])
         result = emission_sort(out, emit_row, phase, oseq, valid, P + B)
-        buf, _ = keep_newest(pool, ~evicted, max(L, 1))
+        buf, _ = keep_newest(pool, ~evicted, max(L, 1), presorted=True)
         return ({"buf": buf, "next_seq": next_seq}, result)
 
     def findable_buffer(self, state):
@@ -488,9 +519,9 @@ class LengthBatchWindowOp(WindowOp):
                                EB + 3 * P)
 
         pending = pool["valid"] & (batch_of >= last_complete)
-        new_cur, _ = keep_newest(pool, pending, L)
+        new_cur, _ = keep_newest(pool, pending, L, presorted=True)
         last_batch = pool["valid"] & (batch_of == last_complete - 1)
-        new_exp_pool, _ = keep_newest(pool, last_batch, L)
+        new_exp_pool, _ = keep_newest(pool, last_batch, L, presorted=True)
         new_exp = jax.tree_util.tree_map(
             lambda a, b: jnp.where(any_flush, a, b), new_exp_pool,
             state["exp"])
@@ -608,9 +639,11 @@ class TimeBatchWindowOp(WindowOp):
         result = emission_sort(out, emit_row, phase, oseq, valid, cap_out)
 
         # buffers: on send, cur batch -> exp, cur empties; else cur keeps all
-        new_cur_flush, _ = keep_newest(pool, jnp.zeros_like(pool["valid"]), W)
-        new_cur_keep, overflow = keep_newest(pool, pool["valid"], W)
-        new_exp_flush, _ = keep_newest(pool, pool["valid"], W)
+        new_cur_flush, _ = keep_newest(pool, jnp.zeros_like(pool["valid"]),
+                                       W, presorted=True)
+        new_cur_keep, overflow = keep_newest(pool, pool["valid"], W,
+                                             presorted=True)
+        new_exp_flush, _ = keep_newest(pool, pool["valid"], W, presorted=True)
         new_cur = jax.tree_util.tree_map(
             lambda a, b: jnp.where(send, a, b), new_cur_flush, new_cur_keep)
         new_exp = jax.tree_util.tree_map(

@@ -119,7 +119,7 @@ class ExternalTimeWindowOp(WindowOp):
         valid = jnp.concatenate([exp_valid, cur])
         result = emission_sort(out, emit_row, phase, oseq, valid, P + B)
 
-        buf, overflow = keep_newest(pool, ~expires_here, W)
+        buf, overflow = keep_newest(pool, ~expires_here, W, presorted=True)
         return ({"buf": buf, "next_seq": next_seq,
                  "overflow": state["overflow"] + overflow}, result)
 
@@ -200,7 +200,7 @@ class TimeLengthWindowOp(WindowOp):
         valid = jnp.concatenate([exp_valid, cur])
         result = emission_sort(out, emit_row, phase, oseq, valid, P + B)
 
-        buf, _ = keep_newest(pool, live & ~evicted, L)
+        buf, _ = keep_newest(pool, live & ~evicted, L, presorted=True)
         return ({"buf": buf, "next_seq": next_seq}, result)
 
     def next_due(self, state):
@@ -258,7 +258,8 @@ class DelayWindowOp(WindowOp):
         result = emission_sort(out, emit_row, phase, pool["seq"], released,
                                P)
 
-        buf, overflow = keep_newest(pool, pool["valid"] & ~released, W)
+        buf, overflow = keep_newest(pool, pool["valid"] & ~released, W,
+                                    presorted=True)
         return ({"buf": buf, "next_seq": next_seq,
                  "overflow": state["overflow"] + overflow}, result)
 
@@ -378,7 +379,8 @@ class BatchWindowOp(WindowOp):
         pool = make_pool(empty_buffer(self.schema, self.cap), batch, seq,
                          cur)
         pad = jnp.zeros((self.cap,), jnp.bool_)
-        new_exp_pool, overflow = keep_newest(pool, pool["valid"], self.cap)
+        new_exp_pool, overflow = keep_newest(pool, pool["valid"], self.cap,
+                                             presorted=True)
         new_exp = jax.tree_util.tree_map(
             lambda a_, b_: jnp.where(any_arrivals, a_, b_), new_exp_pool,
             state["exp"])
@@ -388,7 +390,7 @@ class BatchWindowOp(WindowOp):
         else:
             last_first = grp_first
         new_reset_pool, _ = keep_newest(
-            pool, jnp.concatenate([pad, last_first]), 1)
+            pool, jnp.concatenate([pad, last_first]), 1, presorted=True)
         new_reset = jax.tree_util.tree_map(
             lambda a_, b_: jnp.where(any_arrivals, a_, b_), new_reset_pool,
             state["reset"])
@@ -1062,7 +1064,7 @@ class ExternalTimeBatchWindowOp(WindowOp):
         # flushed window's rows (merged with the earlier early-flushed set
         # while the same batch window stays open)
         pending = pool["valid"] & ~cur_emits & ~early
-        new_cur, overflow = keep_newest(pool, pending, W)
+        new_cur, overflow = keep_newest(pool, pending, W, presorted=True)
         last_flushed = pool["valid"] & cur_emits & (
             w_of == jnp.max(jnp.where(cur_emits, w_of,
                                       jnp.int64(-2 ** 62))))
@@ -1084,7 +1086,7 @@ class ExternalTimeBatchWindowOp(WindowOp):
         keep_exp_old = jnp.broadcast_to(flushed0, (EB,)) & \
             state["exp"]["valid"]
         big_mask = jnp.concatenate([keep_exp_old, flush_set])
-        new_exp_m, _ = keep_newest(big, big_mask, W)
+        new_exp_m, _ = keep_newest(big, big_mask, W, presorted=True)
         did_flush = any_flush | (early & (~flushed0 | any_pool))
         new_exp = jax.tree_util.tree_map(
             lambda a_, b_: jnp.where(did_flush, a_, b_), new_exp_m,
@@ -1428,7 +1430,8 @@ class CronWindowOp(WindowOp):
             lambda a, b: jnp.where(flush, a, b), state["cur"],
             state["exp"])
         pool = make_pool(mid_cur, batch, seq, cur)
-        new_cur, overflow = keep_newest(pool, pool["valid"], W)
+        new_cur, overflow = keep_newest(pool, pool["valid"], W,
+                                        presorted=True)
         return ({"cur": new_cur, "exp": new_exp, "next_seq": next_seq,
                  "overflow": state["overflow"] + overflow}, result)
 
@@ -1558,8 +1561,8 @@ class HoppingWindowOp(WindowOp):
         # expired set
         keep = pool["valid"] & (pool["ts"] > next_hop - self.W_ms)
         new_buf, overflow = keep_newest(
-            pool, jnp.where(send, keep, pool["valid"]), W)
-        new_exp_f, _ = keep_newest(pool, flushed, W)
+            pool, jnp.where(send, keep, pool["valid"]), W, presorted=True)
+        new_exp_f, _ = keep_newest(pool, flushed, W, presorted=True)
         new_exp = jax.tree_util.tree_map(
             lambda a, b: jnp.where(send, a, b), new_exp_f, state["exp"])
         return ({"buf": new_buf, "exp": new_exp, "next_seq": next_seq,

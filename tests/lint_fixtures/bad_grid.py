@@ -1,0 +1,31 @@
+"""Fixture: quadratic-grid-hazard — [B, W]-style broadcast cross
+products outside the blessed join/table grid fallbacks."""
+import jax.numpy as jnp
+
+
+def bad_condition_grid(batch_keys, buf_keys, buf_valid):
+    # the classic [B, W] equi grid the banded probe replaces
+    return (batch_keys[:, None] == buf_keys[None, :]) & buf_valid[None, :]
+
+
+def bad_grid_through_call(ev_ts, buf_ts, window_ms):
+    # both axes inside one compare, one side through a call: ONE finding
+    # on the outermost expression
+    return jnp.abs(ev_ts[:, None] - buf_ts[None, :]) <= window_ms
+
+
+def fine_single_axis(batch_keys, threshold):
+    # a lone [:, None] (or [None, :]) broadcast is not a cross product
+    return (batch_keys[:, None] > threshold) & (batch_keys[:, None] < 10)
+
+
+def fine_probe_shape(sorted_keys, values, n_live):
+    # the banded replacement idiom stays clean
+    lo = jnp.searchsorted(sorted_keys, values, side="left")
+    hi = jnp.searchsorted(sorted_keys, values, side="right")
+    return jnp.minimum(lo, n_live), jnp.minimum(hi, n_live)
+
+
+def suppressed_blessed_fallback(batch_keys, buf_keys):
+    # an intentional grid with the pragma stays silent
+    return (batch_keys[:, None] == buf_keys[None, :])  # lint: disable=quadratic-grid-hazard

@@ -107,6 +107,18 @@ def test_bench_join_quick_parses_frontier_and_breakdown():
     assert d["value"] > 0
     assert d["pairs_dropped"] == 0
     _assert_frontier(d)
-    # the join [B,W] grid side steps must top the join config's ranking
+    # the join side steps must top the join config's ranking, and the
+    # center name must say which kernel ran (docs/performance.md
+    # "join kernels")
     _assert_breakdown(d, top_kind="join")
-    assert d["stage_breakdown"]["steps"][0]["step"].startswith("join/q.")
+    top = d["stage_breakdown"]["steps"][0]["step"]
+    assert top.startswith("join/q.")
+    assert "[probe]" in top or "[grid]" in top
+    # both kernels measured: the auto pick (probe for this equi ON) and
+    # the pinned grid comparison pass, each with a frontier
+    assert d["join_kernel"] == "probe"
+    assert d["grid_eps"] > 0
+    assert d["probe_speedup_vs_grid"] > 0
+    for row in d["frontier_grid"]:
+        assert "error" not in row, row
+        assert row["events_per_s"] > 0

@@ -13,6 +13,7 @@
 - tools/profile_report.py end to end (--config join ranks the grid top)
 """
 import json
+import os
 import sys
 import time
 
@@ -108,18 +109,24 @@ class TestDefaultOff:
 
 class TestCostReport:
     def test_shares_sum_to_100_ranked_and_join_grid_tops(self):
-        """The acceptance shape: on a join workload the [B,W] grid side
-        steps are the top cost center, shares sum to ~100%, and the
-        ranking is descending by measured wall ms."""
+        """The acceptance shape: on a join workload the join side steps
+        are the top cost center, shares sum to ~100%, and the ranking
+        is descending by measured wall ms. Side-center names carry the
+        kernel that ran (``join/<q>.left[grid|probe]``)."""
         rt = _start(FILTER_JOIN_APP)
         _send_join_traffic(rt, n=1024, chunks=1)   # warm compiles
         rt.cost_start(every=1)
         _send_join_traffic(rt, n=1024, chunks=4, seed=1)
         report = rt.cost_report()
+        kernels = rt.statistics()["compile"]["join_kernels"]
         rt.shutdown()
         steps = report["steps"]
         names = {s["step"] for s in steps}
-        assert {"join/qj.left", "join/qj.right", "query/qf"} <= names
+        for side in ("left", "right"):
+            kern = kernels[f"qj.{side}"]["kernel"]
+            assert kern in ("grid", "probe")
+            assert f"join/qj.{side}[{kern}]" in names
+        assert "query/qf" in names
         # ranked descending, shares sum to ~100
         totals = [s["ms_total"] for s in steps]
         assert totals == sorted(totals, reverse=True)
@@ -384,19 +391,55 @@ class TestProfileReportTool:
         rc = profile_report.main(argv)
         return rc, capsys.readouterr().out
 
-    def test_config_join_ranks_grid_top_json(self, capsys):
+    def test_config_join_ranks_kernel_tagged_side_top_json(self, capsys):
         rc, out = self._main(["--config", "join", "--events", "2048",
                               "--chunk", "1024", "--json", "--no-save"],
                              capsys)
         assert rc == 0
         report = json.loads(out)
         assert report["steps"], "no cost centers measured"
-        # the acceptance criterion: the join [B,W] grid step ranks top
+        # the acceptance criterion: a join side step ranks top AND its
+        # center name says which kernel ran (main() exits 1 otherwise)
         assert report["steps"][0]["kind"] == "join"
-        assert report["bottleneck"]["step"].startswith("join/q.")
+        top = report["bottleneck"]["step"]
+        assert top.startswith("join/q.")
+        assert "[probe]" in top or "[grid]" in top
         assert sum(s["share_pct"] for s in report["steps"]) == \
             pytest.approx(100.0, abs=0.5)
         assert report["saved"] is None   # --no-save honored
+
+    def test_config_join_grid_override_names_grid_kernel(self, capsys,
+                                                         monkeypatch):
+        monkeypatch.setenv("SIDDHI_TPU_JOIN_KERNEL", "grid")
+        rc, out = self._main(["--config", "join", "--events", "1024",
+                              "--chunk", "512", "--json", "--no-save"],
+                             capsys)
+        assert rc == 0
+        report = json.loads(out)
+        assert "[grid]" in report["bottleneck"]["step"]
+
+    def test_zero_measured_centers_exits_nonzero_with_message(
+            self, capsys, tmp_path):
+        # a non-numeric stream schema in app-file mode gets no synthetic
+        # traffic -> zero dispatches -> must exit 1 AND say why, never
+        # print an empty table and call it success
+        app = tmp_path / "silent.siddhi"
+        app.write_text("""
+            @app:name('silent_probe')
+            @app:playback
+            define stream S (name string);
+            @info(name = 'q') from S select name insert into Out;
+        """)
+        tools = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import profile_report
+        rc = profile_report.main([str(app), "--events", "256",
+                                  "--chunk", "128", "--no-save"])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "no cost centers measured" in err
 
     def test_config_filter_human_report(self, capsys, tmp_path,
                                         monkeypatch):

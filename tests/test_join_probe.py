@@ -1,0 +1,367 @@
+"""Grid-vs-probe join kernel equivalence (docs/performance.md "join
+kernels").
+
+The banded searchsorted probe must be indistinguishable from the [B, W]
+broadcast grid on everything observable: emitted rows (values AND
+order), RESET/EXPIRED passthrough, one-sided outer rows, JOIN_CAP
+overflow counts, and per-query statistics. The sweep runs
+
+- a synthetic corpus covering inner/left/right/full outer joins,
+  aliased sides, residual (non-key) conjuncts, batch windows
+  (RESET passthrough), JOIN_CAP overflow, string and int keys,
+  unidirectional joins, and stream-table joins;
+- the reference join test corpus (tests/ref_corpus/join_*.json),
+  replayed once per kernel;
+- a columnar randomized run (exercises the sliding-window liveness
+  gate on the probe's candidate stage);
+
+under SIDDHI_TPU_JOIN_KERNEL=grid and =probe and asserts identical
+output. A counting-jit guard asserts the probe path never retraces in
+steady state (the PR 4/5 zero-recompile contract).
+"""
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import Event, QueryCallback, SiddhiManager, StreamCallback
+
+CORPUS = pathlib.Path(__file__).parent / "ref_corpus"
+T0 = 1_500_000_000_000
+
+
+def _skip_ids(fname):
+    p = CORPUS / fname
+    if not p.exists():
+        return frozenset()
+    return frozenset(
+        ln.strip().split("|")[0].strip()
+        for ln in p.read_text().splitlines()
+        if ln.strip() and not ln.startswith("#"))
+
+
+SKIP = _skip_ids("known_failures.txt") | _skip_ids("compile_gated.txt")
+
+
+def _normalized_stats(rt):
+    """statistics() minus run-volatile keys: 'compile' carries the
+    kernel choice itself (differs by design) and cache/timing data;
+    latency/throughput are wall-clock."""
+    stats = rt.statistics()
+    stats.pop("compile", None)
+    for entry in stats.values():
+        if isinstance(entry, dict):
+            entry.pop("latency", None)
+            entry.pop("throughput_eps", None)
+    return stats
+
+
+def _replay(ql, actions, kernel, monkeypatch, callbacks=None):
+    """Deploy `ql` under one join kernel, replay corpus-style actions,
+    return (in_rows, rm_rows, normalized stats, join overflow)."""
+    monkeypatch.setenv("SIDDHI_TPU_JOIN_KERNEL", kernel)
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = {"in": [], "rm": []}
+
+    def on_query(_ts, in_events, rm_events):
+        got["in"] += [tuple(e.data) for e in (in_events or [])]
+        got["rm"] += [tuple(e.data) for e in (rm_events or [])]
+
+    def on_stream(events):
+        got["in"] += [tuple(e.data) for e in events]
+
+    targets = callbacks or list(rt.queries)
+    q_targets = [t for t in targets if t in rt.queries]
+    if q_targets:
+        for t in q_targets:
+            rt.add_callback(t, QueryCallback(fn=on_query))
+    else:
+        for t in targets:
+            rt.add_callback(t, StreamCallback(fn=on_stream))
+    rt.start()
+    with rt.barrier:
+        rt.on_ingest_ts(T0)
+    clock = T0
+    for act in actions:
+        if act[0] == "send":
+            _, sid, row = act
+            rt.get_input_handler(sid).send(Event(clock, tuple(row)))
+            clock += 1
+        elif act[0] == "sleep":
+            clock += act[1]
+            with rt.barrier:
+                rt.on_ingest_ts(clock)
+    overflow = sum(getattr(q, "overflow", 0) for q in rt.queries.values())
+    kernels = rt.statistics().get("compile", {}).get("join_kernels", {})
+    stats = _normalized_stats(rt)
+    rt.shutdown()
+    return got["in"], got["rm"], stats, overflow, kernels
+
+
+def _assert_kernels_equal(ql, actions, monkeypatch, callbacks=None,
+                          expect_probe=True):
+    g = _replay(ql, actions, "grid", monkeypatch, callbacks)
+    p = _replay(ql, actions, "probe", monkeypatch, callbacks)
+    assert g[0] == p[0], f"in-rows diverge:\n grid={g[0]}\nprobe={p[0]}"
+    assert g[1] == p[1], f"rm-rows diverge:\n grid={g[1]}\nprobe={p[1]}"
+    assert g[2] == p[2], "statistics() diverge"
+    assert g[3] == p[3], f"overflow diverges: grid={g[3]} probe={p[3]}"
+    for rec in g[4].values():
+        assert rec["kernel"] == "grid"
+    if expect_probe:
+        for rec in p[4].values():
+            assert rec["kernel"] == "probe"
+    return p
+
+
+PB = "@app:playback "
+TWO = PB + """
+    define stream L (k string, v int);
+    define stream R (k string, w int);
+"""
+ALT = (("send", "L", ("A", 1)), ("send", "R", ("A", 10)),
+       ("send", "L", ("B", 2)), ("send", "R", ("C", 30)),
+       ("send", "L", ("A", 3)), ("send", "R", ("B", 20)),
+       ("send", "L", ("C", 4)), ("send", "R", ("A", 40)))
+
+
+class TestSyntheticSweep:
+    def test_inner_time_windows(self, monkeypatch):
+        ql = TWO + """
+            @info(name='q')
+            from L#window.time(1 sec) join R#window.time(1 sec)
+            on L.k == R.k
+            select L.k as k, v, w insert into Out;
+        """
+        acts = ALT + (("sleep", 600),) + ALT + (("sleep", 1500),)
+        _assert_kernels_equal(ql, acts, monkeypatch)
+
+    @pytest.mark.parametrize("jt", ["left outer", "right outer",
+                                    "full outer"])
+    def test_outer_joins_emit_identical_one_sided_rows(self, jt,
+                                                       monkeypatch):
+        ql = TWO + f"""
+            @info(name='q')
+            from L#window.length(3) {jt} join R#window.length(3)
+            on L.k == R.k
+            select L.k as lk, v, R.k as rk, w insert into Out;
+        """
+        _assert_kernels_equal(ql, ALT, monkeypatch)
+
+    def test_aliased_sides(self, monkeypatch):
+        ql = TWO + """
+            @info(name='q')
+            from L#window.length(5) as a join R#window.length(5) as b
+            on a.k == b.k
+            select a.k as k, a.v as v, b.w as w insert into Out;
+        """
+        _assert_kernels_equal(ql, ALT, monkeypatch)
+
+    def test_residual_conjunct_on_banded_candidates(self, monkeypatch):
+        # equi key + residual comparisons: the probe evaluates v/w
+        # conjuncts only on band candidates — row set must not change
+        ql = TWO + """
+            @info(name='q')
+            from L#window.length(5) join R#window.length(5)
+            on L.k == R.k and L.v < R.w and R.w != 30
+            select L.k as k, v, w insert into Out;
+        """
+        _assert_kernels_equal(ql, ALT, monkeypatch)
+
+    def test_non_equi_condition_falls_back_to_grid(self, monkeypatch):
+        ql = TWO + """
+            @info(name='q')
+            from L#window.length(4) join R#window.length(4)
+            on L.v < R.w
+            select L.k as k, v, w insert into Out;
+        """
+        p = _assert_kernels_equal(ql, ALT, monkeypatch,
+                                  expect_probe=False)
+        for rec in p[4].values():
+            assert rec["kernel"] == "grid"
+            assert "equi" in rec["reason"]
+
+    def test_batch_window_reset_expired_passthrough(self, monkeypatch):
+        # lengthBatch flushes emit RESET + EXPIRED rows; both must pass
+        # through the join one-sided identically on both kernels
+        ql = TWO + """
+            @info(name='q')
+            from L#window.lengthBatch(2) join R#window.length(4)
+            on L.k == R.k
+            select L.k as k, v, w
+            insert all events into Out;
+        """
+        _assert_kernels_equal(ql, ALT + ALT, monkeypatch)
+
+    def test_join_cap_overflow_counts_identically(self, monkeypatch):
+        ql = TWO.replace("define stream L", "define stream L ", 1) + """
+            @info(name='q') @cap(join.pairs='2')
+            from L#window.length(8) join R#window.length(8)
+            on L.k == R.k
+            select L.k as k, v, w insert into Out;
+        """
+        same_key = tuple(("send", "L", ("A", i)) for i in range(4)) + \
+            tuple(("send", "R", ("A", 10 * i)) for i in range(4))
+        p = _assert_kernels_equal(ql, same_key, monkeypatch)
+        assert p[3] > 0    # the cap really overflowed (and matched)
+
+    def test_int_keys_and_unidirectional(self, monkeypatch):
+        ql = PB + """
+            define stream L (k int, v int);
+            define stream R (k int, w int);
+            @info(name='q')
+            from L#window.length(5) unidirectional join
+                 R#window.length(5)
+            on L.k == R.k
+            select L.k as k, v, w insert into Out;
+        """
+        acts = (("send", "R", (1, 10)), ("send", "L", (1, 1)),
+                ("send", "R", (2, 20)), ("send", "L", (2, 2)),
+                ("send", "L", (1, 3)))
+        _assert_kernels_equal(ql, acts, monkeypatch)
+
+    def test_stream_table_join_probes_table_buffer(self, monkeypatch):
+        ql = PB + """
+            define stream S (sym string, qty int);
+            define stream Feed (sym string, price float);
+            define table Prices (sym string, price float);
+            @info(name='load') from Feed select sym, price
+            insert into Prices;
+            @info(name='j')
+            from S join Prices on S.sym == Prices.sym
+            select S.sym as sym, qty, Prices.price as price
+            insert into Out;
+        """
+        acts = (("send", "Feed", ("IBM", 75.0)),
+                ("send", "Feed", ("WSO2", 57.0)),
+                ("send", "S", ("IBM", 10)),
+                ("send", "Feed", ("IBM", 80.0)),
+                ("send", "S", ("IBM", 2)),
+                ("send", "S", ("GOOG", 5)))
+        _assert_kernels_equal(ql, acts, monkeypatch,
+                              callbacks=["Out"])
+
+
+def _corpus_join_cases():
+    out = []
+    for f in sorted(CORPUS.glob("join_*.json")):
+        d = json.loads(f.read_text())
+        for c in d["cases"]:
+            cid = f"{f.stem}.{c['name']}"
+            if cid in SKIP or c.get("expect_error"):
+                continue
+            out.append(pytest.param(c, id=cid))
+    return out
+
+
+@pytest.mark.parametrize("case", _corpus_join_cases())
+def test_ref_corpus_join_case_grid_probe_equivalence(case, monkeypatch):
+    """Every runnable reference join test case replays identically on
+    both kernels (rows AND statistics) — the acceptance sweep."""
+    acts = tuple(a for a in case["actions"]
+                 if a[0] in ("send", "sleep"))
+    _assert_kernels_equal("@app:playback " + case["app"], acts,
+                          monkeypatch, callbacks=case["callbacks"],
+                          expect_probe=False)
+
+
+def test_columnar_randomized_with_liveness_gate(monkeypatch):
+    """Columnar ingest coalesces timer fires, so the probe must apply
+    the same per-pair liveness gate as the grid (candidate-stage
+    residual) — randomized high-fanout traffic over sliding time
+    windows must emit identical pair streams."""
+    ql = PB + """
+        define stream L (k int, v int);
+        define stream R (k int, w int);
+        @info(name='q') @cap(window.size='256', join.pairs='8192')
+        from L#window.time(500 milliseconds) join
+             R#window.time(500 milliseconds)
+        on L.k == R.k
+        select L.k as k, v, w insert into Out;
+    """
+
+    def run(kernel):
+        monkeypatch.setenv("SIDDHI_TPU_JOIN_KERNEL", kernel)
+        rt = SiddhiManager().create_siddhi_app_runtime(ql)
+        rows = []
+        rt.add_callback("Out", StreamCallback(
+            fn=lambda evs: rows.extend(tuple(e.data) for e in evs)))
+        rt.start()
+        hl = rt.get_input_handler("L")
+        hr = rt.get_input_handler("R")
+        rng = np.random.default_rng(42)
+        n = 128
+        for i in range(6):
+            ts = T0 + i * 200 + np.arange(n, dtype=np.int64)
+            k = rng.integers(0, 16, n).astype(np.int32)
+            hl.send_arrays(ts, [k, rng.integers(0, 100, n)
+                                .astype(np.int32)])
+            hr.send_arrays(ts, [k, rng.integers(0, 100, n)
+                                .astype(np.int32)])
+        emitted = rt.queries["q"].stats()["emitted"]
+        dropped = rt.queries["q"].overflow
+        rt.shutdown()
+        return rows, emitted, dropped
+
+    g_rows, g_em, g_drop = run("grid")
+    p_rows, p_em, p_drop = run("probe")
+    assert g_em == p_em and g_drop == p_drop
+    assert g_rows == p_rows
+    assert g_em > 0
+
+
+def test_probe_steady_state_zero_recompiles(monkeypatch):
+    """The probe join side steps must hit the jit caches after warmup:
+    zero new traces across steady-state chunks (the PR 4/5 counting-jit
+    contract — recompiles in the hot loop are the #1 TPU throughput
+    hazard)."""
+    import functools
+
+    import jax
+
+    real_jit = jax.jit
+    traces = [0]
+
+    def counting_jit(f, *a, **kw):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            traces[0] += 1
+            return f(*args, **kwargs)
+        return real_jit(wrapped, *a, **kw)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+    monkeypatch.setenv("SIDDHI_TPU_JOIN_KERNEL", "probe")
+    rt = SiddhiManager().create_siddhi_app_runtime(PB + """
+        define stream L (k int, v int);
+        define stream R (k int, w int);
+        @info(name='q')
+        from L#window.length(32) join R#window.length(32)
+        on L.k == R.k
+        select L.k as k, v, w insert into Out;
+    """)
+    assert all(rec["kernel"] == "probe" for rec in
+               rt.statistics()["compile"]["join_kernels"].values())
+    rt.start()
+    hl = rt.get_input_handler("L")
+    hr = rt.get_input_handler("R")
+
+    def chunk(i):
+        n = 64
+        ts = T0 + i * n + np.arange(n, dtype=np.int64)
+        k = ((np.arange(n) * 5 + i) % 16).astype(np.int32)
+        return ts, k
+
+    for i in range(3):      # warmup: compiles settle
+        ts, k = chunk(i)
+        hl.send_arrays(ts, [k, k + 1])
+        hr.send_arrays(ts, [k, k + 2])
+    before = traces[0]
+    for i in range(3, 10):
+        ts, k = chunk(i)
+        hl.send_arrays(ts, [k, k + 1])
+        hr.send_arrays(ts, [k, k + 2])
+    rt.shutdown()
+    assert traces[0] == before, \
+        f"probe steady state triggered {traces[0] - before} new traces"

@@ -55,6 +55,19 @@ def test_host_sync_in_loop_covers_metric_recording_paths():
     assert all(f.line <= 39 for f in fs)
 
 
+def test_quadratic_grid_hazard_fires_once_per_expression():
+    """[B,W]-style cross products ([:, None] against [None, :]) fire
+    once per outermost expression; single-axis broadcasts, the
+    searchsorted probe idiom, and pragma'd blessed fallbacks stay
+    clean (the intentional ops/join.py grid fallback rides the
+    checked-in baseline instead)."""
+    fs = findings_for("bad_grid.py")
+    assert lines_of(fs, "quadratic-grid-hazard") == [8, 14]
+    f = [x for x in fs if x.rule == "quadratic-grid-hazard"][0]
+    assert f.severity == "warning"
+    assert "cross product" in f.message
+
+
 def test_host_sync_in_jit_fires_for_decorated_and_wrapped():
     fs = findings_for("bad_jit_sync.py")
     assert lines_of(fs, "host-sync-in-jit") == [8, 13]

@@ -234,3 +234,50 @@ class TestTimerDriven:
         assert len(q) == 2
         assert [e.data[0] for e in q[0][0]] == [7] and q[0][1] is None
         assert q[1][0] is None and [e.data[0] for e in q[1][1]] == [7]
+
+
+class TestRegionCompactionEquivalence:
+    """The sort-free region compaction (keep_newest presorted path,
+    docs/performance.md "sort-free window compaction") must be
+    output-identical to the argsort path — same rows, same order, same
+    overflow counts."""
+
+    QL = PLAYBACK + """
+        define stream S (k string, v int);
+        @info(name = 'q') @cap(window.size='8')
+        from S#window.time(100 milliseconds)
+        select k, v insert all events into Out;
+    """
+
+    def _run(self, region: bool, monkeypatch):
+        from siddhi_tpu.ops import windows as W
+        monkeypatch.setattr(W, "_REGION_COMPACTION", region)
+        events = [(1000 + 30 * i, ("A" if i % 3 else "B", i))
+                  for i in range(24)]
+        stream_got, _q = run_app(self.QL, "S", events,
+                                 callback_target="Out")
+        return [tuple(e.data) for e in stream_got]
+
+    def test_region_matches_sort_path(self, monkeypatch):
+        assert self._run(True, monkeypatch) == \
+            self._run(False, monkeypatch)
+
+    def test_overflow_counts_match(self, monkeypatch):
+        from siddhi_tpu.ops import windows as W
+        counts = {}
+        for region in (True, False):
+            monkeypatch.setattr(W, "_REGION_COMPACTION", region)
+            mgr = SiddhiManager()
+            rt = mgr.create_siddhi_app_runtime(PLAYBACK + """
+                define stream S (v int);
+                @info(name = 'q') @cap(window.size='4')
+                from S#window.time(1 sec)
+                select v insert into Out;
+            """)
+            rt.start()
+            h = rt.get_input_handler("S")
+            for i in range(12):   # 12 live rows into a 4-cap window
+                h.send(Event(timestamp=1000 + i, data=(i,)))
+            counts[region] = rt.queries["q"].overflow_total()
+            rt.shutdown()
+        assert counts[True] == counts[False] > 0

@@ -23,7 +23,11 @@ Side effects: merges the measured cost table into
 device-time annotations (``rt.trace_export``).
 
 Exit status: 0 when the report contains at least one cost center, 1
-otherwise — usable as a CI probe like tools/metrics_dump.py.
+(with a stderr diagnostic, never a silent empty table) otherwise —
+usable as a CI probe like tools/metrics_dump.py. The join configs
+additionally require the top-ranked center to be a join side step whose
+name carries the kernel that ran (``join/q.left[grid|probe]`` —
+docs/performance.md "join kernels").
 """
 from __future__ import annotations
 
@@ -93,10 +97,11 @@ def _cfg_chain3():
     return ql, gen, "q3"
 
 
-def _cfg_join():
-    # the bench_join shape: 1024-symbol key space, 1024-row windows —
-    # the [B,W] grid steps (left/right sides) are the expected top cost
-    # centers of any profile of this config
+def _join_cfg(n_symbols):
+    # the bench_join shape: the join side steps (left/right) are the
+    # expected top cost centers of any profile of this config; the
+    # center name carries the kernel that ran
+    # (``join/q.left[grid|probe]`` — asserted in main() below)
     ql = """
         @app:playback
         define stream StockStream (symbol string, price float);
@@ -108,7 +113,7 @@ def _cfg_join():
         select StockStream.symbol, price, tweets
         insert into OutputStream;
     """
-    syms = _syms(1024)
+    syms = _syms(n_symbols)
 
     def gen(rng, ts, n):
         sym = syms[rng.integers(0, len(syms), n)]
@@ -118,6 +123,16 @@ def _cfg_join():
                                   rng.integers(0, 50, n)
                                   .astype(np.int32)]}
     return ql, gen, "q"
+
+
+def _cfg_join():
+    return _join_cfg(1024)
+
+
+def _cfg_join_eq():
+    # bench_join_eq: high-cardinality equi key (symbols=8192) — the
+    # banded probe kernel's home turf
+    return _join_cfg(8192)
 
 
 def _cfg_seq5():
@@ -143,7 +158,8 @@ def _cfg_seq5():
 
 
 CONFIGS = {"filter": _cfg_filter, "chain3": _cfg_chain3,
-           "join": _cfg_join, "seq5": _cfg_seq5}
+           "join": _cfg_join, "join_eq": _cfg_join_eq,
+           "seq5": _cfg_seq5}
 
 
 def _numeric_gen(rt):
@@ -292,7 +308,34 @@ def main(argv=None) -> int:
                           "saved": saved, **report}))
     else:
         print(render(report, name, args.events, saved))
-    return 0 if report["steps"] else 1
+    if not report["steps"]:
+        # never exit 0 with an empty table: zero measured centers means
+        # the replay produced no dispatches (non-numeric stream schemas
+        # in app-file mode, too few --events for the --chunk, or the
+        # app's queries never fired) — name the likely causes instead of
+        # printing an empty report and calling it success
+        target = args.config or args.app
+        print(f"profile_report: no cost centers measured for "
+              f"'{target}' ({args.events} events, chunk {args.chunk}) — "
+              "no step dispatched under profiling. Check that the app's "
+              "streams received traffic (app-file mode generates ramps "
+              "only for all-numeric streams) and that --events covers "
+              "at least one chunk.", file=sys.stderr)
+        return 1
+    if args.config in ("join", "join_eq"):
+        # the join configs' contract: the top-ranked center is a join
+        # side step AND its name says which kernel ran — the probe/grid
+        # split is the whole point of profiling this workload
+        top = report["steps"][0]["step"]
+        if not (top.startswith("join/q.")
+                and ("[grid]" in top or "[probe]" in top)):
+            print(f"profile_report: --config {args.config} expected a "
+                  f"join side center named 'join/q.<side>[grid|probe]' "
+                  f"on top of the ranking, got '{top}' — the join "
+                  "kernel did not dominate (or the center lost its "
+                  "kernel tag)", file=sys.stderr)
+            return 1
+    return 0
 
 
 if __name__ == "__main__":
