@@ -84,12 +84,20 @@ ASSUMED = {
 # `python bench.py --quick` tightens all four for smoke runs;
 # SIDDHI_BENCH_SCALE=1 SIDDHI_BENCH_DEADLINE_S=3600 restores the full
 # r4-style measurement.
+#   SIDDHI_BENCH_DISORDER=1  additionally measures the event-time
+#                            reorder-buffer overhead (resilience/
+#                            ordering.py) on the filter and seq5
+#                            configs: events/s with a watermark buffer
+#                            on ORDERED input vs the buffer-off main
+#                            number ("disorder" key in the JSON line;
+#                            docs/performance.md).
 # ---------------------------------------------------------------------------
 _env = os.environ.get
 SCALE = float(_env("SIDDHI_BENCH_SCALE", "0.5") or 0.5)
 REPS = int(_env("SIDDHI_BENCH_REPS", "3") or 3)
 BUDGET_S = float(_env("SIDDHI_BENCH_BUDGET_S", "240") or 240)
 DEADLINE_S = float(_env("SIDDHI_BENCH_DEADLINE_S", "420") or 420)
+DISORDER = _env("SIDDHI_BENCH_DISORDER", "") not in ("", "0")
 
 
 def _scaled(n: int, chunk: int = 1) -> int:
@@ -225,17 +233,54 @@ class _Last:
             self.out = None
 
 
+FILTER_APP = """
+    @app:playback
+    define stream StockStream (symbol string, price float, volume long);
+    @info(name = 'q')
+    from StockStream[price > 100.0]
+    select symbol, price
+    insert into OutputStream;
+"""
+
+
+def _reorder_overhead(app_ql, stream, n, dt_off, mk_chunks, samples,
+                      lateness_ms=1000):
+    """SIDDHI_BENCH_DISORDER: measure the reorder-buffer tax on ORDERED
+    input — the same app with an `@app:watermark` ingest buffer, same
+    traffic volume, best-of-REPS (docs/performance.md). mk_chunks(i)
+    yields the chunks for rep i with a monotone clock (buffered tails
+    from rep i flush with rep i+1's watermark progress)."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(
+        f"@app:watermark(lateness='{lateness_ms}')" + app_ql)
+    outs = []
+    next(iter(rt.queries.values())).batch_callbacks.append(outs.append)
+    rt.start()
+    h = rt.get_input_handler(stream)
+    _warm(rt, n, samples=samples)
+
+    def one_rep(i):
+        for ts, cols in mk_chunks(i):
+            h.send_arrays(ts, cols)
+        _drain(outs)
+
+    one_rep(0)   # warmup rep: encodings + release-cut buckets settle
+    dt_on = min(_timed(lambda i=i: one_rep(i)) for i in range(1, REPS + 1))
+    rt.flush_watermarks(final=True)
+    _drain(outs)
+    rt.shutdown()
+    return {
+        "eps_buffer_on": round(n / dt_on, 1),
+        "eps_buffer_off": round(n / dt_off, 1),
+        "reorder_overhead_pct": round((dt_on / dt_off - 1.0) * 100.0, 1),
+        "lateness_ms": lateness_ms,
+    }
+
+
 def bench_filter(n=1_000_000):
     n = _scaled(n)
     mgr = SiddhiManager()
-    rt = mgr.create_siddhi_app_runtime("""
-        @app:playback
-        define stream StockStream (symbol string, price float, volume long);
-        @info(name = 'q')
-        from StockStream[price > 100.0]
-        select symbol, price
-        insert into OutputStream;
-    """)
+    rt = mgr.create_siddhi_app_runtime(FILTER_APP)
     q = rt.queries["q"]
     outs = []
     q.batch_callbacks.append(outs.append)
@@ -254,6 +299,15 @@ def bench_filter(n=1_000_000):
     # (the r4 driver capture measured 2-6x below the builder's runs)
     dt = min(_timed(lambda: (h.send_arrays(ts, [sym, price, vol]),
                              _drain(outs))) for _ in range(REPS))
+    dis = None
+    if DISORDER:
+        # reorder-buffer overhead on ordered input (monotone per-rep
+        # clock: each rep's tail flushes with the next rep's watermark)
+        def mk(i):
+            t = ts + np.int64(i) * n
+            return [(t, [sym, price, vol])]
+        dis = _reorder_overhead(FILTER_APP, "StockStream", n, dt, mk,
+                                {"StockStream": (ts, [sym, price, vol])})
     # AFTER the timed reps: one DETAIL-probed chunk so the registry dump
     # carries a real per-step latency summary (DETAIL serializes the
     # pipeline — docs/observability.md — so it must never overlap the
@@ -266,9 +320,11 @@ def bench_filter(n=1_000_000):
         _drain(outs)))
     met = _metrics_snapshot(rt)
     rt.shutdown()
-    return _entry("filter", n, dt, extra={
-        "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
-        "stage_breakdown": sb, **cinfo})
+    extra = {"ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
+             "stage_breakdown": sb, **cinfo}
+    if dis is not None:
+        extra["disorder"] = dis
+    return _entry("filter", n, dt, extra=extra)
 
 
 CHAIN3_APP = """
@@ -712,6 +768,24 @@ def bench_seq5(n=1_048_576, chunk=65_536):
         _drain(outs)
         dts.append(time.perf_counter() - t0)
     dt = min(dts)
+    dis = None
+    if DISORDER:
+        # reorder-buffer overhead on ordered input, seq5 shape (own
+        # runtime + own monotone clock/rng twin of the main pass)
+        rngd = np.random.default_rng(12)
+        clockd = [TS0]
+
+        def mkd(m):
+            t = clockd[0] + np.arange(m, dtype=np.int64)
+            clockd[0] += m
+            return t, [syms[rngd.integers(0, len(syms), m)],
+                       rngd.integers(1, 6, m).astype(np.int32),
+                       rngd.integers(0, 1000, m).astype(np.int32)]
+
+        dis = _reorder_overhead(
+            SEQ5_APP, "T", n_chunks * chunk, dt,
+            lambda i: [mkd(chunk) for _ in range(n_chunks)],
+            {"T": (s_ts, s_cols)})
     # latency pass: per-chunk sync measures send -> matches visible
     lat = []
     for _ in range(8):
@@ -742,6 +816,7 @@ def bench_seq5(n=1_048_576, chunk=65_536):
     lat_ms = np.array(lat) * 1000.0
     lat1k_ms = np.array(lat1k) * 1000.0
     return _entry("seq5", n_chunks * chunk, dt, extra={
+        **({"disorder": dis} if dis is not None else {}),
         "metrics": met,
         "frontier": fr, "stage_breakdown": sb,
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),

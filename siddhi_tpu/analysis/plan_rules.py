@@ -109,6 +109,7 @@ class PlanValidator:
     # -- checks --------------------------------------------------------
     def validate(self) -> list[PlanIssue]:
         self.check_app_statistics()
+        self.check_watermarks()
         for sid, sd in self.app.stream_definitions.items():
             self.check_on_error_actions(sid, sd)
         qn = 0
@@ -145,6 +146,66 @@ class PlanValidator:
                 "statistics-interval", ERROR, "app",
                 f"cannot parse @app:statistics interval '{interval}' "
                 "(expected e.g. '5 sec', '500 ms', '1 min')")
+
+    def check_watermarks(self) -> None:
+        """``@app:watermark`` / per-stream ``@watermark`` annotations:
+        unknown late policy, negative/unparseable lateness, bad cap or
+        dedup values, and watermark targets naming undefined streams
+        are definite runtime rejections — fail at parse time with the
+        offending value named (same pattern as ``on-error-action``;
+        shared parser in resilience/ordering.py so validation cannot
+        drift from planner behavior)."""
+        from ..resilience.ordering import config_from_annotation
+        for ann in self.app.annotations:
+            if ann.name.lower() != "watermark":
+                continue
+            conf = None
+            try:
+                conf = config_from_annotation(ann)
+            except ValueError as e:
+                self.add("watermark-config", ERROR, "app", str(e))
+            tgt = ann.element("stream")
+            if tgt is not None:
+                t = str(tgt).strip().strip("'\"")
+                if t not in self.app.stream_definitions:
+                    self.add(
+                        "watermark-config", ERROR, "app",
+                        f"@app:watermark targets undefined stream '{t}'")
+            self._check_late_stream(conf, "app", None)
+        for sid, sd in self.app.stream_definitions.items():
+            ann = A.find_annotation(sd.annotations, "watermark")
+            if ann is None:
+                continue
+            conf = None
+            try:
+                conf = config_from_annotation(ann)
+            except ValueError as e:
+                self.add("watermark-config", ERROR, f"stream {sid}",
+                         str(e))
+            self._check_late_stream(conf, f"stream {sid}", sid)
+
+    def _check_late_stream(self, conf, where: str,
+                           sid: Optional[str]) -> None:
+        """policy='STREAM' side-outputs late events with their original
+        attributes: the late.stream target must be a defined stream
+        and, when the source stream is known, schema-identical."""
+        if conf is None or conf.late_stream is None:
+            return
+        lsd = self.app.stream_definitions.get(conf.late_stream)
+        if lsd is None:
+            self.add(
+                "watermark-config", ERROR, where,
+                f"@watermark late.stream '{conf.late_stream}' is not a "
+                "defined stream")
+            return
+        if sid is not None:
+            src = self.app.stream_definitions[sid]
+            if [a.type for a in lsd.attributes] != \
+                    [a.type for a in src.attributes]:
+                self.add(
+                    "watermark-config", ERROR, where,
+                    f"@watermark late.stream '{conf.late_stream}' "
+                    f"schema does not match stream '{sid}'")
 
     def check_on_error_actions(self, sid: str, sd) -> None:
         """Unknown @OnError / connector `on.error` action values are

@@ -1615,6 +1615,11 @@ class SiddhiAppRuntime:
         # planner's per-join-side kernel picks: {"<q>.left": {"kernel":
         # "grid"|"probe", "reason": ...}} — statistics()['compile']
         self._join_kernels: dict[str, dict] = {}
+        # per-stream bounded-lateness reorder buffers keyed by stream id
+        # (resilience/ordering.py, wired by the planner from @watermark
+        # annotations); non-empty => watermark mode: the virtual clock
+        # advances on watermark progress and never regresses
+        self._reorder: dict = {}
         self.tables: dict[str, TableRuntime] = {}
         self.record_tables: dict = {}  # tid -> RecordTableRuntime (@Store)
         self.named_windows: dict[str, QueryRuntime] = {}
@@ -1748,6 +1753,11 @@ class SiddhiAppRuntime:
                 self._cron_armed = True
                 base = (first_ts if first_ts is not None else last_ts) - 1
                 self._arm_cron(base)
+            if self._reorder and self._playback_time is not None:
+                # watermark mode: PROCESS-policy late events and replay
+                # re-injection carry old timestamps — the watermark
+                # clock never regresses
+                last_ts = max(last_ts, self._playback_time)
             self._playback_time = last_ts
             self._last_ingest_wall = time.monotonic()
             self.scheduler.advance_to(last_ts)
@@ -1769,8 +1779,64 @@ class SiddhiAppRuntime:
                 self._cron_armed = True
                 self._arm_cron(first_ts - 1)
             self.scheduler.advance_to(first_ts - 1)
+            if self._reorder and self._playback_time is not None:
+                last_ts = max(last_ts, self._playback_time)
             self._playback_time = last_ts
             self._last_ingest_wall = time.monotonic()
+
+    def on_event_time(self, target_ms: int) -> None:
+        """Watermark-driven clock (resilience/ordering.py): advance the
+        virtual clock and due timers monotonically to the global
+        watermark, so windows/joins/patterns fire on watermark progress
+        instead of raw arrival — and never backwards. Idempotent for
+        targets at or behind the current clock."""
+        self._resolve_dues()
+        if not self._playback:
+            return
+        cur = self._playback_time
+        if cur is not None and target_ms <= cur:
+            return
+        if self._unarmed_patterns:
+            pats, self._unarmed_patterns = self._unarmed_patterns, []
+            for q in pats:
+                q.arm_start_deadlines(target_ms)
+        if not self._cron_armed:
+            self._cron_armed = True
+            self._arm_cron(target_ms - 1)
+        self._playback_time = target_ms
+        self._last_ingest_wall = time.monotonic()
+        self.scheduler.advance_to(target_ms)
+
+    def global_watermark(self) -> Optional[int]:
+        """Min watermark across watermarked streams (streams that have
+        not observed any event yet do not hold the watermark back — the
+        idle-source caveat, docs/resilience.md). None before any
+        watermarked stream has seen traffic."""
+        wms = [b.watermark for b in self._reorder.values()
+               if b.watermark is not None]
+        return min(wms) if wms else None
+
+    def flush_watermarks(self, final: bool = False) -> None:
+        """Release reorder-buffered events (resilience/ordering.py): up
+        to each stream's current watermark, or EVERYTHING when
+        ``final`` (the shutdown path). A final flush also advances the
+        clock to the observed event-time frontier so trailing window
+        boundaries and pattern deadlines fire exactly where an
+        unbuffered run's would."""
+        if not self._reorder:
+            return
+        with self.barrier:
+            for buf in self._reorder.values():
+                buf.flush(final=final)
+            if final:
+                fronts = [b.max_ts for b in self._reorder.values()
+                          if b.max_ts is not None]
+                if fronts:
+                    self.on_event_time(max(fronts))
+            else:
+                wm = self.global_watermark()
+                if wm is not None:
+                    self.on_event_time(wm)
 
     def _arm_cron(self, base_ms: int) -> None:
         for q in self.queries.values():
@@ -1976,6 +2042,22 @@ class SiddhiAppRuntime:
                 flat[f"{sbase}.async.depth"] = j._queue.qsize()
                 flat[f"{sbase}.async.pending"] = j._pending
                 flat[f"{sbase}.async.capacity"] = j.async_conf[0]
+            # event-time robustness gauges (resilience/ordering.py):
+            # watermark position/lag, reorder-buffer depth and the
+            # late/dropped/duplicate/forced counters
+            buf = self._reorder.get(sid)
+            if buf is not None:
+                wm = buf.watermark
+                flat[f"{sbase}.watermark"] = -1 if wm is None else int(wm)
+                flat[f"{sbase}.watermark.lag_ms"] = buf.lag_ms
+                flat[f"{sbase}.reorder.depth"] = buf.depth
+                for k, v in buf.counters.items():
+                    flat[f"{sbase}.reorder.{k}"] = v
+        if self._reorder:
+            report["reorder"] = {
+                sid: {"watermark": b.watermark, "lag_ms": b.lag_ms,
+                      "depth": b.depth, **b.counters}
+                for sid, b in self._reorder.items()}
         errors = self.error_stats.snapshot()
         if errors:
             report["stream_errors"] = errors
@@ -2297,6 +2379,10 @@ class SiddhiAppRuntime:
                            for n, b in self.partitions.items()},
             "aggregations": {n: a.snapshot_state()
                              for n, a in self.aggregations.items()},
+            # reorder-buffered events are accepted-but-unreleased state:
+            # a crash between checkpoint and flush must not lose them
+            "reorder": {sid: b.snapshot_state()
+                        for sid, b in self._reorder.items()},
             "strings": dump_strings(),
         }
         return serialize(payload)
@@ -2329,6 +2415,10 @@ class SiddhiAppRuntime:
         for n, snap in payload.get("aggregations", {}).items():
             if n in self.aggregations:
                 self.aggregations[n].restore_state(snap)
+        for sid, snap in payload.get("reorder", {}).items():
+            buf = self._reorder.get(sid)
+            if buf is not None:
+                buf.restore_state(snap)
         for q in self.queries.values():
             if hasattr(q, "reschedule"):
                 q.reschedule()
@@ -2384,6 +2474,15 @@ class SiddhiAppRuntime:
     def shutdown(self) -> None:
         self.running = False  # reject new sends before draining
         self._stop_reporter()
+        if self._reorder:
+            # release everything still held in reorder buffers so an
+            # accepted event is never silently lost at shutdown
+            try:
+                self.flush_watermarks(final=True)
+            except Exception:  # noqa: BLE001 — shutdown must finish
+                logging.getLogger("siddhi_tpu.runtime").exception(
+                    "app '%s': reorder-buffer final flush failed",
+                    self.name)
         flush_errors = []
         for j in self.junctions.values():
             if j.async_conf is not None:
@@ -2477,6 +2576,11 @@ class Planner:
                     fschema = StreamSchema("!" + sid, schema.attributes + (
                         Attribute("_error", AttrType.STRING),))
                     j.fault_junction = app.junction_for("!" + sid, fschema)
+        # 1a2. event-time watermarks + bounded-lateness reorder buffers
+        # (resilience/ordering.py; docs/resilience.md). Validated at
+        # parse time by the `watermark-config` plan rule — this is the
+        # planner backstop for validate=False / hand-built ASTs.
+        self.plan_watermarks()
         # 1b. defined tables (@PrimaryKey -> upsert semantics);
         # @Store tables become host-side record tables, with an optional
         # device-resident @Cache front registered under the table id so
@@ -2607,6 +2711,72 @@ class Planner:
         # 3. sources/sinks from @source/@sink annotations
         from .io import build_io
         build_io(app, self.extensions)
+
+    def plan_watermarks(self) -> None:
+        """``@app:watermark(...)`` / per-stream ``@watermark(...)`` ->
+        ReorderBuffer per configured stream, wired onto the ingest path
+        (InputHandler). App-level without ``stream=`` applies to every
+        defined stream; ``stream='S'`` targets one; a definition-level
+        annotation overrides both. Any watermark config switches the
+        app to event-time processing (playback semantics): the virtual
+        clock advances on watermark progress."""
+        from ..resilience.ordering import (ReorderBuffer,
+                                           config_from_annotation)
+        app, ast = self.app, self.ast
+        wm_default = None
+        wm_streams: dict = {}
+        for ann in ast.annotations:
+            if ann.name.lower() != "watermark":
+                continue
+            try:
+                conf = config_from_annotation(ann)
+            except ValueError as e:
+                raise CompileError(f"@app:watermark: {e}")
+            tgt = ann.element("stream")
+            if tgt is None:
+                wm_default = conf
+            else:
+                tgt = str(tgt).strip().strip("'\"")
+                if tgt not in ast.stream_definitions:
+                    raise CompileError(
+                        f"@app:watermark targets undefined stream "
+                        f"'{tgt}'")
+                wm_streams[tgt] = conf
+        for sid, sd in ast.stream_definitions.items():
+            wa = A.find_annotation(sd.annotations, "watermark")
+            if wa is not None:
+                try:
+                    conf = config_from_annotation(wa)
+                except ValueError as e:
+                    raise CompileError(f"stream '{sid}': @watermark: {e}")
+            else:
+                conf = wm_streams.get(sid) or wm_default
+            if conf is None:
+                continue
+            buf = ReorderBuffer(sid, app.schemas[sid], conf)
+            buf.handler = app.input_handlers[sid]
+            if conf.policy == "STREAM":
+                lt = conf.late_stream
+                lsd = ast.stream_definitions.get(lt)
+                if lsd is None:
+                    raise CompileError(
+                        f"stream '{sid}': @watermark late.stream '{lt}' "
+                        "is not a defined stream")
+                if [a.type for a in lsd.attributes] != \
+                        [a.type for a in sd.attributes]:
+                    raise CompileError(
+                        f"stream '{sid}': @watermark late.stream '{lt}' "
+                        "schema does not match the source stream "
+                        "(late events re-publish with the original "
+                        "attributes)")
+                lschema = StreamSchema(lt, tuple(
+                    Attribute(a.name, a.type) for a in lsd.attributes))
+                buf.late_junction = app.junction_for(lt, lschema)
+            app._reorder[sid] = buf
+        if app._reorder:
+            # watermarks define event time: windows/joins/patterns fire
+            # on watermark progress (implies @app:playback semantics)
+            app._playback = True
 
     # -- partitions ------------------------------------------------------
     DEFAULT_PARTITION_SLOTS = 32

@@ -347,6 +347,16 @@ class InputHandler:
         else:
             events = [Event(timestamp=now(), data=tuple(data))]
         self.junction.mark_ingest(len(events))
+        buf = self.app._reorder.get(self.stream_id)
+        if buf is not None:
+            # bounded-lateness reorder buffer (resilience/ordering.py):
+            # events are buffered, watermark-sorted and released through
+            # _dispatch_rows; late events resolve per the stream policy
+            with maybe_span(self.app, "ingest", self.stream_id,
+                            events=len(events), buffered=1), \
+                    self.app.barrier:
+                buf.ingest_rows(events)
+            return
         with maybe_span(self.app, "ingest", self.stream_id,
                         events=len(events)):
             if self.junction._queue is not None:
@@ -356,14 +366,25 @@ class InputHandler:
                 self.junction.publish(events)
                 return
             with self.app.barrier:
-                self.app.on_ingest(self.stream_id, events)
-                self.junction.publish(events)
-                # timers armed DURING processing (e.g. hop boundaries
-                # the chunk's own event-time jump crossed) fire now, not
-                # at the next external tick
-                if self.app._playback and \
-                        self.app._playback_time is not None:
-                    self.app.scheduler.advance_to(self.app._playback_time)
+                self._dispatch_rows(events)
+
+    def _dispatch_rows(self, events) -> None:
+        """Row publish body (caller holds the app barrier unless the
+        junction is @Async): advance the clock, publish, fire timers
+        armed during processing. The reorder-buffer flush releases
+        through here too, so buffered and direct ingest share one
+        dispatch contract."""
+        if self.junction._queue is not None:
+            self.junction.publish(events)
+            return
+        self.app.on_ingest(self.stream_id, events)
+        self.junction.publish(events)
+        # timers armed DURING processing (e.g. hop boundaries the
+        # chunk's own event-time jump crossed) fire now, not at the
+        # next external tick
+        if self.app._playback and \
+                self.app._playback_time is not None:
+            self.app.scheduler.advance_to(self.app._playback_time)
 
     def send_arrays(self, ts, cols) -> None:
         """Columnar ingest: numpy timestamp + data column arrays
@@ -375,9 +396,6 @@ class InputHandler:
         ONE adaptively-encoded uint8 buffer with one device transfer and
         zero per-batch host syncs (core/ingest.py); otherwise the
         EventBatch path is used."""
-        from .event import batch_from_columns
-        from .ingest import PackedChunk, PackedEncoder
-        from .runtime import BATCH_BUCKETS, bucket_capacity
         if not self.app.running:
             raise RuntimeError(
                 f"app '{self.app.name}' is not running; call start() first")
@@ -385,6 +403,27 @@ class InputHandler:
         if n == 0:
             return
         self.app._columnar = True
+        buf = self.app._reorder.get(self.stream_id)
+        if buf is not None:
+            # columnar reorder buffer: the chunk lands in numpy segments;
+            # the watermark-driven flush re-emits sorted chunks through
+            # _dispatch_arrays (same bucketed capacities, zero new jits)
+            self.junction.mark_ingest(n)
+            with maybe_span(self.app, "ingest", self.stream_id,
+                            rows=n, buffered=1), self.app.barrier:
+                buf.ingest_columns(ts, cols)
+            return
+        self._dispatch_arrays(ts, cols)
+
+    def _dispatch_arrays(self, ts, cols, mark: bool = True) -> None:
+        """Columnar publish body: chunk to bucketed capacities and
+        dispatch. Direct ingest and reorder-buffer releases share this
+        path; releases pass mark=False (ingest throughput was already
+        marked at arrival)."""
+        from .event import batch_from_columns
+        from .ingest import PackedChunk, PackedEncoder
+        from .runtime import BATCH_BUCKETS, bucket_capacity
+        n = len(ts)
         packed_ok = all(getattr(r, "supports_packed", False)
                         for r in self.junction.receivers)
         max_cap = BATCH_BUCKETS[-1]
@@ -411,7 +450,8 @@ class InputHandler:
             t = ts[start:start + max_cap]
             c = [col[start:start + max_cap] for col in cols]
             last_ts = int(t[-1])
-            self.junction.mark_ingest(len(t))
+            if mark:
+                self.junction.mark_ingest(len(t))
             with maybe_span(self.app, "ingest", self.stream_id,
                             rows=len(t)), self.app.barrier:
                 # columnar fast path: fire only dues STRICTLY BEFORE

@@ -370,7 +370,7 @@ def analyze_index_probe(on_ast, table: "TableRuntime",
     return IndexProbe(attr, op, ce)
 
 
-def sorted_key_view(keys, live):
+def sorted_key_view(keys, live, xp=None):
     """Stable key-sorted view of a buffer's key column: live rows first
     (ascending key, ORIGINAL POSITION order within equal keys — an
     explicit position tiebreak, not a stability assumption), dead/padded
@@ -380,21 +380,26 @@ def sorted_key_view(keys, live):
     Shared by the table IndexProbe and the banded equi-join probe in
     ops/join.py (the promoted hot-path use): both answer per-event
     probes with two searchsorteds over this view instead of a [B, T]
-    condition grid."""
+    condition grid. ``xp`` selects the array namespace: jnp (default,
+    in-trace device use) or numpy — the ingest-side reorder buffer
+    (resilience/ordering.py) runs the SAME pad-last lexsort contract on
+    host arrays for its in-buffer timestamp ordering."""
+    if xp is None:
+        xp = jnp
     T = keys.shape[0]
-    if jnp.issubdtype(keys.dtype, jnp.floating):
-        big = jnp.asarray(jnp.inf, keys.dtype)
+    import numpy as _np
+    if _np.issubdtype(_np.dtype(keys.dtype.name), _np.floating):
+        big = xp.asarray(_np.inf, keys.dtype)
     else:
-        import numpy as _np
         big = _np.asarray(_np.iinfo(_np.dtype(keys.dtype.name)).max,
                           keys.dtype.name)
     # pad-last LEXSORT (pad flag primary): a live row whose key equals the
     # padding sentinel (dtype max / +inf) must sort BEFORE the padding so
     # the n_live clamp cannot cut it off
-    ks = jnp.where(live, keys, big)
-    order = jnp.lexsort((jnp.arange(T, dtype=jnp.int32), ks,
-                         (~live).astype(jnp.int8)))
-    return order, ks[order], jnp.sum(live.astype(jnp.int32))
+    ks = xp.where(live, keys, big)
+    order = xp.lexsort((xp.arange(T, dtype=xp.int32), ks,
+                        (~live).astype(xp.int8)))
+    return order, ks[order], xp.sum(live.astype(xp.int32))
 
 
 def band_bounds(sorted_keys, n_live, values, op, act):

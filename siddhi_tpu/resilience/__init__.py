@@ -9,11 +9,15 @@ Reference mapping:
 - scheduled state persistence (PersistenceManager in the reference
   distribution)                                       -> supervisor.py
 - no reference equivalent: faults.py is the seeded chaos harness that
-  makes the recovery paths testable instead of trusted on faith.
+  makes the recovery paths testable instead of trusted on faith, and
+  ordering.py is the event-time robustness layer (per-stream
+  watermarks, bounded-lateness reorder buffers, late-event policies).
 """
 from .errorstore import (ErroredEvent, ErrorStore, FileSystemErrorStore,
                          InMemoryErrorStore, replay)
 from .faults import FaultInjector
+from .ordering import (LATE_POLICIES, ReorderBuffer, WatermarkConfig,
+                       parse_lateness_ms)
 from .supervisor import CheckpointSupervisor
 
 __all__ = [
@@ -23,5 +27,9 @@ __all__ = [
     "FaultInjector",
     "FileSystemErrorStore",
     "InMemoryErrorStore",
+    "LATE_POLICIES",
+    "ReorderBuffer",
+    "WatermarkConfig",
+    "parse_lateness_ms",
     "replay",
 ]

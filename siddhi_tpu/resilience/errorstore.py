@@ -148,30 +148,54 @@ class FileSystemErrorStore(ErrorStore):
 def replay(app, store: ErrorStore) -> int:
     """Re-inject an app's error-store backlog through its junctions.
 
+    Events re-inject in ORIGINAL-TIMESTAMP order (stable: store order
+    breaks ties), not store order — failures are captured as they
+    happen, so the store interleaves streams and retries out of event-
+    time order, and a replay that followed store order would itself
+    re-introduce the disorder recovery is supposed to repair (windows
+    and patterns would fold the backlog in the wrong sequence).
+    Consecutive same-origin runs re-inject as one batch.
+
     At-least-once: records whose origin stream no longer exists stay in
     the store; events that fail again during replay are re-captured by
     the same on-error path that stored them the first time. Returns the
     number of events re-injected.
     """
     records = store.drain(app.name)
-    replayed = 0
+    entries = []  # (ts, capture order, origin, Event)
+    seq = 0
     for rec in records:
-        junction = app.junctions.get(rec.origin)
-        if junction is None:
+        if app.junctions.get(rec.origin) is None:
             store.store(app.name, rec)    # unroutable — keep for later
             log.warning("app '%s': error-store record for unknown stream "
                         "'%s' kept in store", app.name, rec.origin)
             continue
-        events = rec.to_events()
-        handler = app.input_handlers.get(rec.origin)
+        for e in rec.to_events():
+            entries.append((e.timestamp, seq, rec.origin, e))
+            seq += 1
+    entries.sort(key=lambda t: (t[0], t[1]))
+
+    def inject(origin: str, events: list) -> None:
+        handler = app.input_handlers.get(origin)
         if handler is not None and app.running:
             handler.send(events)
         else:
             with app.barrier:
-                app.on_ingest(rec.origin, events)
-                junction.publish(events)
-        replayed += len(events)
+                app.on_ingest(origin, events)
+                app.junctions[origin].publish(events)
+
+    replayed = 0
+    batch_origin, batch = None, []
+    for _, _, origin, e in entries:
+        if origin != batch_origin and batch:
+            inject(batch_origin, batch)
+            batch = []
+        batch_origin = origin
+        batch.append(e)
+        replayed += 1
+    if batch:
+        inject(batch_origin, batch)
     if replayed:
-        log.info("app '%s': replayed %d event(s) from the error store",
-                 app.name, replayed)
+        log.info("app '%s': replayed %d event(s) from the error store "
+                 "in original-timestamp order", app.name, replayed)
     return replayed

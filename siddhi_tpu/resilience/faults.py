@@ -30,6 +30,8 @@ class FaultInjector:
         self.seed = seed
         self.injected = collections.Counter()   # fault kind -> count
         self._patches: list[tuple[object, str, object]] = []
+        # delay_ingest holdback state per patched handler (id -> state)
+        self._delayed: dict = {}
 
     # -- lifecycle --------------------------------------------------------
     def __enter__(self) -> "FaultInjector":
@@ -122,6 +124,141 @@ class FaultInjector:
             return orig(*args, **kwargs)
 
         self._patch(callback, "receive", receive)
+
+    # -- ingest disorder --------------------------------------------------
+    def _np_rng(self):
+        """A numpy Generator derived from the injector's seeded RNG —
+        vectorized chunk perturbation stays deterministic per seed."""
+        import numpy as np
+        return np.random.default_rng(self.rng.randrange(2 ** 32))
+
+    def shuffle_ingest(self, handler, max_skew_ms: int = 100) -> None:
+        """Reorder events on their way into ``handler.send`` /
+        ``send_arrays`` with BOUNDED timestamp skew: seeded uniform
+        jitter in ``[0, max_skew_ms]`` is added to each timestamp for
+        ordering only, and rows are re-sent in jittered order with
+        their original timestamps. An event can only be overtaken by
+        events within ``max_skew_ms`` of its own timestamp, so a
+        reorder buffer with ``lateness >= max_skew_ms`` repairs the
+        disorder exactly (resilience/ordering.py)."""
+        import numpy as np
+        orig_rows, orig_cols = handler.send, handler.send_arrays
+        rng = self._np_rng()
+
+        def send_arrays(ts, cols):
+            ts = np.asarray(ts, dtype=np.int64)
+            jitter = rng.integers(0, max_skew_ms + 1, ts.shape[0])
+            order = np.argsort(ts + jitter, kind="stable")
+            if not np.array_equal(order, np.arange(ts.shape[0])):
+                self.injected["shuffle"] += 1
+            orig_cols(ts[order],
+                      [np.asarray(c)[order] for c in cols])
+
+        def send(data):
+            from ..core.stream import Event
+            if isinstance(data, (list, tuple)) and data and isinstance(
+                    data[0], Event):
+                ts = np.fromiter((e.timestamp for e in data), np.int64,
+                                 len(data))
+                jitter = rng.integers(0, max_skew_ms + 1, len(data))
+                order = np.argsort(ts + jitter, kind="stable")
+                if not np.array_equal(order, np.arange(len(data))):
+                    self.injected["shuffle"] += 1
+                return orig_rows([data[i] for i in order])
+            return orig_rows(data)
+
+        self._patch(handler, "send_arrays", send_arrays)
+        self._patch(handler, "send", send)
+
+    def duplicate_ingest(self, handler, rate: float = 0.1) -> None:
+        """Duplicate rows on the columnar ingest path with seeded
+        probability ``rate``; the copy rides the SAME chunk adjacent to
+        its original (same timestamp + payload), so a reorder buffer
+        with ``dedup='true'`` detects every injected duplicate while
+        both copies share the reorder window."""
+        import numpy as np
+        orig_cols = handler.send_arrays
+        rng = self._np_rng()
+
+        def send_arrays(ts, cols):
+            ts = np.asarray(ts, dtype=np.int64)
+            dup = rng.random(ts.shape[0]) < rate
+            if dup.any():
+                self.injected["duplicate"] += int(dup.sum())
+                idx = np.repeat(np.arange(ts.shape[0]),
+                                1 + dup.astype(np.int64))
+                orig_cols(ts[idx], [np.asarray(c)[idx] for c in cols])
+            else:
+                orig_cols(ts, cols)
+
+        self._patch(handler, "send_arrays", send_arrays)
+
+    def delay_ingest(self, handler, delay_ms: int,
+                     rate: float = 0.05) -> None:
+        """Hold a seeded fraction of rows back and re-inject them once
+        the stream's event-time frontier has advanced ``delay_ms`` past
+        their timestamps — stragglers. With ``delay_ms`` beyond the
+        lateness bound the re-injected rows arrive LATE and exercise
+        the stream's late-event policy. ``release_delayed(handler)``
+        flushes still-held rows at scenario end."""
+        import numpy as np
+        orig_cols = handler.send_arrays
+        rng = self._np_rng()
+        held = {"ts": [], "cols": None, "frontier": None}
+        self._delayed[id(handler)] = (held, orig_cols)
+
+        def send_arrays(ts, cols):
+            ts = np.asarray(ts, dtype=np.int64)
+            cols = [np.asarray(c) for c in cols]
+            take = rng.random(ts.shape[0]) < rate
+            # never hold a whole chunk: the frontier must keep moving
+            if take.all() and ts.shape[0] > 1:
+                take[0] = False
+            if take.any():
+                self.injected["delay"] += int(take.sum())
+                held["ts"].append(ts[take])
+                if held["cols"] is None:
+                    held["cols"] = [[] for _ in cols]
+                for lane, c in zip(held["cols"], cols):
+                    lane.append(c[take])
+                keep = ~take
+                ts, cols = ts[keep], [c[keep] for c in cols]
+            frontier = held["frontier"]
+            if ts.shape[0]:
+                mx = int(ts.max())
+                frontier = mx if frontier is None else max(frontier, mx)
+                held["frontier"] = frontier
+                orig_cols(ts, cols)
+            # re-inject stragglers whose delay has elapsed in event time
+            if held["ts"] and frontier is not None:
+                hts = np.concatenate(held["ts"])
+                due = hts + delay_ms <= frontier
+                if due.any():
+                    hcols = [np.concatenate(lane)
+                             for lane in held["cols"]]
+                    orig_cols(hts[due], [c[due] for c in hcols])
+                    keep = ~due
+                    held["ts"] = [hts[keep]] if keep.any() else []
+                    held["cols"] = [[c[keep]] for c in hcols] \
+                        if keep.any() else None
+
+        self._patch(handler, "send_arrays", send_arrays)
+
+    def release_delayed(self, handler) -> int:
+        """Re-inject every row still held by ``delay_ingest`` (end of
+        scenario); returns the number of rows released."""
+        import numpy as np
+        entry = self._delayed.get(id(handler))
+        if entry is None:
+            return 0
+        held, orig_cols = entry
+        if not held["ts"]:
+            return 0
+        hts = np.concatenate(held["ts"])
+        hcols = [np.concatenate(lane) for lane in held["cols"]]
+        held["ts"], held["cols"] = [], None
+        orig_cols(hts, hcols)
+        return int(hts.shape[0])
 
     # -- persistence ------------------------------------------------------
     def corrupt_saves(self, store, mode: str = "truncate",

@@ -1,0 +1,487 @@
+"""Event-time robustness: per-stream watermarks + bounded-lateness
+reorder buffers on the ingest path.
+
+Real traffic is never in order: a million producers deliver chunks with
+bounded skew, duplicates, and stragglers. Until now the only event-time
+story was ``@app:playback`` — every window, join liveness gate and NFA
+step trusted *arrival* order, so a single late chunk silently corrupted
+results. This module makes time a first-class ingest signal:
+
+- ``ReorderBuffer``: a host-side **columnar** bounded-lateness buffer
+  that sits between ``InputHandler.send/send_arrays`` and the junction
+  publish. Chunks are appended as numpy segments (no per-event Python
+  on the columnar lane); the flush path concatenates, stable-sorts by
+  timestamp (reusing ``ops/table.py sorted_key_view`` — the same
+  pad-last lexsort contract the banded join probe uses for in-buffer
+  ordering, here on the numpy namespace) and releases the prefix at or
+  below the watermark through the normal dispatch machinery, chunked to
+  the same bucketed capacities raw ingest uses — the flush adds **zero
+  new jitted programs** and never perturbs compile-cache keys.
+- **Watermark** per stream: max observed event time minus the
+  configured lateness bound. Releases are watermark-driven, and so is
+  the app's virtual clock (``SiddhiAppRuntime.on_event_time``): windows
+  / joins / patterns fire on watermark progress, not raw arrival.
+  Watermarking implies event-time processing (``@app:playback``).
+- **Late events** (timestamp strictly below the watermark at arrival)
+  resolve per event via ``policy``: ``DROP`` (count + discard),
+  ``PROCESS`` (deliver immediately, out of order, counted), ``STREAM``
+  (side-output to a same-schema stream named by ``late.stream``) or
+  ``STORE`` (capture in the PR 2 error store for replay).
+- **Ordering guarantees**: the sort is stable with an explicit
+  arrival-position tiebreak, so equal-timestamp events keep buffer
+  order and fully in-order input is released bit-identically to the
+  input sequence. Shuffled input within the lateness bound is released
+  in exactly the sorted order an ordered run would see.
+- **Bounded everything**: the buffer capacity is an ``@watermark(...,
+  cap=...)`` dial; overflow force-releases the oldest events ahead of
+  the watermark and counts them (``forced``) — truncation is counted,
+  never silent. Optional ``dedup='true'`` drops exact duplicate rows
+  (same timestamp + payload) while both copies are resident in the
+  reorder window (``duplicates`` counter).
+
+Configuration (parsed generically in ``lang/``, validated at parse
+time by the ``watermark-config`` plan rule in
+``analysis/plan_rules.py``, planner backstop in ``core/runtime.py``)::
+
+    @app:watermark(lateness='200 ms')                  -- every stream
+    @app:watermark(stream='S', lateness='50 ms')       -- one stream
+    @watermark(lateness='100 ms', policy='STORE', cap='16384',
+               dedup='true')                           -- on a definition
+    define stream S (sym string, v int);
+
+Observability: per-stream ``watermark`` / ``watermark.lag_ms`` gauges,
+``reorder.depth`` and the late/dropped/duplicate/forced counters ride
+``statistics()`` and ``/metrics`` (docs/observability.md); the flush
+emits a ``reorder/<sid>`` span with watermark/released/depth
+annotations.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import re
+from typing import Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("siddhi_tpu.resilience")
+
+LATE_POLICIES = ("DROP", "PROCESS", "STREAM", "STORE")
+
+DEFAULT_REORDER_CAP = 65536
+
+_TIME_RE = re.compile(
+    r"(\d+)\s*(millisecond|milliseconds|ms|sec|second|seconds|s|"
+    r"min|minute|minutes|hour|hours|h)?")
+_UNIT_MS = {"millisecond": 1, "milliseconds": 1, "ms": 1,
+            "sec": 1000, "second": 1000, "seconds": 1000, "s": 1000,
+            "min": 60_000, "minute": 60_000, "minutes": 60_000,
+            "hour": 3_600_000, "hours": 3_600_000, "h": 3_600_000}
+
+
+def parse_lateness_ms(value) -> int:
+    """'200 ms' / '2 sec' / bare ms int -> milliseconds; raises
+    ValueError on negative or unparseable lateness."""
+    s = str(value).strip().strip("'\"").strip()
+    if s.startswith("-"):
+        raise ValueError(f"lateness must be >= 0, got '{s}'")
+    m = _TIME_RE.fullmatch(s)
+    if not m:
+        raise ValueError(
+            f"cannot parse lateness '{s}' (expected e.g. '200 ms', "
+            "'2 sec')")
+    return int(m.group(1)) * _UNIT_MS[m.group(2) or "ms"]
+
+
+@dataclasses.dataclass
+class WatermarkConfig:
+    """One stream's event-time contract (from ``@watermark`` /
+    ``@app:watermark`` annotations)."""
+
+    lateness_ms: int
+    policy: str = "DROP"
+    cap: int = DEFAULT_REORDER_CAP
+    dedup: bool = False
+    late_stream: Optional[str] = None  # STREAM policy side-output target
+
+
+def config_from_annotation(ann) -> WatermarkConfig:
+    """Shared parser for ``@watermark``/``@app:watermark`` annotations —
+    the plan rule (`watermark-config`) and the runtime planner both call
+    this, so parse-time validation and runtime behavior cannot drift.
+    Raises ValueError with a user-facing message on any bad element."""
+    def _el(key):
+        v = ann.element(key)
+        return None if v is None else str(v).strip().strip("'\"")
+
+    lateness = _el("lateness")
+    if lateness is None and ann.positional:
+        lateness = str(ann.positional[0]).strip().strip("'\"")
+    if lateness is None:
+        raise ValueError(
+            "@watermark needs a lateness bound, e.g. "
+            "@watermark(lateness='200 ms')")
+    lateness_ms = parse_lateness_ms(lateness)
+    policy = (_el("policy") or "DROP").upper()
+    if policy not in LATE_POLICIES:
+        raise ValueError(
+            f"unknown @watermark policy '{policy}' (expected one of "
+            f"{', '.join(LATE_POLICIES)})")
+    cap_s = _el("cap")
+    cap = DEFAULT_REORDER_CAP
+    if cap_s is not None:
+        try:
+            cap = int(cap_s)
+        except ValueError:
+            cap = 0
+        if cap <= 0:
+            raise ValueError(
+                f"@watermark cap='{cap_s}' must be a positive integer")
+    dedup_s = _el("dedup")
+    dedup = False
+    if dedup_s is not None:
+        if dedup_s.lower() not in ("true", "false"):
+            raise ValueError(
+                f"@watermark dedup='{dedup_s}' must be true or false")
+        dedup = dedup_s.lower() == "true"
+    late_stream = _el("late.stream")
+    if late_stream is not None and policy != "STREAM":
+        raise ValueError(
+            "@watermark late.stream only applies with policy='STREAM'")
+    if policy == "STREAM" and late_stream is None:
+        raise ValueError(
+            "@watermark policy='STREAM' needs late.stream='<defined "
+            "stream with the same schema>'")
+    return WatermarkConfig(lateness_ms=lateness_ms, policy=policy,
+                           cap=cap, dedup=dedup, late_stream=late_stream)
+
+
+def _dedup_keep_mask(ts: np.ndarray, cols: Sequence[np.ndarray]):
+    """Columnar duplicate detection over a release slice already in
+    (timestamp, arrival) order: keep the first arrival of every
+    identical (timestamp + all columns) row. One lexsort + adjacent
+    compares — no per-event host loop."""
+    n = ts.shape[0]
+    seq = np.arange(n, dtype=np.int64)
+    # lexsort: last key is primary. Group identical rows (ts + payload);
+    # seq least-significant so the first arrival leads its group.
+    order = np.lexsort(tuple([seq] + [np.ascontiguousarray(c)
+                                      for c in cols] + [ts]))
+    dup_sorted = np.zeros(n, dtype=bool)
+    if n > 1:
+        same = ts[order][1:] == ts[order][:-1]
+        for c in cols:
+            cs = c[order]
+            same &= cs[1:] == cs[:-1]
+        dup_sorted[1:] = same
+    keep = np.ones(n, dtype=bool)
+    keep[order] = ~dup_sorted
+    return keep
+
+
+class ReorderBuffer:
+    """Bounded-lateness reorder buffer for ONE stream. Methods are
+    called with the app barrier held (the InputHandler takes it), so a
+    concurrent snapshot never observes a half-applied flush.
+
+    Two lanes share the watermark/policy machinery:
+
+    - columnar (``ingest_columns``): numpy segments, vectorized flush;
+    - row (``ingest_rows``): host Event lists (the row path is
+      per-event at ingest already). Mixing lanes on one stream coerces
+      pending columnar segments to rows (rare; documented).
+    """
+
+    def __init__(self, stream_id: str, schema, conf: WatermarkConfig):
+        self.stream_id = stream_id
+        self.schema = schema
+        self.conf = conf
+        self.handler = None        # wired by the planner (InputHandler)
+        self.late_junction = None  # wired for policy='STREAM'
+        self.max_ts: Optional[int] = None  # event-time frontier
+        self._lane: Optional[str] = None   # None | 'cols' | 'rows'
+        self._pend_ts: list[np.ndarray] = []
+        self._pend_cols: list[list[np.ndarray]] = []
+        self._pend_rows: list = []
+        self.depth = 0
+        self.counters = {
+            "late": 0, "late_dropped": 0, "late_processed": 0,
+            "late_streamed": 0, "late_stored": 0,
+            "duplicates": 0, "forced": 0, "released": 0,
+        }
+
+    # -- watermark -------------------------------------------------------
+    @property
+    def watermark(self) -> Optional[int]:
+        """Max observed event time minus the lateness bound (None until
+        the first event)."""
+        if self.max_ts is None:
+            return None
+        return self.max_ts - self.conf.lateness_ms
+
+    @property
+    def lag_ms(self) -> int:
+        """Distance between the stream's event-time frontier and its
+        watermark (== the lateness bound once traffic flows)."""
+        wm = self.watermark
+        return 0 if wm is None else int(self.max_ts - wm)
+
+    # -- ingest ----------------------------------------------------------
+    def ingest_columns(self, ts, cols) -> None:
+        ts = np.ascontiguousarray(ts, dtype=np.int64)
+        cols = [np.ascontiguousarray(c) for c in cols]
+        wm = self.watermark
+        if wm is not None:
+            late = ts < wm
+            if late.any():
+                keep = ~late
+                self._route_late_cols(ts[late], [c[late] for c in cols],
+                                      wm)
+                ts = ts[keep]
+                cols = [c[keep] for c in cols]
+        if len(ts):
+            mx = int(ts.max())
+            self.max_ts = mx if self.max_ts is None else max(self.max_ts,
+                                                             mx)
+            if self._lane == "rows":
+                self._pend_rows.extend(self._decode_rows(ts, cols))
+            else:
+                self._lane = "cols"
+                self._pend_ts.append(ts)
+                self._pend_cols.append(cols)
+            self.depth += len(ts)
+        self._flush_and_advance()
+
+    def ingest_rows(self, events) -> None:
+        wm = self.watermark
+        if wm is not None:
+            late = [e for e in events if e.timestamp < wm]
+            if late:
+                events = [e for e in events if e.timestamp >= wm]
+                self._route_late_rows(late, wm)
+        if events:
+            mx = max(e.timestamp for e in events)
+            self.max_ts = mx if self.max_ts is None else max(
+                self.max_ts, mx)
+            if self._lane == "cols" and self.depth:
+                # lane coercion: decode pending columnar segments so one
+                # stable sort covers everything (mixed ingest is rare)
+                self._pend_rows = [
+                    e for t, cs in zip(self._pend_ts, self._pend_cols)
+                    for e in self._decode_rows(t, cs)]
+                self._pend_ts, self._pend_cols = [], []
+            self._lane = "rows"
+            self._pend_rows.extend(events)
+            self.depth += len(events)
+        self._flush_and_advance()
+
+    # -- flush -----------------------------------------------------------
+    def _flush_and_advance(self) -> None:
+        forced = max(0, self.depth - self.conf.cap)
+        self.flush(min_release=forced)
+        app = self.handler.app
+        wm = app.global_watermark()
+        if wm is not None:
+            app.on_event_time(wm)
+
+    def flush(self, min_release: int = 0, final: bool = False) -> int:
+        """Release every buffered event at or below the watermark (all
+        of them when ``final``), stable-sorted by timestamp with buffer
+        order preserved among equal timestamps. ``min_release`` forces
+        that many oldest events out ahead of the watermark (capacity
+        overflow — counted as ``forced``, never silent). Returns the
+        number of events released."""
+        if self.depth == 0:
+            return 0
+        wm = self.watermark
+        if self._lane == "cols":
+            return self._flush_cols(wm, min_release, final)
+        return self._flush_rows(wm, min_release, final)
+
+    def _cut(self, sorted_ts: np.ndarray, wm, min_release: int,
+             final: bool) -> int:
+        n = sorted_ts.shape[0]
+        if final:
+            return n
+        cut = 0 if wm is None else int(
+            np.searchsorted(sorted_ts, wm, side="right"))
+        if min_release > cut:
+            self.counters["forced"] += min_release - cut
+            log.warning(
+                "stream '%s': reorder buffer over capacity (%d); "
+                "force-releasing %d event(s) ahead of the watermark",
+                self.stream_id, self.conf.cap, min_release - cut)
+            cut = min(min_release, n)
+        return cut
+
+    def _stable_order(self, ts_all: np.ndarray):
+        """Stable timestamp sort with an explicit arrival-position
+        tiebreak — ops/table.py sorted_key_view on the numpy namespace
+        (every buffered row is live; the pad-last clamp is inert)."""
+        from ..ops.table import sorted_key_view
+        order, sorted_ts, _ = sorted_key_view(
+            ts_all, np.ones(ts_all.shape[0], dtype=bool), xp=np)
+        return order, sorted_ts
+
+    def _flush_cols(self, wm, min_release: int, final: bool) -> int:
+        ts_all = self._pend_ts[0] if len(self._pend_ts) == 1 \
+            else np.concatenate(self._pend_ts)
+        order, sorted_ts = self._stable_order(ts_all)
+        cut = self._cut(sorted_ts, wm, min_release, final)
+        if cut == 0:
+            return 0
+        cols_all = [seg[0] if len(self._pend_cols) == 1
+                    else np.concatenate(seg)
+                    for seg in zip(*self._pend_cols)]
+        rel_idx = order[:cut]
+        rel_ts = ts_all[rel_idx]
+        rel_cols = [c[rel_idx] for c in cols_all]
+        if self.conf.dedup and cut > 1:
+            keep = _dedup_keep_mask(rel_ts, rel_cols)
+            ndup = int(cut - keep.sum())
+            if ndup:
+                self.counters["duplicates"] += ndup
+                rel_ts = rel_ts[keep]
+                rel_cols = [c[keep] for c in rel_cols]
+        rem_idx = np.sort(order[cut:])  # arrival order preserved
+        if rem_idx.size:
+            self._pend_ts = [ts_all[rem_idx]]
+            self._pend_cols = [[c[rem_idx] for c in cols_all]]
+        else:
+            self._pend_ts, self._pend_cols = [], []
+            self._lane = None
+        self.depth -= cut
+        self.counters["released"] += int(rel_ts.shape[0])
+        self._emit_cols(rel_ts, rel_cols, wm)
+        return cut
+
+    def _flush_rows(self, wm, min_release: int, final: bool) -> int:
+        rows = self._pend_rows
+        ts_all = np.fromiter((e.timestamp for e in rows), np.int64,
+                             len(rows))
+        order, sorted_ts = self._stable_order(ts_all)
+        cut = self._cut(sorted_ts, wm, min_release, final)
+        if cut == 0:
+            return 0
+        rel = [rows[i] for i in order[:cut]]
+        if self.conf.dedup and cut > 1:
+            seen = set()
+            kept = []
+            for e in rel:
+                key = (e.timestamp, e.data, e.is_expired)
+                if key in seen:
+                    self.counters["duplicates"] += 1
+                else:
+                    seen.add(key)
+                    kept.append(e)
+            rel = kept
+        self._pend_rows = [rows[i] for i in np.sort(order[cut:])]
+        if not self._pend_rows:
+            self._lane = None
+        self.depth -= cut
+        self.counters["released"] += len(rel)
+        self._emit_rows(rel, wm)
+        return cut
+
+    def _emit_cols(self, ts, cols, wm) -> None:
+        from ..obs.tracing import maybe_span
+        with maybe_span(self.handler.app, "reorder", self.stream_id,
+                        watermark=-1 if wm is None else int(wm),
+                        released=int(ts.shape[0]), depth=self.depth):
+            self.handler._dispatch_arrays(ts, cols, mark=False)
+
+    def _emit_rows(self, events, wm) -> None:
+        from ..obs.tracing import maybe_span
+        with maybe_span(self.handler.app, "reorder", self.stream_id,
+                        watermark=-1 if wm is None else int(wm),
+                        released=len(events), depth=self.depth):
+            self.handler._dispatch_rows(events)
+
+    # -- late-event policies ---------------------------------------------
+    def _route_late_cols(self, ts, cols, wm: int) -> None:
+        n = int(ts.shape[0])
+        self.counters["late"] += n
+        policy = self.conf.policy
+        if policy == "DROP":
+            self.counters["late_dropped"] += n
+        elif policy == "PROCESS":
+            self.counters["late_processed"] += n
+            self.handler._dispatch_arrays(ts, cols, mark=False)
+        else:
+            self._late_as_rows(self._decode_rows(ts, cols), wm)
+
+    def _route_late_rows(self, events, wm: int) -> None:
+        self.counters["late"] += len(events)
+        policy = self.conf.policy
+        if policy == "DROP":
+            self.counters["late_dropped"] += len(events)
+        elif policy == "PROCESS":
+            self.counters["late_processed"] += len(events)
+            self.handler._dispatch_rows(events)
+        else:
+            self._late_as_rows(events, wm)
+
+    def _late_as_rows(self, events, wm: int) -> None:
+        app = self.handler.app
+        if self.conf.policy == "STREAM" and self.late_junction is not None:
+            self.counters["late_streamed"] += len(events)
+            self.late_junction.publish(events)
+            return
+        # STORE: capture in the error store for replay (replay re-sorts
+        # by original timestamp, so recovery cannot re-introduce
+        # disorder — resilience/errorstore.py)
+        from .errorstore import ErroredEvent
+        self.counters["late_stored"] += len(events)
+        app._error_store().store(app.name, ErroredEvent.from_events(
+            self.stream_id, events,
+            f"late event: timestamp below watermark {wm} "
+            f"(lateness {self.conf.lateness_ms} ms)",
+            now=app.current_time()))
+
+    def _decode_rows(self, ts: np.ndarray, cols) -> list:
+        """Columnar slice -> host Events (STRING dictionary codes decode
+        back to strings). Only late-policy side paths and lane coercion
+        pay this; the flush hot path stays columnar."""
+        from ..core.stream import Event
+        from ..core.types import AttrType, GLOBAL_STRINGS
+        pycols = []
+        for t, c in zip(self.schema.types, cols):
+            if t is AttrType.STRING:
+                pycols.append([GLOBAL_STRINGS.decode(int(x)) for x in c])
+            elif t is AttrType.BOOL:
+                pycols.append([bool(x) for x in c])
+            elif t in (AttrType.FLOAT, AttrType.DOUBLE):
+                pycols.append([float(x) for x in c])
+            else:
+                pycols.append([int(x) for x in c])
+        return [Event(int(t), tuple(vals))
+                for t, vals in zip(ts.tolist(), zip(*pycols))] if pycols \
+            else [Event(int(t), ()) for t in ts.tolist()]
+
+    # -- checkpoint ------------------------------------------------------
+    def snapshot_state(self) -> dict:
+        """Pure-data snapshot (numpy + tuples only — the restricted
+        snapshot unpickler admits nothing else)."""
+        return {
+            "lane": self._lane,
+            "max_ts": self.max_ts,
+            "cols": [(t, list(cs)) for t, cs in
+                     zip(self._pend_ts, self._pend_cols)],
+            "rows": [(e.timestamp, tuple(e.data), e.is_expired)
+                     for e in self._pend_rows],
+            "counters": dict(self.counters),
+        }
+
+    def restore_state(self, snap: dict) -> None:
+        from ..core.stream import Event
+        self._lane = snap["lane"]
+        self.max_ts = snap["max_ts"]
+        self._pend_ts = [np.asarray(t, dtype=np.int64)
+                         for t, _ in snap["cols"]]
+        self._pend_cols = [[np.asarray(c) for c in cs]
+                           for _, cs in snap["cols"]]
+        self._pend_rows = [Event(ts, tuple(data), is_expired=exp)
+                           for ts, data, exp in snap["rows"]]
+        self.depth = sum(len(t) for t in self._pend_ts) + \
+            len(self._pend_rows)
+        self.counters.update(snap.get("counters", {}))

@@ -149,6 +149,98 @@ def run_corrupt_snapshot_fallback(seed: int = 0) -> dict:
     }
 
 
+DISORDER_APP = """
+    @app:name('chaosdisorder')
+    @app:watermark(lateness='64', dedup='true')
+    define stream L (k int, v int);
+    define stream R (k int, w int);
+    @info(name = 'j')
+    from L#window.time(200) as a join R#window.time(200) as b
+      on a.k == b.k
+    select a.k as k, a.v as v, b.w as w
+    insert into J;
+    @info(name = 'agg')
+    from L#window.lengthBatch(32)
+    select sum(v) as total
+    insert into W;
+"""
+
+
+def run_disorder_equivalence(seed: int = 0, n: int = 512,
+                             chunk: int = 64) -> dict:
+    """Windowed + joined app under bounded ingest disorder.
+
+    The same seeded traffic is run twice through the watermarked app
+    (resilience/ordering.py): once in order, once with per-chunk
+    bounded shuffling on BOTH streams plus seeded duplicate injection
+    on the left stream. The reorder buffer (lateness 64 ms >= the
+    48 ms injected skew) must re-sort every chunk and ``dedup='true'``
+    must swallow every injected duplicate, so the join + windowed
+    aggregation outputs are BIT-EQUAL to the ordered run's — the
+    event-time invariant under chaos.
+    """
+    import numpy as np
+
+    from .. import SiddhiManager
+    from ..core.stream import StreamCallback
+    from .faults import FaultInjector
+
+    def _traffic():
+        rng = np.random.default_rng(seed * 7919 + 17)
+        base = 1_000_000
+        chunks = []
+        for c in range(n // chunk):
+            # strictly increasing, interleaved timestamps (equal-ts
+            # arrival order is buffer order — distinct ts keep the
+            # shuffled run's release order fully determined)
+            off = base + c * chunk * 4
+            lts = off + 4 * np.arange(chunk, dtype=np.int64)
+            rts = off + 4 * np.arange(chunk, dtype=np.int64) + 2
+            k_l = rng.integers(0, 8, chunk).astype(np.int32)
+            k_r = rng.integers(0, 8, chunk).astype(np.int32)
+            v = rng.integers(0, 1000, chunk).astype(np.int32)
+            w = rng.integers(0, 1000, chunk).astype(np.int32)
+            chunks.append((lts, [k_l, v], rts, [k_r, w]))
+        return chunks
+
+    def _run(disorder: bool):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(DISORDER_APP)
+        got_j, got_w = [], []
+        rt.add_callback("J", StreamCallback(fn=lambda evs: got_j.extend(
+            (e.timestamp, tuple(e.data), e.is_expired) for e in evs)))
+        rt.add_callback("W", StreamCallback(fn=lambda evs: got_w.extend(
+            (e.timestamp, tuple(e.data), e.is_expired) for e in evs)))
+        rt.start()
+        hl = rt.get_input_handler("L")
+        hr = rt.get_input_handler("R")
+        with FaultInjector(seed=seed) as fi:
+            if disorder:
+                fi.shuffle_ingest(hl, max_skew_ms=48)
+                fi.shuffle_ingest(hr, max_skew_ms=48)
+                fi.duplicate_ingest(hl, rate=0.15)
+            for lts, lcols, rts, rcols in _traffic():
+                hl.send_arrays(lts, lcols)
+                hr.send_arrays(rts, rcols)
+            injected = dict(fi.injected)
+        rt.shutdown()   # final watermark flush releases the tail
+        counters = {sid: dict(b.counters)
+                    for sid, b in rt._reorder.items()}
+        return got_j, got_w, injected, counters
+
+    oj, ow, _, _ = _run(disorder=False)
+    dj, dw, injected, counters = _run(disorder=True)
+    return {
+        "equal": oj == dj and ow == dw,
+        "join_ordered": len(oj), "join_disorder": len(dj),
+        "window_ordered": len(ow), "window_disorder": len(dw),
+        "injected": injected,
+        "reorder": counters,
+        "duplicates_detected": counters.get("L", {}).get("duplicates", 0),
+        "late": sum(c.get("late", 0) for c in counters.values()),
+    }
+
+
 def run_soak(seed: int = 0, rounds: int = 5) -> list[dict]:
     """Repeat the outage scenario with per-round derived seeds and a
     seeded probabilistic drop-rate — the long-running chaos soak."""

@@ -286,6 +286,43 @@ class TestStatisticsRegistryEquivalence:
         assert flat[f"{p}.pending"] == 0
         rt.shutdown()
 
+    def test_watermark_and_reorder_gauges(self):
+        """Event-time robustness metrics (resilience/ordering.py):
+        watermark position/lag, reorder-buffer depth and the late/
+        dropped counters surface in BOTH the registry dump and
+        statistics()['reorder'] (docs/observability.md)."""
+        rt = _playback_app("""
+            @app:watermark(lateness='16', policy='DROP')
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """, level="BASIC")
+        h = rt.get_input_handler("S")
+        h.send_arrays(TS0 + np.arange(64, dtype=np.int64) * 4,
+                      [np.arange(64, dtype=np.int32)])
+        h.send_arrays(np.array([TS0 + 1], np.int64),
+                      [np.array([-1], np.int32)])   # late -> dropped
+        flat, report = rt._collect_observability()
+        p = f"siddhi.{rt.name}.stream.S"
+        wm = TS0 + 63 * 4 - 16
+        assert flat[f"{p}.watermark"] == wm
+        assert flat[f"{p}.watermark.lag_ms"] == 16
+        assert flat[f"{p}.reorder.depth"] > 0      # tail within lateness
+        assert flat[f"{p}.reorder.late"] == 1
+        assert flat[f"{p}.reorder.late_dropped"] == 1
+        assert flat[f"{p}.reorder.released"] + \
+            flat[f"{p}.reorder.depth"] == 64
+        rep = report["reorder"]["S"]
+        assert rep["watermark"] == wm
+        assert rep["depth"] == flat[f"{p}.reorder.depth"]
+        assert rep["late_dropped"] == 1
+        # same numbers through the registry collector walk (/metrics)
+        assert rt.metrics.collect()[f"{p}.watermark"] == wm
+        text = rt.metrics.prometheus_text()
+        assert prom_name(f"{p}.watermark.lag_ms") in text
+        assert prom_name(f"{p}.reorder.depth") in text
+        rt.shutdown()
+        assert rt.metrics.collect()[f"{p}.reorder.depth"] == 0
+
     def test_checkpoint_age_gauge(self):
         from siddhi_tpu.resilience.supervisor import CheckpointSupervisor
         rt = _playback_app(CHAIN_APP, level="BASIC")

@@ -1,0 +1,589 @@
+"""Event-time robustness tests (siddhi_tpu/resilience/ordering.py):
+watermarks, bounded-lateness reorder buffers, late-event policies, and
+the disorder-equivalence sweep — input shuffled within the lateness
+bound must produce BIT-EQUAL outputs to ordered input across window,
+join, pattern and partition apps, because the reorder buffer re-sorts
+releases and the virtual clock advances on watermark progress instead
+of arrival order.
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import Event, SiddhiManager
+from siddhi_tpu.core.stream import StreamCallback
+from siddhi_tpu.ops.expr import CompileError
+from siddhi_tpu.resilience.faults import FaultInjector
+from siddhi_tpu.resilience.ordering import (ReorderBuffer, WatermarkConfig,
+                                            parse_lateness_ms)
+
+TS0 = 1_000_000
+
+
+def _collect(rt, stream):
+    got = []
+    rt.add_callback(stream, StreamCallback(fn=lambda evs: got.extend(
+        (e.timestamp, tuple(e.data), e.is_expired) for e in evs)))
+    return got
+
+
+def _mk_chunks(seed, n, chunk, n_cols=2, stride=4, lo=0, hi=1000):
+    """Strictly-increasing timestamps + seeded int payload columns."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for c in range(n // chunk):
+        ts = TS0 + (c * chunk + np.arange(chunk, dtype=np.int64)) * stride
+        cols = [rng.integers(lo, hi, chunk).astype(np.int32)
+                for _ in range(n_cols)]
+        out.append((ts, cols))
+    return out
+
+
+def _shuffle_within(ts, cols, rng, skew):
+    jitter = rng.integers(0, skew + 1, ts.shape[0])
+    order = np.argsort(ts + jitter, kind="stable")
+    return ts[order], [c[order] for c in cols]
+
+
+# ---------------------------------------------------------------------------
+# disorder-equivalence sweep: window / join / pattern / partition
+# ---------------------------------------------------------------------------
+
+WINDOW_APP = """
+    @app:watermark(lateness='64')
+    define stream S (k int, v int);
+    @info(name = 'q')
+    from S#window.time(200)
+    select k, sum(v) as total
+    insert into Out;
+"""
+
+LENGTH_BATCH_APP = """
+    @app:watermark(lateness='64')
+    define stream S (k int, v int);
+    @info(name = 'q')
+    from S#window.lengthBatch(32)
+    select sum(v) as total
+    insert into Out;
+"""
+
+JOIN_APP = """
+    @app:watermark(lateness='64')
+    define stream L (k int, v int);
+    define stream R (k int, w int);
+    @info(name = 'j')
+    from L#window.time(200) as a join R#window.time(200) as b
+      on a.k == b.k
+    select a.k as k, a.v as v, b.w as w
+    insert into Out;
+"""
+
+PATTERN_APP = """
+    @app:watermark(lateness='64')
+    define stream S (k int, v int);
+    @info(name = 'p')
+    from every e1=S[v > 800] -> e2=S[k == e1.k and v < 100]
+    within 10 sec
+    select e1.k as k, e1.v as v1, e2.v as v2
+    insert into Out;
+"""
+
+PARTITION_APP = """
+    @app:watermark(lateness='64')
+    define stream S (k int, v int);
+    partition with (k of S) begin
+      @info(name = 'pq')
+      from S select k, sum(v) as total insert into Out;
+    end;
+"""
+
+
+def _run_single(ql, seed, disorder, n=256, chunk=64, skew=48):
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "Out")
+    rt.start()
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(seed + 1)
+    for ts, cols in _mk_chunks(seed, n, chunk):
+        if disorder:
+            ts, cols = _shuffle_within(ts, cols, rng, skew)
+        h.send_arrays(ts, cols)
+    rt.shutdown()
+    return got
+
+
+@pytest.mark.parametrize("ql", [WINDOW_APP, LENGTH_BATCH_APP, PATTERN_APP,
+                                PARTITION_APP],
+                         ids=["time-window", "length-batch", "pattern",
+                              "partition"])
+def test_disorder_equivalence_single_stream(ql):
+    ordered = _run_single(ql, seed=11, disorder=False)
+    shuffled = _run_single(ql, seed=11, disorder=True)
+    assert len(ordered) > 0
+    assert shuffled == ordered
+
+
+def test_disorder_equivalence_join():
+    def run(disorder):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(JOIN_APP)
+        got = _collect(rt, "Out")
+        rt.start()
+        hl, hr = rt.get_input_handler("L"), rt.get_input_handler("R")
+        rng = np.random.default_rng(5)
+        lchunks = _mk_chunks(21, 256, 64, lo=0, hi=8)
+        rchunks = _mk_chunks(22, 256, 64, lo=0, hi=8)
+        for (lts, lcols), (rts, rcols) in zip(lchunks, rchunks):
+            rts = rts + 2  # interleave: distinct cross-stream timestamps
+            if disorder:
+                lts, lcols = _shuffle_within(lts, lcols, rng, 48)
+                rts, rcols = _shuffle_within(rts, rcols, rng, 48)
+            hl.send_arrays(lts, lcols)
+            hr.send_arrays(rts, rcols)
+        rt.shutdown()
+        return got
+
+    ordered = run(False)
+    shuffled = run(True)
+    assert len(ordered) > 0
+    assert shuffled == ordered
+
+
+def test_disorder_equivalence_cross_chunk_shuffle():
+    """Disorder crossing chunk boundaries: globally jitter-shuffle the
+    whole input, re-chunk, and compare against the ordered run — the
+    watermark cut points differ between runs, so this also asserts the
+    released-chunk-boundary invariance of the downstream pipeline."""
+    def run(shuffled):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(WINDOW_APP)
+        got = _collect(rt, "Out")
+        rt.start()
+        h = rt.get_input_handler("S")
+        n, chunk = 256, 64
+        ts = TS0 + np.arange(n, dtype=np.int64) * 4
+        rng = np.random.default_rng(3)
+        cols = [rng.integers(0, 8, n).astype(np.int32),
+                rng.integers(0, 1000, n).astype(np.int32)]
+        if shuffled:
+            ts, cols = _shuffle_within(ts, cols,
+                                       np.random.default_rng(9), 48)
+        for s in range(0, n, chunk):
+            h.send_arrays(ts[s:s + chunk], [c[s:s + chunk] for c in cols])
+        rt.shutdown()
+        return got
+
+    ordered = run(False)
+    shuffled = run(True)
+    assert len(ordered) > 0
+    assert shuffled == ordered
+
+
+def test_in_order_input_bit_equal_to_unbuffered():
+    """Fully in-order input through the reorder buffer must emit the
+    exact event sequence today's unbuffered path emits (stable sort,
+    buffer order among equal timestamps, final flush catches the
+    tail)."""
+    plain = WINDOW_APP.replace("@app:watermark(lateness='64')",
+                               "@app:playback")
+    def run(ql):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = _collect(rt, "Out")
+        rt.start()
+        h = rt.get_input_handler("S")
+        for ts, cols in _mk_chunks(7, 256, 64):
+            h.send_arrays(ts, cols)
+        rt.shutdown()
+        return got
+
+    assert run(WINDOW_APP) == run(plain)
+
+
+def test_row_path_disorder_equivalence():
+    """send() (row path) through the buffer: shuffled Events within the
+    bound release sorted and match the ordered run."""
+    def run(order):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(LENGTH_BATCH_APP)
+        got = _collect(rt, "Out")
+        rt.start()
+        h = rt.get_input_handler("S")
+        events = [Event(TS0 + 4 * i, (i % 8, i)) for i in range(96)]
+        for e in (events if order else
+                  [events[i] for i in np.argsort(
+                      np.arange(96) * 4 + np.random.default_rng(2)
+                      .integers(0, 12, 96), kind="stable")]):
+            h.send(e)
+        rt.shutdown()
+        return got
+
+    ordered = run(True)
+    shuffled = run(False)
+    assert len(ordered) > 0
+    assert shuffled == ordered
+
+
+# ---------------------------------------------------------------------------
+# late-event policies
+# ---------------------------------------------------------------------------
+
+def _policy_app(policy, extra=""):
+    return f"""
+        @app:watermark(lateness='16', policy='{policy}'{extra})
+        define stream S (v int);
+        define stream LateS (v int);
+        @info(name = 'q') from S select v insert into Out;
+    """
+
+
+def _send_with_straggler(rt):
+    rt.start()
+    h = rt.get_input_handler("S")
+    ts = TS0 + np.arange(64, dtype=np.int64) * 4
+    h.send_arrays(ts, [np.arange(64, dtype=np.int32)])
+    # straggler far below the watermark (wm = TS0 + 63*4 - 16)
+    h.send_arrays(np.array([TS0 + 2], np.int64),
+                  [np.array([-1], np.int32)])
+    return h
+
+
+class TestLatePolicies:
+    def test_drop_counts(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(_policy_app("DROP"))
+        got = _collect(rt, "Out")
+        _send_with_straggler(rt)
+        rt.shutdown()
+        buf = rt._reorder["S"]
+        assert buf.counters["late"] == 1
+        assert buf.counters["late_dropped"] == 1
+        assert -1 not in [g[1][0] for g in got]
+        assert len(got) == 64
+
+    def test_process_delivers_out_of_order(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(_policy_app("PROCESS"))
+        got = _collect(rt, "Out")
+        _send_with_straggler(rt)
+        rt.shutdown()
+        buf = rt._reorder["S"]
+        assert buf.counters["late_processed"] == 1
+        assert -1 in [g[1][0] for g in got]
+        assert len(got) == 65
+
+    def test_store_lands_in_error_store(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(_policy_app("STORE"))
+        _collect(rt, "Out")
+        _send_with_straggler(rt)
+        store = rt._error_store()
+        assert rt._reorder["S"].counters["late_stored"] == 1
+        recs = store.peek(rt.name)
+        assert len(recs) == 1
+        assert recs[0].origin == "S"
+        assert "late event" in recs[0].cause
+        assert recs[0].events[0] == (TS0 + 2, (-1,), False)
+        rt.shutdown()
+
+    def test_stream_side_output_schema(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            _policy_app("STREAM", extra=", late.stream='LateS'"))
+        got_main = _collect(rt, "Out")
+        got_late = _collect(rt, "LateS")
+        _send_with_straggler(rt)
+        rt.shutdown()
+        assert rt._reorder["S"].counters["late_streamed"] == 1
+        # side output carries the ORIGINAL schema + timestamp
+        assert got_late == [(TS0 + 2, (-1,), False)]
+        assert -1 not in [g[1][0] for g in got_main]
+
+    def test_row_path_late_drop(self):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(_policy_app("DROP"))
+        got = _collect(rt, "Out")
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send([Event(TS0 + 4 * i, (i,)) for i in range(32)])
+        h.send(Event(TS0 + 1, (-1,)))   # below wm = TS0 + 124 - 16
+        rt.shutdown()
+        assert rt._reorder["S"].counters["late_dropped"] == 1
+        assert len(got) == 32
+
+
+# ---------------------------------------------------------------------------
+# dedup / capacity / config
+# ---------------------------------------------------------------------------
+
+def test_dedup_drops_exact_duplicates():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:watermark(lateness='16', dedup='true')
+        define stream S (v int);
+        @info(name = 'q') from S select v insert into Out;
+    """)
+    got = _collect(rt, "Out")
+    rt.start()
+    h = rt.get_input_handler("S")
+    ts = TS0 + np.arange(32, dtype=np.int64) * 4
+    v = np.arange(32, dtype=np.int32)
+    idx = np.repeat(np.arange(32), 1 + (np.arange(32) % 4 == 0))
+    h.send_arrays(ts[idx], [v[idx]])     # every 4th row duplicated
+    rt.shutdown()
+    assert rt._reorder["S"].counters["duplicates"] == 8
+    assert len(got) == 32                # duplicates swallowed
+    assert [g[1][0] for g in got] == list(range(32))
+
+
+def test_dedup_keeps_distinct_equal_timestamp_rows():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:watermark(lateness='16', dedup='true')
+        define stream S (v int);
+        @info(name = 'q') from S select v insert into Out;
+    """)
+    got = _collect(rt, "Out")
+    rt.start()
+    h = rt.get_input_handler("S")
+    # same timestamp, different payloads: NOT duplicates
+    h.send_arrays(np.array([TS0, TS0, TS0 + 4], np.int64),
+                  [np.array([1, 2, 3], np.int32)])
+    rt.shutdown()
+    assert rt._reorder["S"].counters["duplicates"] == 0
+    assert [g[1][0] for g in got] == [1, 2, 3]
+
+
+def test_capacity_overflow_counted_never_silent():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:watermark(lateness='100000', cap='32')
+        define stream S (v int);
+        @info(name = 'q') from S select v insert into Out;
+    """)
+    got = _collect(rt, "Out")
+    rt.start()
+    h = rt.get_input_handler("S")
+    ts = TS0 + np.arange(96, dtype=np.int64)   # all within lateness
+    h.send_arrays(ts, [np.arange(96, dtype=np.int32)])
+    buf = rt._reorder["S"]
+    assert buf.depth == 32                      # capped
+    assert buf.counters["forced"] == 64         # counted, not silent
+    assert len(got) == 64                       # force-released in order
+    rt.shutdown()
+    assert len(got) == 96                       # nothing lost
+
+def test_equal_timestamps_preserve_buffer_order():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:watermark(lateness='8')
+        define stream S (v int);
+        @info(name = 'q') from S select v insert into Out;
+    """)
+    got = _collect(rt, "Out")
+    rt.start()
+    h = rt.get_input_handler("S")
+    ts = np.full(16, TS0, np.int64)
+    h.send_arrays(ts, [np.arange(16, dtype=np.int32)])
+    rt.shutdown()
+    assert [g[1][0] for g in got] == list(range(16))
+
+
+def test_watermark_none_before_traffic_and_lag_after():
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(WINDOW_APP)
+    buf = rt._reorder["S"]
+    assert buf.watermark is None and buf.lag_ms == 0
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send_arrays(np.array([TS0 + 100], np.int64),
+                  [np.zeros(1, np.int32), np.zeros(1, np.int32)])
+    assert buf.watermark == TS0 + 100 - 64
+    assert buf.lag_ms == 64
+    assert rt.global_watermark() == buf.watermark
+    rt.shutdown()
+
+
+def test_snapshot_restore_keeps_buffered_events():
+    ql = """
+        @app:watermark(lateness='1000')
+        define stream S (v int);
+        @info(name = 'q') from S select v insert into Out;
+    """
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("S")
+    ts = TS0 + np.arange(16, dtype=np.int64)
+    h.send_arrays(ts, [np.arange(16, dtype=np.int32)])
+    assert rt._reorder["S"].depth == 16      # all within lateness
+    snap = rt.snapshot()
+    rt.shutdown()
+
+    rt2 = mgr.create_siddhi_app_runtime(ql)
+    got = _collect(rt2, "Out")
+    rt2.start()
+    rt2.restore(snap)
+    assert rt2._reorder["S"].depth == 16
+    rt2.shutdown()                            # final flush releases them
+    assert [g[1][0] for g in got] == list(range(16))
+
+
+# ---------------------------------------------------------------------------
+# config validation (watermark-config plan rule + planner backstop)
+# ---------------------------------------------------------------------------
+
+class TestWatermarkValidation:
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(CompileError, match="watermark.*polic"):
+            SiddhiManager().create_siddhi_app_runtime("""
+                @app:watermark(lateness='10', policy='TELEPORT')
+                define stream S (v int);
+                from S select v insert into Out;
+            """)
+
+    def test_negative_lateness_rejected(self):
+        with pytest.raises(CompileError, match="lateness"):
+            SiddhiManager().create_siddhi_app_runtime("""
+                @app:watermark(lateness='-5')
+                define stream S (v int);
+                from S select v insert into Out;
+            """)
+
+    def test_undefined_stream_target_rejected(self):
+        with pytest.raises(CompileError, match="undefined stream"):
+            SiddhiManager().create_siddhi_app_runtime("""
+                @app:watermark(stream='Nope', lateness='10')
+                define stream S (v int);
+                from S select v insert into Out;
+            """)
+
+    def test_stream_policy_needs_late_stream(self):
+        with pytest.raises(CompileError, match="late.stream"):
+            SiddhiManager().create_siddhi_app_runtime("""
+                @app:watermark(lateness='10', policy='STREAM')
+                define stream S (v int);
+                from S select v insert into Out;
+            """)
+
+    def test_late_stream_schema_mismatch_rejected(self):
+        with pytest.raises(CompileError, match="schema"):
+            SiddhiManager().create_siddhi_app_runtime("""
+                define stream Late (v string);
+                @watermark(lateness='10', policy='STREAM',
+                           late.stream='Late')
+                define stream S (v int);
+                from S select v insert into Out;
+            """)
+
+    def test_per_stream_annotation_overrides_app_default(self):
+        rt = SiddhiManager().create_siddhi_app_runtime("""
+            @app:watermark(lateness='10')
+            @watermark(lateness='500', policy='PROCESS')
+            define stream S (v int);
+            define stream T (v int);
+            from S select v insert into Out;
+            from T select v insert into Out2;
+        """)
+        assert rt._reorder["S"].conf.lateness_ms == 500
+        assert rt._reorder["S"].conf.policy == "PROCESS"
+        assert rt._reorder["T"].conf.lateness_ms == 10
+        assert rt._playback    # watermark implies event time
+
+    def test_parse_lateness_units(self):
+        assert parse_lateness_ms("200 ms") == 200
+        assert parse_lateness_ms("'2 sec'") == 2000
+        assert parse_lateness_ms(5) == 5
+        with pytest.raises(ValueError):
+            parse_lateness_ms("-1 sec")
+        with pytest.raises(ValueError):
+            parse_lateness_ms("soon")
+
+
+# ---------------------------------------------------------------------------
+# flush path: zero new jits at steady state
+# ---------------------------------------------------------------------------
+
+def test_flush_path_steady_state_zero_recompiles(monkeypatch):
+    """The reorder buffer is host-side numpy: after warmup, buffered
+    chunk processing must trigger ZERO new traces (the flush must not
+    perturb compile-cache keys — docs/compile_cache.md)."""
+    import functools
+
+    import jax
+
+    real_jit = jax.jit
+    traces = [0]
+
+    def counting_jit(f, *a, **kw):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            traces[0] += 1
+            return f(*args, **kwargs)
+        return real_jit(wrapped, *a, **kw)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(WINDOW_APP)
+    rt.start()
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(4)
+
+    def chunk(i):
+        n = 64
+        ts = TS0 + (i * n + np.arange(n, dtype=np.int64)) * 4
+        return ts, [rng.integers(0, 8, n).astype(np.int32),
+                    rng.integers(0, 1000, n).astype(np.int32)]
+
+    for i in range(4):    # warmup: release-cut sizes + encodings settle
+        h.send_arrays(*chunk(i))
+    before = traces[0]
+    for i in range(4, 12):
+        h.send_arrays(*chunk(i))
+    assert traces[0] == before, \
+        f"steady-state flushes triggered {traces[0] - before} new traces"
+    rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ReorderBuffer unit behavior (sorted_key_view reuse on numpy)
+# ---------------------------------------------------------------------------
+
+def test_sorted_key_view_numpy_namespace():
+    from siddhi_tpu.ops.table import sorted_key_view
+    keys = np.array([5, 3, 5, 1], np.int64)
+    live = np.array([True, True, False, True])
+    order, sk, n_live = sorted_key_view(keys, live, xp=np)
+    assert isinstance(order, np.ndarray)
+    assert int(n_live) == 3
+    assert list(order[:3]) == [3, 1, 0]     # dead row sorts last
+    assert list(sk[:3]) == [1, 3, 5]
+
+
+def test_buffer_unit_stable_sort_and_watermark():
+    class _App:
+        _playback = True
+        _reorder = {}
+        def global_watermark(self):
+            return None
+        def on_event_time(self, t):
+            pass
+
+    class _Handler:
+        app = _App()
+        def __init__(self):
+            self.rows = []
+        def _dispatch_rows(self, events):
+            self.rows.extend(events)
+
+    buf = ReorderBuffer("S", None, WatermarkConfig(lateness_ms=10))
+    h = _Handler()
+    buf.handler = h
+    buf.ingest_rows([Event(105, (1,)), Event(101, (2,)),
+                     Event(103, (3,)), Event(120, (4,))])
+    # wm = 110: releases 101,103,105 sorted; 120 pending
+    assert [e.timestamp for e in h.rows] == [101, 103, 105]
+    assert buf.depth == 1
+    buf.flush(final=True)
+    assert [e.timestamp for e in h.rows] == [101, 103, 105, 120]

@@ -180,6 +180,64 @@ def test_valid_on_error_actions_parse():
     """)
 
 
+def test_unknown_watermark_policy_raises():
+    with pytest.raises(CompileError, match="watermark-config"):
+        parse("@app:watermark(lateness='10', policy='YOLO')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_negative_watermark_lateness_raises():
+    with pytest.raises(CompileError, match="watermark-config"):
+        parse("@watermark(lateness='-10 ms')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_missing_watermark_lateness_raises():
+    with pytest.raises(CompileError, match="watermark-config"):
+        parse("@app:watermark(policy='DROP')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_watermark_on_undefined_stream_raises():
+    with pytest.raises(CompileError, match="watermark-config"):
+        parse("@app:watermark(stream='Ghost', lateness='10')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_watermark_late_stream_must_be_defined():
+    with pytest.raises(CompileError, match="watermark-config"):
+        parse("@watermark(lateness='10', policy='STREAM', "
+              "late.stream='Nowhere')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_watermark_bad_cap_and_dedup_raise():
+    with pytest.raises(CompileError, match="watermark-config"):
+        parse("@app:watermark(lateness='10', cap='-4')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+    with pytest.raises(CompileError, match="watermark-config"):
+        parse("@app:watermark(lateness='10', dedup='maybe')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_valid_watermark_configs_parse():
+    parse("""
+        @app:watermark(lateness='200 ms')
+        @watermark(lateness='1 sec', policy='STREAM',
+                   late.stream='LateS', dedup='true', cap='1024')
+        define stream S (a int);
+        define stream LateS (a int);
+        from S select a insert into Out;
+    """)
+
+
 # ---- advisory warnings do not raise -----------------------------------
 
 

@@ -15,8 +15,8 @@ from siddhi_tpu import (CheckpointSupervisor, ErroredEvent, Event,
 from siddhi_tpu.core import io as sio
 from siddhi_tpu.resilience.errorstore import replay
 from siddhi_tpu.resilience.scenarios import (
-    run_corrupt_snapshot_fallback, run_sink_outage_crash_recovery,
-    run_soak)
+    run_corrupt_snapshot_fallback, run_disorder_equivalence,
+    run_sink_outage_crash_recovery, run_soak)
 
 PLAYBACK = "@app:playback "
 
@@ -95,6 +95,48 @@ class TestErrorStore:
         assert replay(rt, store) == 0
         rt.shutdown()
         assert store.size(rt.name) == 1
+
+    def test_replay_reinjects_in_original_timestamp_order(self):
+        """Regression: records are captured as failures happen, so the
+        store can hold a LATER timestamp before an earlier one; replay
+        must re-sort by original event timestamp or recovery itself
+        re-introduces disorder into windows/patterns."""
+        rt, got = build(PLAYBACK + """
+            define stream S (v int);
+            @info(name = 'q') from S select v insert into Out;
+        """, out="Out")
+        store = InMemoryErrorStore()
+        store.store(rt.name, ErroredEvent.from_events(
+            "S", [Event(3000, (3,)), Event(4000, (4,))], "X: y"))
+        store.store(rt.name, ErroredEvent.from_events(
+            "S", [Event(1000, (1,)), Event(2000, (2,))], "X: y"))
+        assert replay(rt, store) == 4
+        rt.shutdown()
+        assert [e.timestamp for e in got] == [1000, 2000, 3000, 4000]
+        assert [e.data[0] for e in got] == [1, 2, 3, 4]
+
+    def test_replay_timestamp_order_across_origins(self):
+        """Interleaved timestamps across TWO origin streams replay in
+        global event-time order (store order breaks ties)."""
+        rt, _ = build(PLAYBACK + """
+            define stream S (v int);
+            define stream T (v int);
+            @info(name = 'qs') from S select v insert into Out;
+            @info(name = 'qt') from T select v insert into Out2;
+        """)
+        arrivals = []
+        rt.add_callback("S", StreamCallback(fn=lambda evs: arrivals.extend(
+            ("S", e.timestamp) for e in evs)))
+        rt.add_callback("T", StreamCallback(fn=lambda evs: arrivals.extend(
+            ("T", e.timestamp) for e in evs)))
+        store = InMemoryErrorStore()
+        store.store(rt.name, ErroredEvent.from_events(
+            "S", [Event(2000, (1,))], "X: y"))
+        store.store(rt.name, ErroredEvent.from_events(
+            "T", [Event(1000, (2,)), Event(3000, (3,))], "X: y"))
+        assert replay(rt, store) == 3
+        rt.shutdown()
+        assert arrivals == [("T", 1000), ("S", 2000), ("T", 3000)]
 
 
 # ---------------------------------------------------------------------------
@@ -370,6 +412,20 @@ class TestChaos:
         b = run_sink_outage_crash_recovery(seed=21, rate=0.6)
         assert a["received"] == b["received"]
         assert a["stored_backlog"] == b["stored_backlog"]
+
+    def test_disorder_equivalence_under_bounded_chaos(self):
+        """Acceptance: a windowed+join app under seeded bounded
+        shuffling + duplicate injection produces outputs BIT-EQUAL to
+        the ordered run — the watermark reorder buffer repairs the
+        disorder and dedup swallows every injected duplicate
+        (resilience/ordering.py)."""
+        res = run_disorder_equivalence(seed=5, n=256)
+        assert res["equal"], res
+        assert res["join_ordered"] > 0 and res["window_ordered"] > 0
+        assert res["injected"].get("shuffle", 0) > 0
+        assert res["duplicates_detected"] == \
+            res["injected"].get("duplicate", 0)
+        assert res["late"] == 0   # skew stayed within the lateness bound
 
     @pytest.mark.slow
     def test_soak_many_rounds_never_lose_events(self):

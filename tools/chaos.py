@@ -36,8 +36,8 @@ def run(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from siddhi_tpu.resilience.scenarios import (
-        run_corrupt_snapshot_fallback, run_sink_outage_crash_recovery,
-        run_soak)
+        run_corrupt_snapshot_fallback, run_disorder_equivalence,
+        run_sink_outage_crash_recovery, run_soak)
 
     failures = 0
 
@@ -58,6 +58,14 @@ def run(argv=None) -> int:
            and res["post_restore_sums"] == res["expected_sums"],
            f"restored={res['restored']} "
            f"sums={res['post_restore_sums']}")
+
+    res = run_disorder_equivalence(seed=args.seed)
+    report("disorder-equivalence",
+           res["equal"] and res["join_ordered"] > 0,
+           f"join={res['join_disorder']}/{res['join_ordered']} "
+           f"window={res['window_disorder']}/{res['window_ordered']} "
+           f"dups_detected={res['duplicates_detected']} "
+           f"injected={res['injected']}")
 
     if args.soak:
         for i, r in enumerate(run_soak(seed=args.seed,
