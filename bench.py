@@ -397,6 +397,155 @@ def bench_chain3(n=1_048_576):
     })
 
 
+TENANT_TEMPLATE = """
+define stream In (v double, k long);
+@info(name='q')
+from In[v > ${lo:double} and v < ${hi:double}]#window.lengthBatch(256)
+select v, k
+insert into Out;
+"""
+
+
+def _tenant_data(rows: int, seed: int = 11):
+    rng = np.random.default_rng(seed)
+    ts = TS0 + np.arange(rows, dtype=np.int64)
+    v = rng.uniform(0, 200, rows)
+    k = rng.integers(0, 1 << 20, rows, dtype=np.int64)
+    return ts, [v, k]
+
+
+def _tenant_bindings(i: int) -> dict:
+    return {"lo": 20.0 + (i % 16), "hi": 180.0 - (i % 16)}
+
+
+def _run_tenant_pool(n_tenants: int, rows: int, batch_max: int):
+    """Pooled arm: ONE template, ONE compiled program set, N tenants as
+    a vmapped slot axis; aggregate events/s over fair dispatch rounds."""
+    from siddhi_tpu.serving import TemplateRegistry
+    reg = TemplateRegistry(SiddhiManager())
+    pool = reg.pool(TENANT_TEMPLATE, warm=False, slots=n_tenants,
+                    max_tenants=n_tenants, batch_max=batch_max)
+    wu = pool.warmup([batch_max])
+    for i in range(n_tenants):
+        pool.add_tenant(f"t{i}", _tenant_bindings(i))
+    ts, cols = _tenant_data(rows)
+    last = _Last()
+    pool.batch_callbacks.append(
+        lambda terminal: last(next(iter(terminal.values()), None)
+                              if terminal else None))
+
+    def one_pass():
+        for i in range(n_tenants):
+            pool.send(f"t{i}", ts, cols)
+        pool.flush()
+        last.drain()
+
+    one_pass()   # warm pass: dispatch-path caches settle off the clock
+    dt = min(_timed(one_pass) for _ in range(REPS))
+    stats = pool.statistics()
+    comp = stats["compile"]
+    pool.shutdown()
+    return {
+        "eps": round(n_tenants * rows / dt, 1),
+        "seconds": round(dt, 3),
+        "compile_ms": wu["compile_ms"],
+        "warm_programs": wu["programs"],
+        "program_sets": comp["program_sets"],
+        "pool_warmups": comp["warmups"],
+        "slots": stats["pool"]["slots"],
+        "rounds": stats["pool"]["rounds"],
+    }
+
+
+def _run_tenant_separate(n_tenants: int, rows: int):
+    """Baseline arm: one full SiddhiAppRuntime per tenant — N parses, N
+    compiles, N separate step dispatches per pass (what ROADMAP item 2
+    replaces). Measured at a bounded N and extrapolated flat, which is
+    GENEROUS to the baseline: aggregate events/s of serial per-runtime
+    dispatch does not improve with more runtimes while its compile cost
+    grows linearly."""
+    from siddhi_tpu.serving import Template
+    tpl = Template(TENANT_TEMPLATE)
+    mgr = SiddhiManager()
+    ts, cols = _tenant_data(rows)
+    runtimes = []
+    t0 = time.perf_counter()
+    for i in range(n_tenants):
+        rt = mgr.create_siddhi_app_runtime(tpl.instantiate_static(
+            _tenant_bindings(i), app_name=f"sep_{i}"))
+        outs = _Last()
+        rt.queries["q"].batch_callbacks.append(outs)
+        rt.start()
+        runtimes.append((rt, rt.get_input_handler("In"), outs))
+    deploy_s = time.perf_counter() - t0
+
+    def one_pass():
+        for _rt, h, outs in runtimes:
+            h.send_arrays(ts, cols)
+        for _rt, _h, outs in runtimes:
+            outs.drain()
+
+    t0 = time.perf_counter()
+    one_pass()   # first pass pays the N per-runtime lazy compiles
+    compile_s = time.perf_counter() - t0
+    dt = min(_timed(one_pass) for _ in range(REPS))
+    for rt, _h, _outs in runtimes:
+        rt.shutdown()
+    return {
+        "eps": round(n_tenants * rows / dt, 1),
+        "seconds": round(dt, 3),
+        "deploy_ms": round(deploy_s * 1000.0, 1),
+        "first_pass_compile_ms": round(compile_s * 1000.0, 1),
+    }
+
+
+def bench_tenants():
+    """Multi-tenant serving acceptance (ROADMAP item 2): N tenants of
+    ONE filter+window template as a vmapped TenantPool vs N separate
+    runtimes. Reports eps_pooled/eps_separate/speedup per N and the
+    pool's one-program-set compile story; the headline value is the
+    pooled aggregate events/s at the largest N."""
+    n_list = [int(x) for x in
+              _env("SIDDHI_BENCH_TENANTS", "64,256,1024").split(",")
+              if x.strip()]
+    sep_n = int(_env("SIDDHI_BENCH_TENANTS_SEP", "64") or 64)
+    batch_max = 1024
+    rows = _scaled(2048, batch_max)
+    sep = _run_tenant_separate(min(sep_n, min(n_list)), rows)
+    per_n = {}
+    for n in n_list:
+        pooled = _run_tenant_pool(n, rows, batch_max)
+        assert pooled["program_sets"] == 1 and \
+            pooled["pool_warmups"] == 1, pooled
+        per_n[n] = {
+            "eps_pooled": pooled["eps"],
+            # flat extrapolation of the measured separate-runtimes
+            # aggregate (serial dispatch: more runtimes do not add
+            # events/s, they add compiles)
+            "eps_separate": sep["eps"],
+            "separate_measured_at": min(sep_n, min(n_list)),
+            "extrapolated": n != min(sep_n, min(n_list)),
+            "speedup": round(pooled["eps"] / max(sep["eps"], 1e-9), 2),
+            "compile_ms": pooled["compile_ms"],
+            "program_sets": pooled["program_sets"],
+            "rounds": pooled["rounds"],
+        }
+    n_max = max(n_list)
+    head = per_n[n_max]
+    return {
+        "value": head["eps_pooled"], "unit": "events/s",
+        "baseline": "n/a",
+        "events": n_max * rows,
+        "rows_per_tenant": rows,
+        "eps_pooled": head["eps_pooled"],
+        "eps_separate": head["eps_separate"],
+        "speedup": head["speedup"],
+        "compile_ms": head["compile_ms"],
+        "separate": sep,
+        "tenants": {str(n): per_n[n] for n in n_list},
+    }
+
+
 def bench_window_agg(n=1_000_000):
     n = _scaled(n)
     mgr = SiddhiManager()
@@ -922,8 +1071,9 @@ def bench_warmstart():
 # r5 measured: 494M joined pairs/s, 1.29M input ev/s, 0 drops.
 # warmstart (cold-vs-warm deploy probes at 1024 rows) runs third: cheap,
 # and the cold/warm split is the PR-5 acceptance metric.
-BENCHES = ("seq5", "chain3", "warmstart", "filter", "window_agg", "seq2",
-           "kleene", "join", "join_eq", "join_fanout")
+BENCHES = ("seq5", "chain3", "warmstart", "tenants", "filter",
+           "window_agg", "seq2", "kleene", "join", "join_eq",
+           "join_fanout")
 
 
 def main():
@@ -944,6 +1094,13 @@ def main():
         env.setdefault("SIDDHI_BENCH_REPS", "1")
         env.setdefault("SIDDHI_BENCH_BUDGET_S", "90")
         env.setdefault("SIDDHI_BENCH_DEADLINE_S", "240")
+        # tenants smoke: small pools, small separate arm (os.environ
+        # too: single-config invocations run in-process and read the
+        # knob at call time, not from the subprocess env dict)
+        env.setdefault("SIDDHI_BENCH_TENANTS", "16,64")
+        env.setdefault("SIDDHI_BENCH_TENANTS_SEP", "8")
+        os.environ.setdefault("SIDDHI_BENCH_TENANTS", "16,64")
+        os.environ.setdefault("SIDDHI_BENCH_TENANTS_SEP", "8")
         globals().update(
             SCALE=float(env["SIDDHI_BENCH_SCALE"]),
             REPS=int(env["SIDDHI_BENCH_REPS"]),

@@ -56,8 +56,15 @@ from .resilience import (  # noqa: E402
     FileSystemErrorStore,
     InMemoryErrorStore,
 )
+from .serving import (  # noqa: E402
+    AdmissionError,
+    Template,
+    TemplateRegistry,
+    TenantPool,
+)
 
 __all__ = [
+    "AdmissionError",
     "AttrType",
     "CheckpointSupervisor",
     "ErrorStore",
@@ -72,6 +79,9 @@ __all__ = [
     "QueryCallback",
     "SiddhiManager",
     "StreamCallback",
+    "Template",
+    "TemplateRegistry",
+    "TenantPool",
     "compiler",
     "parse",
     "parse_expression",

@@ -77,9 +77,67 @@ _state_streams = A.iter_state_streams
 _query_inputs = A.iter_query_inputs
 
 
+def iter_template_param_uses(q: A.Query):
+    """Yield ``(where, param, allowed)`` for every `${name:type}`
+    placeholder a query's expressions contain. ``allowed`` is True only
+    in the positions the runtime can carry as per-tenant parameters
+    (ops/expr.py tparam machinery): filter conditions without table
+    references, and non-aggregating select/having — everything else
+    (window/stream-function arguments, join ON, pattern conditions,
+    group-by, table-output clauses, aggregating selectors) is structural
+    and must be bound at the pool level instead."""
+    from ..ops.selector import selector_needs_aggregation
+    from ..ops.table import expr_mentions_table
+
+    def params(expr):
+        if expr is None:
+            return ()
+        return tuple(e for e in A.walk_expressions(expr)
+                     if isinstance(e, A.TemplateParam))
+
+    plain = isinstance(q.input, A.SingleInputStream)
+    for sin in A.iter_query_inputs(q):
+        for h in sin.handlers:
+            if isinstance(h, A.Filter):
+                ok = plain and not expr_mentions_table(h.expression)
+                where = "filter condition" if ok else \
+                    ("table-reference filter" if plain
+                     else "join/pattern stream filter")
+                for p in params(h.expression):
+                    yield where, p, ok
+            else:
+                kind = "window" if isinstance(h, A.WindowHandler) \
+                    else "stream-function"
+                for e in h.parameters:
+                    for p in params(e):
+                        yield f"{kind} '{h.name}' parameter", p, False
+    if isinstance(q.input, A.JoinInputStream):
+        for p in params(q.input.on):
+            yield "join ON condition", p, False
+    needs_agg = selector_needs_aggregation(q.selector)
+    sel_ok = plain and not needs_agg
+    sel_where = "select/having" if sel_ok else \
+        ("aggregating select/having" if plain else "select/having")
+    for oa in q.selector.attributes:
+        for p in params(oa.expression):
+            yield sel_where, p, sel_ok
+    for p in params(q.selector.having):
+        yield sel_where, p, sel_ok
+    for attr in ("on",):
+        e = getattr(q.output, attr, None)
+        for p in params(e):
+            yield "table-output ON clause", p, False
+    for pair in getattr(q.output, "set_clause", None) or ():
+        for e in pair:
+            for p in params(e):
+                yield "table-output SET clause", p, False
+
+
 class PlanValidator:
-    def __init__(self, app: A.SiddhiApp):
+    def __init__(self, app: A.SiddhiApp,
+                 allow_template_params: bool = False):
         self.app = app
+        self.allow_template_params = allow_template_params
         self.issues: list[PlanIssue] = []
         # every id events can be consumed from at app scope
         self.defined: set[str] = set()
@@ -110,6 +168,7 @@ class PlanValidator:
     def validate(self) -> list[PlanIssue]:
         self.check_app_statistics()
         self.check_watermarks()
+        self.check_template_params()
         for sid, sd in self.app.stream_definitions.items():
             self.check_on_error_actions(sid, sd)
         qn = 0
@@ -146,6 +205,66 @@ class PlanValidator:
                 "statistics-interval", ERROR, "app",
                 f"cannot parse @app:statistics interval '{interval}' "
                 "(expected e.g. '5 sec', '500 ms', '1 min')")
+
+    def check_template_params(self) -> None:
+        """``template-binding``: `${name:type}` placeholder hygiene.
+
+        Outside template mode any placeholder is an unbound literal —
+        the app was deployed directly instead of through the tenant
+        serving front door (serving/template.py), a definite planner
+        rejection. In template mode (``parse(..., template=True)``)
+        placeholders are the point, but they must be typed, appear only
+        in positions the runtime can parameterize per tenant (filter
+        conditions, non-aggregating select/having — see
+        iter_template_param_uses), and declare ONE type per name."""
+        declared: dict[str, object] = {}
+        qn = 0
+        for el in self.app.execution_elements:
+            queries = [el] if isinstance(el, A.Query) else list(el.queries)
+            in_partition = isinstance(el, A.Partition)
+            for q in queries:
+                qn += 1
+                name = q.name or f"query{qn}"
+                for where, p, allowed in iter_template_param_uses(q):
+                    ph = f"${{{p.name}}}" if p.type is None else \
+                        f"${{{p.name}:{p.type.value}}}"
+                    if not self.allow_template_params:
+                        self.add(
+                            "template-binding", ERROR, name,
+                            f"unbound placeholder {ph} — tenant templates "
+                            "deploy through the serving front door "
+                            "(serving/template.py), or bind the value "
+                            "statically before deploying")
+                        continue
+                    if p.type is None:
+                        self.add(
+                            "template-binding", ERROR, name,
+                            f"structural placeholder {ph} survived "
+                            "substitution — bind it via the template's "
+                            "shared bindings")
+                        continue
+                    if in_partition:
+                        self.add(
+                            "template-binding", ERROR, name,
+                            f"placeholder {ph} inside a partition is not "
+                            "supported (partitions already vmap the key "
+                            "axis)")
+                    elif not allowed:
+                        self.add(
+                            "template-binding", ERROR, name,
+                            f"placeholder {ph} in a {where} is structural "
+                            "— only filter conditions and non-aggregating "
+                            "select/having can carry per-tenant "
+                            "parameters; bind it via shared bindings")
+                    prev = declared.get(p.name)
+                    if prev is None:
+                        declared[p.name] = p.type
+                    elif prev is not p.type:
+                        self.add(
+                            "template-binding", ERROR, name,
+                            f"placeholder '${{{p.name}}}' declared with "
+                            f"conflicting types {prev.value} and "
+                            f"{p.type.value}")
 
     def check_watermarks(self) -> None:
         """``@app:watermark`` / per-stream ``@watermark`` annotations:
@@ -359,14 +478,95 @@ class PlanValidator:
     # inferred implicit-stream schemas. The parser runs both passes.
 
 
-def validate_app(app: A.SiddhiApp) -> list[PlanIssue]:
+def validate_app(app: A.SiddhiApp,
+                 allow_template_params: bool = False) -> list[PlanIssue]:
     """Run every plan check; returns all issues (errors + warnings)."""
-    return PlanValidator(app).validate()
+    return PlanValidator(
+        app, allow_template_params=allow_template_params).validate()
 
 
-def check_app(app: A.SiddhiApp) -> None:
+def check_app(app: A.SiddhiApp,
+              allow_template_params: bool = False) -> None:
     """Raise CompileError on error-severity plan issues (parser hook)."""
-    errors = [i for i in validate_app(app) if i.severity == ERROR]
+    errors = [i for i in validate_app(
+        app, allow_template_params=allow_template_params)
+        if i.severity == ERROR]
     if errors:
         from ..ops.expr import CompileError
         raise CompileError("; ".join(i.render() for i in errors))
+
+
+# -- tenant-template binding validation (serving/, front-door deploys) -----
+
+def template_placeholders(app: A.SiddhiApp) -> dict:
+    """``{name: AttrType}`` for every typed `${name:type}` placeholder in
+    a template-mode app AST (first declaration wins; conflicts are the
+    template-binding rule's to reject)."""
+    out: dict = {}
+    for el in app.execution_elements:
+        queries = [el] if isinstance(el, A.Query) else list(el.queries)
+        for q in queries:
+            for _where, p, _allowed in iter_template_param_uses(q):
+                if p.type is not None and p.name not in out:
+                    out[p.name] = p.type
+    return out
+
+
+def _literal_type(value):
+    """The AttrType a Python binding value carries as a literal."""
+    from ..core.types import AttrType
+    if isinstance(value, bool):          # before int: bool is an int
+        return AttrType.BOOL
+    if isinstance(value, int):
+        return AttrType.INT if -2**31 <= value < 2**31 else AttrType.LONG
+    if isinstance(value, float):
+        return AttrType.DOUBLE
+    if isinstance(value, str):
+        return AttrType.STRING
+    return None
+
+
+def check_template_bindings(app: A.SiddhiApp, bindings: dict) -> dict:
+    """Validate one tenant's bindings against a template app's typed
+    placeholders — the runtime half of the ``template-binding`` rule:
+
+    - unknown placeholder: a binding names no declared placeholder
+    - unbound placeholder: a declared placeholder has no binding
+    - type contradiction: the binding's literal type does not coerce
+      into the declared type under the PR 3 promotion/coercion tables
+      (core/types.can_coerce — the same lattice the typechecker uses)
+
+    Raises CompileError listing every violation; returns
+    ``{name: (value, AttrType)}`` ready for the pool's parameter slots.
+    """
+    from ..core.types import can_coerce
+    from ..ops.expr import CompileError
+    declared = template_placeholders(app)
+    problems = []
+    for k in sorted(bindings):
+        if k not in declared:
+            problems.append(
+                f"unknown placeholder '{k}' (template declares: "
+                f"{', '.join(sorted(declared)) or 'none'})")
+    out = {}
+    for name in sorted(declared):
+        t = declared[name]
+        if name not in bindings:
+            problems.append(
+                f"unbound placeholder '${{{name}:{t.value}}}' — no "
+                "binding supplied")
+            continue
+        value = bindings[name]
+        lt = _literal_type(value)
+        if lt is None or not can_coerce(lt, t):
+            got = type(value).__name__ if lt is None else lt.value.upper()
+            problems.append(
+                f"binding '{name}'={value!r} has literal type {got} "
+                f"which does not coerce to the declared "
+                f"{t.value.upper()}")
+            continue
+        out[name] = (value, t)
+    if problems:
+        raise CompileError(
+            "template-binding: " + "; ".join(problems))
+    return out

@@ -396,6 +396,15 @@ class TypeChecker:
                 emit(u.code, u.message)
                 return None
 
+        if isinstance(e, A.TemplateParam):
+            # tenant-template placeholder: types as its declared
+            # `${name:type}` type, so a binding position that contradicts
+            # the surrounding expression (e.g. `price > ${t:string}`)
+            # fails right here through the shared comparability tables.
+            # Untyped placeholders type as unknown; the template-binding
+            # plan rule rejects them with a dedicated message.
+            return e.type if isinstance(e.type, AttrType) else None
+
         if isinstance(e, A.MathOp):
             l, r = te(e.left), te(e.right)
             bad = False

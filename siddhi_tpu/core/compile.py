@@ -150,6 +150,11 @@ class CompileService:
         # in-flight warmups: while > 0 the app is compiling and must not
         # be marked ready (service GET /ready load-balancer semantics)
         self._inflight = 0
+        # cooperative cancellation: undeploy of a still-warming app sets
+        # this so the background warmup bails between specs instead of
+        # compiling for a dead app (core/service.py undeploy)
+        self._cancel = threading.Event()
+        self._threads: list[threading.Thread] = []
 
     # -- readiness (service /ready) --------------------------------------
     @property
@@ -167,6 +172,20 @@ class CompileService:
         with self._lock:
             self._inflight -= 1
 
+    def cancel(self) -> None:
+        """Ask in-flight warmups to stop compiling (checked between
+        specs; the spec being compiled finishes — XLA compiles are not
+        interruptible). Sticky until the next warmup begins."""
+        self._cancel.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Wait for background warmup threads (undeploy: cancel() then
+        join() so the inflight count provably returns to zero instead of
+        leaking behind a daemon thread)."""
+        for t in list(self._threads):
+            t.join(timeout=timeout)
+        self._threads = [t for t in self._threads if t.is_alive()]
+
     def warmup_async(self, buckets=None, samples: Optional[dict] = None,
                      workers: Optional[int] = None) -> threading.Thread:
         """Run warmup() on a daemon thread. Readiness flips to False
@@ -183,6 +202,7 @@ class CompileService:
 
         t = threading.Thread(target=run, daemon=True,
                              name=f"siddhi-warmup-{self.app.name}")
+        self._threads.append(t)
         t.start()
         return t
 
@@ -494,15 +514,39 @@ class CompileService:
         finally:
             self._end()
 
+    def warm_specs(self, specs: list, workers: Optional[int] = None) -> dict:
+        """Compile an externally-built spec list through this service:
+        same thread pool, cache counters, cancellation and cumulative
+        telemetry as warmup(). The serving TenantPool feeds its vmapped
+        tenant-axis programs through here so a pool's whole compile
+        story lands in ONE statistics()['compile'] entry."""
+        self._begin()
+        try:
+            return self._run_specs(specs, workers)
+        finally:
+            self._end()
+
     def _warmup(self, buckets, samples: Optional[dict],
                 workers: Optional[int]) -> dict:
-        specs = self.specs(buckets, samples=samples)
+        return self._run_specs(self.specs(buckets, samples=samples),
+                               workers)
+
+    def _run_specs(self, specs: list,
+                   workers: Optional[int]) -> dict:
+        self._cancel.clear()
         before = cache_counts()
         t0 = time.perf_counter()
         records: list[dict] = []
         errors: list[dict] = []
+        cancelled: list[str] = []
 
         def run(spec: CompileSpec) -> None:
+            if self._cancel.is_set():
+                # undeploy raced the warmup: stop compiling for an app
+                # that is already gone (specs still run lazily if the
+                # app ever dispatches again)
+                cancelled.append(spec.key)
+                return
             s0 = time.perf_counter()
             try:
                 fn, args = spec.build()
@@ -537,6 +581,8 @@ class CompileService:
         }
         if errors:
             result["errors"] = errors
+        if cancelled:
+            result["cancelled"] = len(cancelled)
         with self._lock:
             self.warmups += 1
             self.programs += result["programs"]

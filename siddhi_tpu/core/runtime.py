@@ -41,7 +41,8 @@ import numpy as np
 from ..lang import ast as A
 from ..obs.profiler import op_scope
 from ..ops.aggregators import AggregateOp
-from ..ops.expr import CompileError, SingleStreamScope, compile_expression
+from ..ops.expr import (CompileError, SingleStreamScope,
+                        collect_template_params, compile_expression)
 from ..ops.join import (JoinCombinedScope, JoinCross, JoinSideScope,
                         combined_schema)
 from ..ops.nfa import MatchScope, NfaCompiler, NfaEngine
@@ -3270,7 +3271,9 @@ class Planner:
                                           self.functions)
                 if cond.type is not AttrType.BOOL:
                     raise CompileError(f"query '{name}': filter must be BOOL")
-                operators.append(FilterOp(cond, schema))
+                operators.append(FilterOp(
+                    cond, schema,
+                    tparams=collect_template_params(h.expression)))
             elif isinstance(h, A.WindowHandler):
                 if window_op is not None:
                     raise CompileError(
@@ -3304,6 +3307,14 @@ class Planner:
             src_window is not None
 
         if needs_agg:
+            if collect_template_params(
+                    *[oa.expression for oa in q.selector.attributes],
+                    q.selector.having):
+                # planner backstop; the template-binding plan rule
+                # reports this with anchors at parse time
+                raise CompileError(
+                    f"query '{name}': template params are not supported "
+                    "in aggregating selectors")
             operators.append(AggregateOp(
                 q.selector, schema, target, scope,
                 functions=self.functions,

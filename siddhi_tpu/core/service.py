@@ -6,6 +6,20 @@ Reference mapping: modules/siddhi-service/ —
 (SiddhiApi.java:31,37-52; impl SiddhiApiServiceImpl.java:51,100)
 plus GET /siddhi/artifacts (list deployed app names).
 
+Multi-tenant front door (docs/serving.md):
+- POST /siddhi/tenant/deploy — JSON {template, tenant, bindings?,
+  shared?, pool?}: registers the template (hash-keyed), creates/reuses
+  the ONE TenantPool per (template, shared) pair, AOT-warms it before
+  the first tenant, and admits the tenant into a slot. Admission
+  control answers 429 + reason when pool slots or the per-tenant state
+  quota are exhausted.
+- POST /siddhi/tenant/ingest/{pool}/{tenant} — JSON {ts, rows}: queue
+  one chunk; the pool's fair round-robin worker batches it with every
+  other tenant's traffic (one hot tenant cannot starve the rest).
+- GET  /siddhi/tenant/undeploy/{pool}/{tenant}
+- GET  /siddhi/tenant/stats/{pool}[/{tenant}] — per-tenant isolated
+  statistics (siddhi.<pool>.tenant.<id>.* namespace).
+
 Observability endpoints (docs/observability.md):
 - GET /metrics — Prometheus text exposition over every deployed app's
   MetricsRegistry (auth-protected when a token is set: metric names
@@ -58,6 +72,11 @@ class SiddhiService:
         # deploy call blocking for the whole AOT phase
         self.warm_async = warm_async
         self._deployed: dict = {}
+        # multi-tenant serving (siddhi_tpu/serving/): hash-keyed template
+        # registry; one TenantPool (= one compiled program set) per
+        # (template, shared-bindings) pair
+        from ..serving import TemplateRegistry
+        self.templates = TemplateRegistry(self.manager)
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -88,9 +107,44 @@ class SiddhiService:
                 got = self.headers.get("Authorization", "")
                 return got == f"Bearer {service.auth_token}"
 
+            def _json_body(self) -> dict:
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n).decode()
+                body = json.loads(raw) if raw else {}
+                if not isinstance(body, dict):
+                    raise ValueError("expected a JSON object body")
+                return body
+
             def do_POST(self):
+                from ..serving import AdmissionError
                 if not self._authorized():
                     return self._send(401, {"error": "unauthorized"})
+                if self.path == "/siddhi/tenant/deploy":
+                    try:
+                        return self._send(200, service.tenant_deploy(
+                            self._json_body()))
+                    except AdmissionError as e:
+                        # admission control: slots / state quota
+                        # exhausted -> 429 with the reason spelled out
+                        return self._send(429, {"error": e.reason,
+                                                "reason": e.reason})
+                    except Exception as e:  # noqa: BLE001 — to client
+                        return self._send(400, {"error": str(e)})
+                if self.path.startswith("/siddhi/tenant/ingest/"):
+                    parts = self.path.split("/")
+                    if len(parts) != 6:
+                        return self._send(404, {"error": "not found"})
+                    try:
+                        return self._send(200, service.tenant_ingest(
+                            parts[4], parts[5], self._json_body()))
+                    except AdmissionError as e:
+                        # per-tenant backlog backpressure -> 429
+                        return self._send(429, {"error": e.reason,
+                                                "reason": e.reason})
+                    except KeyError as e:
+                        return self._send(404, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 — to client
+                        return self._send(400, {"error": str(e)})
                 if self.path != "/siddhi/artifact/deploy":
                     return self._send(404, {"error": "not found"})
                 n = int(self.headers.get("Content-Length", 0))
@@ -101,7 +155,13 @@ class SiddhiService:
                     return self._send(409, {"error": str(e)})
                 except Exception as e:  # noqa: BLE001 — surface to client
                     return self._send(400, {"error": str(e)})
-                self._send(200, {"status": "deployed", "app": name})
+                rt = service._deployed.get(name)
+                # per-artifact readiness in the deploy response: with an
+                # async warm the app is visible-but-cold until its AOT
+                # compiles land (poll /ready, or redeploy-time tooling
+                # can branch on warm/cold directly)
+                self._send(200, {"status": "deployed", "app": name,
+                                 "ready": bool(rt and rt.ready)})
 
             def do_GET(self):
                 # LB probes first: liveness/readiness carry no secrets
@@ -123,9 +183,39 @@ class SiddhiService:
                         return self._send(200, {"status": "undeployed",
                                                 "app": name})
                     return self._send(404, {"error": f"no app '{name}'"})
+                if self.path.startswith("/siddhi/tenant/undeploy/"):
+                    parts = self.path.split("/")
+                    if len(parts) == 6:
+                        if service.tenant_undeploy(parts[4], parts[5]):
+                            return self._send(
+                                200, {"status": "undeployed",
+                                      "pool": parts[4],
+                                      "tenant": parts[5]})
+                    return self._send(404, {"error": "not found"})
+                if self.path.startswith("/siddhi/tenant/stats/"):
+                    parts = self.path.split("/")
+                    try:
+                        if len(parts) == 5:
+                            return self._send(
+                                200, service.tenant_stats(parts[4]))
+                        if len(parts) == 6:
+                            return self._send(
+                                200, service.tenant_stats(parts[4],
+                                                          parts[5]))
+                    except KeyError as e:
+                        return self._send(404, {"error": str(e)})
+                    return self._send(404, {"error": "not found"})
                 if self.path == "/siddhi/artifacts":
-                    return self._send(200,
-                                      {"apps": sorted(service._deployed)})
+                    # per-artifact readiness alongside the name list so
+                    # deploy tooling can see warm/cold without a probe
+                    # per app
+                    return self._send(200, {
+                        "apps": sorted(service._deployed),
+                        "ready": {name: rt.ready for name, rt
+                                  in list(service._deployed.items())},
+                        "pools": sorted(p.name for p in
+                                        service.templates.pools.values()),
+                    })
                 self._send(404, {"error": "not found"})
 
         self._server = ThreadingHTTPServer((host, port), Handler)
@@ -142,6 +232,7 @@ class SiddhiService:
     def stop(self) -> None:
         for name in list(self._deployed):
             self.undeploy(name)
+        self.templates.shutdown()
         self._server.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
@@ -149,18 +240,108 @@ class SiddhiService:
     # -- observability -----------------------------------------------------
     def readiness(self) -> tuple:
         """(all_ready, {app: ready}) — an app is ready when running and
-        its CompileService has no warmup in flight (core/compile.py).
+        its CompileService has no warmup in flight (core/compile.py);
+        tenant pools report as ``pool:<name>`` and gate /ready the same
+        way while their vmapped program set is compiling.
         Snapshots the deploy map first: probes race deploy/undeploy."""
         apps = {name: rt.ready
                 for name, rt in list(self._deployed.items())}
+        for pool in self.templates.pools.values():
+            apps[f"pool:{pool.name}"] = pool.ready
         return all(apps.values()), apps
 
     def metrics_text(self) -> str:
-        """One Prometheus scrape over every deployed app's registry."""
+        """One Prometheus scrape over every deployed app's registry plus
+        every tenant pool's (siddhi.<pool>.tenant.<id>.* gauges)."""
         parts = [rt.metrics.prometheus_text()
                  for rt in list(self._deployed.values())]
+        parts += [pool.metrics.prometheus_text()
+                  for pool in self.templates.pools.values()]
         text = "".join(p for p in parts if p)
         return text or "# no metrics (no apps deployed)\n"
+
+    # -- tenant operations (serving/, docs/serving.md) ---------------------
+    def tenant_deploy(self, body: dict) -> dict:
+        """Template + bindings -> pool slot. The FIRST deploy of a
+        (template, shared) pair creates the pool and AOT-warms its
+        vmapped program set; every later tenant is pure slot assignment
+        against the already-compiled programs."""
+        template = body.get("template")
+        tenant = body.get("tenant")
+        if not template or not tenant:
+            raise ValueError(
+                "tenant deploy body needs 'template' (text or "
+                "registered name) and 'tenant' (id)")
+        pool_conf = dict(body.get("pool") or {})
+        pool_kwargs = {k: pool_conf[k] for k in
+                       ("slots", "max_tenants", "state_quota_bytes",
+                        "batch_max", "pending_cap") if k in pool_conf}
+        pool = self.templates.pool(template,
+                                   shared=body.get("shared"),
+                                   **pool_kwargs)
+        pool.start()   # fair-batching drain worker (idempotent)
+        slot = pool.add_tenant(str(tenant), body.get("bindings"))
+        return {"status": "deployed", "app": pool.name,
+                "tenant": str(tenant), "slot": slot,
+                "template": pool.template.key, "ready": pool.ready,
+                "pool": {"slots": pool.slots,
+                         "active": len(pool._tenants),
+                         "max_tenants": pool.max_tenants}}
+
+    def _pool(self, pool_name: str):
+        for pool in self.templates.pools.values():
+            if pool.name == pool_name:
+                return pool
+        raise KeyError(f"no tenant pool '{pool_name}'")
+
+    def tenant_undeploy(self, pool_name: str, tenant: str) -> bool:
+        try:
+            pool = self._pool(pool_name)
+        except KeyError:
+            return False
+        return pool.remove_tenant(tenant)
+
+    def tenant_ingest(self, pool_name: str, tenant: str,
+                      body: dict) -> dict:
+        """JSON chunk -> pool queue: {"ts": [...], "rows": [[...], ...]}
+        (row-major; STRING cells as text). The fair-batching worker
+        dispatches it with the rest of the round."""
+        import numpy as np
+        from .types import AttrType, GLOBAL_STRINGS, np_dtype
+        pool = self._pool(pool_name)
+        rows = body.get("rows") or []
+        if not rows:
+            return {"accepted": 0}
+        schema = pool.proto.junctions[pool.ingest_stream].schema
+        if any(len(r) != len(schema.types) for r in rows):
+            raise ValueError(
+                f"rows must have {len(schema.types)} columns "
+                f"(stream '{pool.ingest_stream}')")
+        ts = body.get("ts")
+        if ts is None:
+            import time as _t
+            base = int(_t.time() * 1000)
+            ts = [base + i for i in range(len(rows))]
+        cols = []
+        for i, t in enumerate(schema.types):
+            vals = [r[i] for r in rows]
+            if t is AttrType.STRING:
+                vals = [GLOBAL_STRINGS.encode(str(v)) for v in vals]
+            cols.append(np.asarray(vals, dtype=np_dtype(t)))
+        pool.send(tenant, np.asarray(ts, dtype=np.int64), cols)
+        return {"accepted": len(rows)}
+
+    def tenant_stats(self, pool_name: str,
+                     tenant: str = None) -> dict:
+        pool = self._pool(pool_name)
+        stats = pool.statistics()
+        if tenant is None:
+            return stats
+        entry = stats["tenants"].get(tenant)
+        if entry is None:
+            raise KeyError(f"no tenant '{tenant}' in pool "
+                           f"'{pool_name}'")
+        return {"pool": pool_name, "tenant": tenant, **entry}
 
     # -- operations -------------------------------------------------------
     def deploy(self, siddhi_ql: str) -> str:
@@ -202,5 +383,12 @@ class SiddhiService:
         rt = self._deployed.pop(name, None)
         if rt is None:
             return False
+        # undeploy of a still-warming app: cancel the background AOT
+        # compiles FIRST (they would otherwise keep compiling for a dead
+        # app), then shut down, then join the warm threads so the
+        # inflight counter provably returns to zero instead of leaking
+        # behind a daemon thread (readiness bookkeeping stays exact)
+        rt.compile_service.cancel()
         rt.shutdown()
+        rt.compile_service.join(timeout=30)
         return True

@@ -127,6 +127,21 @@ class InTable(Expression):
     table_id: str
 
 
+@dataclasses.dataclass
+class TemplateParam(Expression):
+    """A `${name:type}` tenant-template placeholder (serving/template.py).
+
+    Unlike a Constant, the value is NOT baked into the compiled program:
+    it lowers to a runtime read of a per-tenant parameter carried in the
+    operator's state pytree, so every tenant of one template shares the
+    SAME jitted step and only the stacked parameter array differs.
+    `type` is the declared AttrType (None for an untyped `${name}`
+    placeholder that leaked past structural substitution — rejected by
+    the `template-binding` plan rule)."""
+    name: str
+    type: Optional[AttrType] = None
+
+
 # --------------------------------------------------------------------------
 # Definitions
 # --------------------------------------------------------------------------

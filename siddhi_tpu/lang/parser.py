@@ -1043,10 +1043,39 @@ class Parser:
         if t.kind == "KW" and t.value == "null":
             self.next()
             return A.Constant(value=None, type=AttrType.OBJECT)
+        if t.kind == "TPARAM":
+            self.next()
+            return self._template_param(t)
         # function / attribute reference / stream reference
         if t.kind in ("ID", "KW") or self.at_op("#", "!"):
             return self._parse_ref_or_function()
         self.fail("expected expression")
+
+    # declared `${name:type}` placeholder types (tenant templates)
+    _TPARAM_TYPES = {
+        "int": AttrType.INT, "long": AttrType.LONG,
+        "float": AttrType.FLOAT, "double": AttrType.DOUBLE,
+        "bool": AttrType.BOOL, "string": AttrType.STRING,
+    }
+
+    def _template_param(self, t: Token) -> A.TemplateParam:
+        body = str(t.value)
+        name, _, typename = body.partition(":")
+        name = name.strip()
+        typename = typename.strip().lower()
+        if not name.isidentifier():
+            self.fail(f"bad template placeholder name '${{{body}}}'")
+        if not typename:
+            # untyped: a structural placeholder that survived
+            # substitution — the template-binding plan rule rejects it
+            return A.TemplateParam(name=name, type=None)
+        at = self._TPARAM_TYPES.get(typename)
+        if at is None:
+            self.fail(
+                f"unknown template placeholder type '{typename}' in "
+                f"'${{{body}}}' (expected one of "
+                f"{', '.join(sorted(self._TPARAM_TYPES))})")
+        return A.TemplateParam(name=name, type=at)
 
     def _parse_ref_or_function(self) -> A.Expression:
         is_inner = bool(self.accept_op("#"))
@@ -1127,7 +1156,8 @@ class Parser:
 # -------------------------------------------------------------------------- #
 
 
-def parse(text: str, validate: bool = True) -> A.SiddhiApp:
+def parse(text: str, validate: bool = True,
+          template: bool = False) -> A.SiddhiApp:
     """Parse a SiddhiQL app and statically validate the plan.
 
     Validation raises CompileError here — at compile time, with the
@@ -1137,12 +1167,18 @@ def parse(text: str, validate: bool = True) -> A.SiddhiApp:
     fire (analysis/plan_rules.py), plus everything type-shaped — schema
     inference over the dataflow graph, expression dtypes, insert-into
     schema compatibility (analysis/typecheck.py). ``validate=False``
-    skips both (the planner still applies its own checks)."""
-    app = Parser(update_variables(text)).parse_app()
+    skips both (the planner still applies its own checks).
+
+    ``template=True`` parses a tenant template (serving/template.py):
+    typed `${name:type}` placeholders stay in the AST as TemplateParam
+    nodes (per-tenant runtime parameters) instead of being rejected as
+    unbound, and `${name}` env substitution is skipped — structural
+    placeholders are the Template's to bind, not the environment's."""
+    app = Parser(text if template else update_variables(text)).parse_app()
     if validate:
         from ..analysis.plan_rules import check_app
         from ..analysis.typecheck import check_app as check_types
-        check_app(app)
+        check_app(app, allow_template_params=template)
         check_types(app)
     return app
 

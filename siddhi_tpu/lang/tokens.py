@@ -146,6 +146,22 @@ def tokenize(text: str) -> list[Token]:
             adv(j + 1 - i)
             toks.append(Token("STRING", s, s, l0, c0))
             continue
+        # template placeholder `${name}` / `${name:type}` (tenant
+        # templates, serving/template.py). Untyped `${name}` normally
+        # never reaches the lexer — SiddhiCompiler-style env substitution
+        # (parser.update_variables) or the Template's structural binding
+        # pass replaces it first — but when it does, the parser builds an
+        # untyped TemplateParam and the `template-binding` plan rule
+        # rejects it with a proper CompileError.
+        if c == "$" and i + 1 < n and text[i + 1] == "{":
+            j = text.find("}", i + 2)
+            if j == -1:
+                err("unterminated template placeholder '${'")
+            body = text[i + 2:j]
+            raw = text[i:j + 1]
+            adv(j + 1 - i)
+            toks.append(Token("TPARAM", body, raw, l0, c0))
+            continue
         # backquoted id
         if c == "`":
             j = text.find("`", i + 1)

@@ -116,6 +116,52 @@ def env_from_batch(batch) -> dict:
     return env
 
 
+def collect_template_params(*exprs) -> tuple:
+    """((name, AttrType), ...) for every `${name:type}` placeholder in the
+    given expression trees, first-use order, deduplicated. Untyped or
+    type-conflicting placeholders raise CompileError (the template-binding
+    plan rule reports the same conditions with query anchors earlier)."""
+    out: list = []
+    seen: dict = {}
+    for expr in exprs:
+        if expr is None:
+            continue
+        for e in A.walk_expressions(expr):
+            if not isinstance(e, A.TemplateParam):
+                continue
+            if e.type is None:
+                raise CompileError(
+                    f"template placeholder '${{{e.name}}}' has no "
+                    "declared type")
+            prev = seen.get(e.name)
+            if prev is None:
+                seen[e.name] = e.type
+                out.append((e.name, e.type))
+            elif prev is not e.type:
+                raise CompileError(
+                    f"template placeholder '${{{e.name}}}' declared with "
+                    f"conflicting types {prev.value} and {e.type.value}")
+    return tuple(out)
+
+
+def tparam_env(env: dict, tparams: tuple, state) -> None:
+    """Thread per-tenant parameter values from an operator's state pytree
+    into a compiled-expression env (scalars per trace; a (slots,) stacked
+    axis once the serving pool vmaps the step over tenants)."""
+    vals = state["tparams"]
+    for name, _t in tparams:
+        env[("tparam", name)] = Col(vals[name],
+                                    jnp.zeros((), dtype=jnp.bool_))
+
+
+def tparam_init_state(tparams: tuple) -> dict:
+    """Zero-valued parameter state for an operator that reads template
+    params ({'tparams': {name: 0-d array}}); the pool overwrites the
+    tenant's slot at add_tenant time."""
+    return {"tparams": {n: jnp.zeros((), dtype=np_dtype(t))
+                        for n, t in tparams}}
+
+
 # ---------------------------------------------------------------------------
 # helpers
 # ---------------------------------------------------------------------------
@@ -160,6 +206,30 @@ def compile_expression(expr: A.Expression, scope: Scope,
                                    "only valid in IS NULL")
             key, t = scope.resolve(e)
             return CompiledExpr(t, lambda env, k=key: env[k])
+
+        if isinstance(e, A.TemplateParam):
+            # tenant-template placeholder: a RUNTIME read of a per-tenant
+            # parameter the operator carries in its state pytree (FilterOp
+            # / ProjectOp thread them into env under ("tparam", name)).
+            # NOT a baked constant — that is what lets every tenant of a
+            # template share one jitted step (serving/pool.py vmaps the
+            # step over the stacked parameter axis).
+            if e.type is None:
+                raise CompileError(
+                    f"template placeholder '${{{e.name}}}' has no "
+                    "declared type — structural placeholders must be "
+                    "bound before planning (serving/template.py)")
+
+            def fn(env, name=e.name):
+                col = env.get(("tparam", name))
+                if col is None:
+                    raise CompileError(
+                        f"template param '${{{name}}}' reached an "
+                        "operator that does not carry tenant parameters "
+                        "(params are supported in filter conditions and "
+                        "non-aggregating select/having only)")
+                return col
+            return CompiledExpr(e.type, fn)
 
         if isinstance(e, A.MathOp):
             return _compile_math(e, comp)

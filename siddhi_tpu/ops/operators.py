@@ -12,7 +12,8 @@ from typing import Any
 import jax.numpy as jnp
 
 from ..core.event import CURRENT, TIMER, EventBatch
-from .expr import CompiledExpr, env_from_batch
+from .expr import (CompiledExpr, env_from_batch, tparam_env,
+                   tparam_init_state)
 
 
 class Operator:
@@ -48,13 +49,23 @@ class FilterOp(Operator):
     TIMER events pass through untouched so downstream scheduling operators
     still observe time."""
 
-    def __init__(self, cond: CompiledExpr, schema):
+    def __init__(self, cond: CompiledExpr, schema, tparams: tuple = ()):
         self.cond = cond
         self.schema = schema
+        # `${name:type}` tenant-template params the condition reads: the
+        # VALUES live in this operator's state pytree (not baked into the
+        # trace), so the serving pool stacks them on the tenant axis and
+        # every tenant shares one compiled step (serving/pool.py)
+        self.tparams = tuple(tparams)
+
+    def init_state(self):
+        return tparam_init_state(self.tparams) if self.tparams else ()
 
     def step(self, state, batch: EventBatch, now):
         env = env_from_batch(batch)
         env["__now__"] = now
+        if self.tparams:
+            tparam_env(env, self.tparams, state)
         c = self.cond.fn(env)
         keep = (c.values & ~c.nulls) | (batch.kind == TIMER)
         return state, batch.mask(keep)

@@ -14,7 +14,9 @@ import jax.numpy as jnp
 from ..core.event import CURRENT, EXPIRED, Attribute, EventBatch, StreamSchema
 from ..core.types import AttrType
 from ..lang import ast as A
-from .expr import Col, CompileError, CompiledExpr, Scope, compile_expression, env_from_batch
+from .expr import (Col, CompileError, CompiledExpr, Scope,
+                   collect_template_params, compile_expression,
+                   env_from_batch, tparam_env, tparam_init_state)
 from .keyed import cumsum_fast
 from .operators import Operator
 
@@ -137,6 +139,12 @@ class ProjectOp(Operator):
         self.in_schema = in_schema
         self.current_on = current_on
         self.expired_on = expired_on
+        # `${name:type}` tenant-template params in select/having: values
+        # ride this operator's state pytree so the serving pool can stack
+        # them per tenant without recompiling (see ops/expr.py)
+        self.tparams = collect_template_params(
+            *[oa.expression for oa in selector.attributes],
+            selector.having)
         if selector.select_all:
             self._passthrough = True
             self._schema = StreamSchema(out_stream_id, in_schema.attributes)
@@ -178,6 +186,9 @@ class ProjectOp(Operator):
             self.host_shape = None
         self.sort_heavy = bool(self.order_by)
 
+    def init_state(self):
+        return tparam_init_state(self.tparams) if self.tparams else ()
+
     def step(self, state, batch: EventBatch, now):
         gate = batch.valid & (
             ((batch.kind == CURRENT) & self.current_on) |
@@ -187,6 +198,8 @@ class ProjectOp(Operator):
         else:
             env = env_from_batch(batch)
             env["__now__"] = now
+            if self.tparams:
+                tparam_env(env, self.tparams, state)
             cols, nulls = [], []
             for ce in self.compiled:
                 c = ce.fn(env)
@@ -203,6 +216,8 @@ class ProjectOp(Operator):
         if self.having is not None:
             henv = env_from_batch(out)
             henv["__now__"] = now
+            if self.tparams:
+                tparam_env(henv, self.tparams, state)
             if self._having_in:
                 for k, v in env_from_batch(batch).items():
                     if isinstance(k, tuple) and k[0] == "attr":

@@ -1,0 +1,14 @@
+"""Multi-tenant serving subsystem (docs/serving.md).
+
+Thousands of tenants of ONE query template share ONE compiled program
+set: the template compiles once (`${name:type}` placeholders lower to
+per-tenant runtime parameters, not baked literals), per-tenant state
+stacks on a leading tenant axis, and `jax.vmap` advances every tenant
+of a template in a single dispatch. See ROADMAP item 2 and the Diba
+pre-staged re-configurable processing units (PAPERS.md).
+"""
+from .template import Template, TemplateRegistry
+from .pool import AdmissionError, TenantPool
+
+__all__ = ["Template", "TemplateRegistry", "TenantPool",
+           "AdmissionError"]

@@ -1,0 +1,779 @@
+"""TenantPool: vmapped multi-tenant execution of one compiled template.
+
+One pool = one template (+ shared structural bindings) = ONE compiled
+program set. Per-tenant state pytrees stack on a leading tenant axis;
+`jax.vmap` over the standard `_chain_body` trace advances EVERY tenant
+of the template in a single dispatch. Tenant `${name:type}` parameters
+ride the stacked operator state (ops/expr.py tparam machinery), so
+tenant add/remove is pure slot assignment — `.at[slot].set` writes, no
+retrace, no recompile (counting-jit guarded in tests/test_serving.py).
+
+Capacity model (`@app:cap(tenants=..., tenant.state.kb=...)` dial or
+constructor knobs):
+
+- the slot axis starts small and GROWS BY DOUBLING when tenants exceed
+  it (a doubling is a recompile — amortized log2(max) compiles over the
+  pool's lifetime; steady-state churn compiles nothing);
+- admission control rejects deploys past `max_tenants` or past the
+  per-tenant state quota with a reason string the service maps to
+  HTTP 429;
+- ingest is FAIR ROUND-ROBIN: each tenant contributes at most
+  `batch_max` rows per dispatch round (the @Async batch.size.max dial,
+  tenant-aware), so one hot tenant cannot starve the rest — its backlog
+  just spans more rounds, bounded by `pending_cap` backpressure.
+
+Isolation:
+
+- `statistics()` / the metrics registry namespace per-tenant gauges as
+  ``siddhi.<pool>.tenant.<id>.*``, collected with ONE device_get per
+  pool (O(templates), not O(tenants) device reads);
+- a tenant callback failure routes the events to THAT tenant's error
+  store partition (``<pool>/tenant/<id>``, PR 2 error store) and never
+  touches other tenants' delivery;
+- `snapshot_tenant` / `restore_tenant` slice exactly one index of the
+  tenant axis — other tenants' state stays bit-identical.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from collections import deque
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.plan_rules import check_template_bindings
+from ..core.event import EXPIRED, EventBatch, rows_from_batch
+from ..core.runtime import (BATCH_BUCKETS, InsertIntoStreamHandler,
+                            QueryRuntime, SiddhiAppRuntime, _as_current,
+                            _chain_body, _donate, bucket_capacity)
+from ..core.stream import Event
+from ..core.types import AttrType, GLOBAL_STRINGS, np_dtype
+from ..lang import ast as A
+from ..ops.expr import CompileError
+
+log = logging.getLogger("siddhi_tpu.serving")
+
+_DEFAULT_MAX_TENANTS = 1024
+_DEFAULT_BATCH_MAX = 1024
+_DEFAULT_PENDING_CAP = 1 << 20   # rows buffered per tenant before 429
+
+
+class AdmissionError(Exception):
+    """Deploy/ingest rejected by admission control (HTTP 429 at the
+    front door); `.reason` names the exhausted resource."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _tree_zeros(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(jnp.shape(x), jnp.result_type(x)), tree)
+
+
+class TenantPool:
+    """Stacked/vmapped runtime for every tenant of one template."""
+
+    def __init__(self, template, shared: Optional[dict] = None,
+                 manager=None, name: Optional[str] = None,
+                 slots: int = 8, max_tenants: Optional[int] = None,
+                 state_quota_bytes: Optional[int] = None,
+                 batch_max: Optional[int] = None,
+                 pending_cap: int = _DEFAULT_PENDING_CAP):
+        from ..core.manager import SiddhiManager
+        from ..obs.metrics import MetricsRegistry
+        self.template = template
+        self.shared = dict(shared or {})
+        self.name = name or f"pool_{template.key[:8]}"
+        self.manager = manager or SiddhiManager()
+        app_ast = template.instantiate(shared=self.shared,
+                                       app_name=self.name)
+        # prototype runtime: planned once, NEVER started — the pool
+        # dispatches vmapped variants of its operator chains and its
+        # CompileService carries the pool's one-program-set telemetry
+        self.proto = SiddhiAppRuntime(app_ast, manager=None)
+        self._plan_topology()
+        self._check_poolable()
+
+        cap_ann = A.find_annotation(app_ast.annotations, "cap")
+        if max_tenants is None:
+            max_tenants = int(cap_ann.element("tenants")
+                              or _DEFAULT_MAX_TENANTS) \
+                if cap_ann else _DEFAULT_MAX_TENANTS
+        if state_quota_bytes is None and cap_ann is not None:
+            kb = cap_ann.element("tenant.state.kb")
+            if kb is not None:
+                state_quota_bytes = int(kb) * 1024 * max_tenants
+        self.max_tenants = int(max_tenants)
+        self.state_quota_bytes = state_quota_bytes
+        if batch_max is None:
+            batch_max = _DEFAULT_BATCH_MAX
+        # fair-share row cap per tenant per round; bucketed so dispatch
+        # capacities land on warm jit cache keys, and capped by the
+        # sort-heavy step limits of the template's queries
+        for q in self.proto.queries.values():
+            if q.max_step_capacity is not None:
+                batch_max = min(batch_max, q.max_step_capacity)
+        self.batch_max = bucket_capacity(int(batch_max))
+        self.pending_cap = int(pending_cap)
+
+        self.slots = _pow2(max(1, min(int(slots), self.max_tenants)))
+        self._slot_cap = _pow2(self.max_tenants)
+        # stacked per-query state: leading axis = tenant slot
+        self._states = {qn: self._stack_init(qn, self.slots)
+                        for qn in self._order}
+        self._emitted = {qn: jnp.zeros((self.slots,), jnp.int64)
+                         for qn in self._order}
+        # per-tenant state bytes (quota accounting): one slot's slice of
+        # every query state plus its emitted counter
+        self.state_bytes_per_tenant = 8 * len(self._order) + sum(
+            leaf.nbytes // self.slots
+            for qn in self._order
+            for leaf in jax.tree_util.tree_leaves(self._states[qn]))
+
+        self._tenants: dict[str, int] = {}
+        self._free = list(range(self.slots - 1, -1, -1))
+        self._pending: dict[str, deque] = {}
+        self._pending_rows: dict[str, int] = {}
+        self._callbacks: dict[str, list[Callable]] = {}
+        self._error_counts: dict[str, int] = {}
+        self.batch_callbacks: list[Callable] = []
+        self._vsteps: dict = {}
+        self._lock = threading.RLock()
+        self._now = -(2 ** 62)
+        self._rounds = 0
+        self._dispatches = 0
+        self._grows = 0
+        self._warmed = False
+        self._running = False
+        self._worker: Optional[threading.Thread] = None
+        self._work = threading.Condition(self._lock)
+        self.metrics = MetricsRegistry()
+        self.metrics.register_collector(
+            lambda: self._collect_observability()[0])
+
+    # -- planning ---------------------------------------------------------
+
+    def _plan_topology(self) -> None:
+        """Derive the linear/fan-out query wiring from the prototype's
+        junction graph: ONE ingest stream in, queries in topological
+        order, terminal streams (produced, never consumed) out."""
+        p = self.proto
+        self._q_in: dict[str, str] = {}
+        self._q_out: dict[str, Optional[str]] = {}
+        produced: set[str] = set()
+        consumers: dict[str, list[str]] = {}
+        for qn, q in p.queries.items():
+            self._q_in[qn] = q.in_schema.stream_id
+            consumers.setdefault(q.in_schema.stream_id, []).append(qn)
+            out = None
+            for h in q.output_handlers:
+                if isinstance(h, InsertIntoStreamHandler):
+                    out = h.junction.stream_id
+                    produced.add(out)
+            self._q_out[qn] = out
+        ingest = sorted(sid for sid in consumers if sid not in produced)
+        self._ingest_streams = ingest
+        # topological order (BFS from the ingest streams)
+        avail = set(ingest)
+        order: list[str] = []
+        remaining = dict(self._q_in)
+        while remaining:
+            placed = [qn for qn, sid in remaining.items() if sid in avail]
+            if not placed:
+                break   # unreachable/cyclic queries — poolability rejects
+            for qn in sorted(placed):
+                order.append(qn)
+                remaining.pop(qn)
+                if self._q_out[qn]:
+                    avail.add(self._q_out[qn])
+        self._order = order
+        self._unreachable = sorted(remaining)
+        self._terminal = sorted(
+            sid for sid in produced if sid not in consumers)
+
+    def _check_poolable(self) -> None:
+        p = self.proto
+        problems = []
+        for attr, what in (("partitions", "partitions"),
+                           ("aggregations", "incremental aggregations"),
+                           ("named_windows", "named windows"),
+                           ("tables", "tables"),
+                           ("record_tables", "@Store tables"),
+                           ("triggers", "triggers")):
+            if getattr(p, attr):
+                problems.append(what)
+        if p.sources or p.sinks:
+            problems.append("@source/@sink connectors")
+        for qn, q in p.queries.items():
+            if type(q) is not QueryRuntime:
+                problems.append(
+                    f"query '{qn}' ({type(q).__name__}: joins/patterns)")
+            elif q.table_deps:
+                problems.append(f"query '{qn}' reads tables")
+            elif self._q_out.get(qn) is None:
+                problems.append(
+                    f"query '{qn}' has a non-insert-into output")
+        if len(self._ingest_streams) != 1:
+            problems.append(
+                f"{len(self._ingest_streams)} ingest streams "
+                "(exactly one supported)")
+        if self._unreachable:
+            problems.append(
+                f"unreachable queries {', '.join(self._unreachable)}")
+        if problems:
+            raise CompileError(
+                f"template '{self.template.name}' is not poolable — "
+                "vmapped tenant execution covers plain filter/window/"
+                "projection insert-into chains on one ingest stream; "
+                "found: " + "; ".join(problems))
+
+    @property
+    def ingest_stream(self) -> str:
+        return self._ingest_streams[0]
+
+    # -- state stacking ---------------------------------------------------
+
+    def _stack_init(self, qname: str, slots: int):
+        init = tuple(op.init_state()
+                     for op in self.proto.queries[qname].operators)
+        return jax.tree_util.tree_map(
+            lambda x: jnp.repeat(jnp.asarray(x)[None], slots, axis=0),
+            init)
+
+    def _tenant_init_states(self, qname: str, vals: dict):
+        """One tenant's fresh (unstacked) state tuple with its bound
+        `${...}` parameter values in place of the zeros."""
+        states = []
+        for op in self.proto.queries[qname].operators:
+            st = op.init_state()
+            tps = getattr(op, "tparams", ())
+            if tps:
+                st = {"tparams": {
+                    n: jnp.asarray(self._encode_param(vals[n][0], t),
+                                   dtype=np_dtype(t))
+                    for n, t in tps}}
+            states.append(st)
+        return tuple(states)
+
+    @staticmethod
+    def _encode_param(value, t: AttrType):
+        if t is AttrType.STRING:
+            return GLOBAL_STRINGS.encode(str(value))
+        if t is AttrType.BOOL:
+            return bool(value)
+        return value
+
+    # -- tenant lifecycle -------------------------------------------------
+
+    def admit(self) -> tuple[bool, str]:
+        """Admission control: (ok, reason). Checked by add_tenant and by
+        the service front door BEFORE building anything (429 + reason)."""
+        if len(self._tenants) >= self.max_tenants:
+            return False, (f"pool '{self.name}' tenant slots exhausted "
+                           f"(cap {self.max_tenants})")
+        if self.state_quota_bytes is not None:
+            need = (len(self._tenants) + 1) * self.state_bytes_per_tenant
+            if need > self.state_quota_bytes:
+                return False, (
+                    f"pool '{self.name}' per-tenant state quota "
+                    f"exhausted ({need} > {self.state_quota_bytes} bytes "
+                    f"at {self.state_bytes_per_tenant} bytes/tenant)")
+        return True, ""
+
+    def add_tenant(self, tenant_id: str,
+                   bindings: Optional[dict] = None) -> int:
+        """Admit a tenant into a slot: validate bindings
+        (template-binding rule), reset the slot's state slice, write the
+        stacked parameter values. Steady-state adds compile NOTHING —
+        only a growth doubling does."""
+        with self._lock:
+            if tenant_id in self._tenants:
+                raise ValueError(
+                    f"tenant '{tenant_id}' is already deployed in pool "
+                    f"'{self.name}'")
+            ok, reason = self.admit()
+            if not ok:
+                raise AdmissionError(reason)
+            vals = check_template_bindings(self.proto.ast,
+                                           dict(bindings or {}))
+            if not self._free:
+                self._grow()
+            slot = self._free.pop()
+            for qn in self._order:
+                init = self._tenant_init_states(qn, vals)
+                self._states[qn] = jax.tree_util.tree_map(
+                    lambda full, iv: full.at[slot].set(iv),
+                    self._states[qn], init)
+                self._emitted[qn] = self._emitted[qn].at[slot].set(0)
+            self._tenants[tenant_id] = slot
+            self._pending[tenant_id] = deque()
+            self._pending_rows[tenant_id] = 0
+            self._error_counts[tenant_id] = 0
+            return slot
+
+    def remove_tenant(self, tenant_id: str) -> bool:
+        """Free the tenant's slot (state stays masked-out until the slot
+        is reassigned — zero recompiles)."""
+        with self._lock:
+            slot = self._tenants.pop(tenant_id, None)
+            if slot is None:
+                return False
+            self._free.append(slot)
+            self._pending.pop(tenant_id, None)
+            self._pending_rows.pop(tenant_id, None)
+            self._callbacks.pop(tenant_id, None)
+            self._error_counts.pop(tenant_id, None)
+            return True
+
+    def _grow(self) -> None:
+        new_slots = self.slots * 2
+        if new_slots > self._slot_cap:
+            raise AdmissionError(
+                f"pool '{self.name}' tenant slots exhausted "
+                f"(cap {self.max_tenants})")
+        log.info("pool '%s': growing tenant axis %d -> %d slots "
+                 "(programs recompile at the new width)",
+                 self.name, self.slots, new_slots)
+
+        def pad(x):
+            return jnp.concatenate(
+                [x, jnp.zeros((self.slots,) + x.shape[1:], x.dtype)],
+                axis=0)
+        self._states = {qn: jax.tree_util.tree_map(pad, st)
+                        for qn, st in self._states.items()}
+        self._emitted = {qn: pad(e) for qn, e in self._emitted.items()}
+        self._free.extend(range(new_slots - 1, self.slots - 1, -1))
+        self.slots = new_slots
+        self._vsteps.clear()
+        self._grows += 1
+        self._warmed = False
+
+    def _slot(self, tenant_id: str) -> int:
+        slot = self._tenants.get(tenant_id)
+        if slot is None:
+            raise KeyError(f"no tenant '{tenant_id}' in pool "
+                           f"'{self.name}'")
+        return slot
+
+    def tenant_partition(self, tenant_id: str) -> str:
+        """Error-store partition key for one tenant (PR 2 store SPI keys
+        by app name; each tenant gets its own namespace)."""
+        return f"{self.name}/tenant/{tenant_id}"
+
+    def add_callback(self, tenant_id: str, fn: Callable) -> None:
+        """Per-tenant output callback: fn(events) with the tenant's rows
+        of every terminal stream. A raising callback routes ITS events
+        to ITS error-store partition; other tenants are unaffected."""
+        with self._lock:
+            self._slot(tenant_id)
+            self._callbacks.setdefault(tenant_id, []).append(fn)
+
+    # -- ingest (fair round-robin batching) -------------------------------
+
+    def send(self, tenant_id: str, ts, cols) -> None:
+        """Queue one columnar chunk for a tenant (numpy ts + columns,
+        STRING columns as dictionary codes — the send_arrays contract).
+        Dispatch happens in fair rounds via pump()/flush() or the
+        background worker."""
+        ts = np.asarray(ts, dtype=np.int64)
+        n = int(ts.shape[0])
+        if n == 0:
+            return
+        cols = [np.ascontiguousarray(c) for c in cols]
+        with self._lock:
+            self._slot(tenant_id)
+            if self._pending_rows[tenant_id] + n > self.pending_cap:
+                raise AdmissionError(
+                    f"tenant '{tenant_id}' ingest backlog full "
+                    f"({self._pending_rows[tenant_id]} rows pending, "
+                    f"cap {self.pending_cap})")
+            self._pending[tenant_id].append((ts, cols))
+            self._pending_rows[tenant_id] += n
+            self._work.notify()
+
+    def _take(self, tenant_id: str, limit: int):
+        """Up to `limit` rows off a tenant's pending queue (splitting a
+        chunk re-queues the remainder at the head — order preserved)."""
+        q = self._pending.get(tenant_id)
+        if not q:
+            return None
+        ts_parts, col_parts, taken = [], [], 0
+        while q and taken < limit:
+            ts, cols = q.popleft()
+            room = limit - taken
+            if len(ts) > room:
+                q.appendleft((ts[room:], [c[room:] for c in cols]))
+                ts, cols = ts[:room], [c[:room] for c in cols]
+            ts_parts.append(ts)
+            col_parts.append(cols)
+            taken += len(ts)
+        if not taken:
+            return None
+        self._pending_rows[tenant_id] -= taken
+        ts = np.concatenate(ts_parts)
+        cols = [np.concatenate([p[i] for p in col_parts])
+                for i in range(len(col_parts[0]))]
+        return ts, cols
+
+    def pump(self) -> int:
+        """One fair dispatch round: every tenant contributes up to
+        batch_max rows, ONE vmapped step per query advances all of them.
+        Returns rows dispatched (0 = nothing pending)."""
+        with self._lock:
+            per_slot = {}
+            taken = 0
+            last_ts = self._now
+            for tid, slot in self._tenants.items():
+                got = self._take(tid, self.batch_max)
+                if got is None:
+                    continue
+                per_slot[slot] = got
+                taken += len(got[0])
+                last_ts = max(last_ts, int(got[0][-1]))
+            if not taken:
+                return 0
+            self._now = max(self._now, last_ts)
+            cap = bucket_capacity(
+                max(len(r[0]) for r in per_slot.values()))
+            batch = self._stacked_batch(per_slot, cap)
+            terminal = self._dispatch(batch, self._now)
+            self._rounds += 1
+        self._deliver(terminal)
+        return taken
+
+    def flush(self) -> int:
+        """Drain every pending chunk through fair rounds."""
+        total = 0
+        while True:
+            n = self.pump()
+            if n == 0:
+                return total
+            total += n
+
+    def advance_time(self, now_ms: int) -> None:
+        """Drive time-based window boundaries with no traffic: one
+        empty-batch dispatch at the given event time (all slots
+        masked invalid — same compiled programs as a tiny round)."""
+        with self._lock:
+            self._now = max(self._now, int(now_ms))
+            batch = self._stacked_batch({}, BATCH_BUCKETS[0])
+            terminal = self._dispatch(batch, self._now)
+        self._deliver(terminal)
+
+    # -- dispatch ---------------------------------------------------------
+
+    def _stacked_batch(self, per_slot: dict, cap: int) -> EventBatch:
+        """(slots, cap) stacked EventBatch from per-slot row chunks; one
+        device_put for the whole pytree. Slots without rows are
+        all-padding (their tenants' states pass through unchanged)."""
+        schema = self.proto.junctions[self.ingest_stream].schema
+        N = self.slots
+        ts = np.zeros((N, cap), np.int64)
+        valid = np.zeros((N, cap), np.bool_)
+        kind = np.zeros((N, cap), np.int32)
+        cols = [np.zeros((N, cap), np_dtype(t)) for t in schema.types]
+        for slot, (t, cs) in per_slot.items():
+            n = len(t)
+            ts[slot, :n] = t
+            valid[slot, :n] = True
+            for i, c in enumerate(cs):
+                cols[i][slot, :n] = c
+        batch = EventBatch(
+            ts=ts, cols=tuple(cols),
+            nulls=tuple(np.zeros((N, cap), np.bool_) for _ in cols),
+            kind=kind, valid=valid)
+        return jax.device_put(batch)
+
+    def _vstep_for(self, qname: str, cap: int) -> Callable:
+        # warm_specs builders run on compile-pool threads; the lock keeps
+        # concurrent builds from double-creating (and double-compiling)
+        # the same jit wrapper
+        with self._lock:
+            return self._vstep_for_locked(qname, cap)
+
+    def _vstep_for_locked(self, qname: str, cap: int) -> Callable:
+        key = (qname, cap, self.slots)
+        fn = self._vsteps.get(key)
+        if fn is None:
+            q = self.proto.queries[qname]
+            chain = _chain_body(q.operators, q._has_timers)
+            rewrite = self._q_out.get(qname) is not None
+
+            def body(states, emitted, batch, now):
+                states, _t, emitted, out, _due = chain(
+                    states, {}, emitted, batch, now)
+                if rewrite:
+                    # insert-into kind rewrite inside the trace, exactly
+                    # like FusedChain hops
+                    out = _as_current(out)
+                return states, emitted, out
+
+            fn = jax.jit(jax.vmap(body, in_axes=(0, 0, 0, None)),
+                         **_donate(0, 1))
+            self._vsteps[key] = fn
+        return fn
+
+    def _dispatch(self, ingest_batch: EventBatch, now: int) -> dict:
+        """Run the template's query chain over one stacked round;
+        returns {terminal stream id: stacked out batch} (device)."""
+        now_dev = jnp.asarray(now, dtype=jnp.int64)
+        stream_batches = {self.ingest_stream: ingest_batch}
+        terminal: dict = {}
+        for qname in self._order:
+            batch = stream_batches.get(self._q_in[qname])
+            if batch is None:
+                continue
+            cap = int(batch.ts.shape[1])
+            step = self._vstep_for(qname, cap)
+            states, emitted, out = step(
+                self._states[qname], self._emitted[qname], batch,
+                now_dev)
+            self._states[qname] = states
+            self._emitted[qname] = emitted
+            self._dispatches += 1
+            tgt = self._q_out[qname]
+            if tgt in self._terminal:
+                terminal[tgt] = out
+            elif tgt is not None:
+                stream_batches[tgt] = out
+        return terminal
+
+    def _deliver(self, terminal: dict) -> None:
+        for fn in self.batch_callbacks:
+            fn(terminal)   # device batches, zero sync (bench fast path)
+        if not self._callbacks or not terminal:
+            return
+        host = jax.device_get(terminal)   # ONE transfer for every tenant
+        with self._lock:
+            targets = [(tid, self._tenants[tid], list(cbs))
+                       for tid, cbs in self._callbacks.items()
+                       if tid in self._tenants]
+        for tid, slot, cbs in targets:
+            for sid, out in host.items():
+                events = self._decode_slot(sid, out, slot)
+                if not events:
+                    continue
+                for cb in cbs:
+                    try:
+                        cb(events)
+                    except Exception as exc:  # noqa: BLE001 — isolate
+                        self._tenant_error(tid, sid, events, exc)
+
+    def _decode_slot(self, sid: str, host_out, slot: int) -> list:
+        types = self.proto.junctions[sid].schema.types
+        row = EventBatch(
+            ts=host_out.ts[slot], cols=tuple(c[slot]
+                                             for c in host_out.cols),
+            nulls=tuple(nl[slot] for nl in host_out.nulls),
+            kind=host_out.kind[slot], valid=host_out.valid[slot])
+        return [Event(ts, vals, is_expired=(kind == EXPIRED))
+                for ts, kind, vals in rows_from_batch(types, row)]
+
+    def _tenant_error(self, tid: str, sid: str, events: list,
+                      exc: Exception) -> None:
+        """Sink-failure isolation: the failing tenant's events land in
+        ITS error-store partition; delivery to other tenants continues."""
+        from ..resilience.errorstore import ErroredEvent
+        with self._lock:
+            self._error_counts[tid] = \
+                self._error_counts.get(tid, 0) + len(events)
+        try:
+            self.proto._error_store().store(
+                self.tenant_partition(tid),
+                ErroredEvent.from_events(
+                    sid, events, f"{type(exc).__name__}: {exc}",
+                    now=self._now))
+        except Exception:  # noqa: BLE001 — isolation must not cascade
+            log.exception("pool '%s': error-store write failed for "
+                          "tenant '%s'", self.name, tid)
+        log.warning("pool '%s': tenant '%s' callback failed on stream "
+                    "'%s' (%d event(s) -> partition '%s'): %s",
+                    self.name, tid, sid, len(events),
+                    self.tenant_partition(tid), exc)
+
+    # -- AOT warmup (one program set per template) ------------------------
+
+    def warmup(self, caps=None, workers: Optional[int] = None) -> dict:
+        """Compile the pool's vmapped step programs through the
+        prototype's PR 5 CompileService (parallel lowering + persistent
+        cache + telemetry) BEFORE the first tenant's traffic: telemetry
+        lands in statistics()['compile'] exactly once per pool no matter
+        how many tenants deploy."""
+        from ..core.compile import CompileSpec
+        caps = sorted({bucket_capacity(min(int(c), self.batch_max))
+                       for c in (caps or (self.batch_max,))})
+        specs = []
+        with self._lock:
+            slots = self.slots
+            for cap in caps:
+                for qname in self._order:
+                    def build(qname=qname, cap=cap):
+                        fn = self._vstep_for(qname, cap)
+                        states = _tree_zeros(self._states[qname])
+                        emitted = jnp.zeros((slots,), jnp.int64)
+                        schema = self.proto.queries[qname].in_schema
+                        N = slots
+                        batch = EventBatch(
+                            ts=jnp.zeros((N, cap), jnp.int64),
+                            cols=tuple(jnp.zeros((N, cap), np_dtype(t))
+                                       for t in schema.types),
+                            nulls=tuple(jnp.zeros((N, cap), jnp.bool_)
+                                        for _ in schema.types),
+                            kind=jnp.zeros((N, cap), jnp.int32),
+                            valid=jnp.zeros((N, cap), jnp.bool_))
+                        return fn, (states, emitted, batch,
+                                    jnp.asarray(0, jnp.int64))
+                    specs.append(CompileSpec(
+                        f"{self.name}/{qname}/v{slots}x{cap}", build))
+        result = self.proto.compile_service.warm_specs(specs,
+                                                       workers=workers)
+        self._warmed = True
+        return result
+
+    @property
+    def ready(self) -> bool:
+        """Load-balancer readiness: no AOT warmup in flight."""
+        return self.proto.compile_service.ready
+
+    # -- background worker (service front door) ---------------------------
+
+    def start(self) -> None:
+        """Run the fair-batching drain loop on a daemon thread (the
+        tenant-aware @Async queue worker)."""
+        with self._lock:
+            if self._running:
+                return
+            self._running = True
+        self._worker = threading.Thread(
+            target=self._drain_loop, daemon=True,
+            name=f"tenantpool-{self.name}")
+        self._worker.start()
+
+    def _drain_loop(self) -> None:
+        while True:
+            with self._lock:
+                while self._running and not any(
+                        self._pending_rows.get(t, 0)
+                        for t in self._tenants):
+                    self._work.wait(timeout=0.5)
+                if not self._running:
+                    return
+            try:
+                self.pump()
+            except Exception:  # noqa: BLE001 — keep serving other rounds
+                log.exception("pool '%s': dispatch round failed",
+                              self.name)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._running = False
+            self._work.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout=5)
+            self._worker = None
+
+    # -- per-tenant snapshot / restore ------------------------------------
+
+    def snapshot_tenant(self, tenant_id: str) -> bytes:
+        """One tenant's state: the tenant-axis slice of every query
+        state + emitted counter, serialized with the restricted
+        snapshot pickler (core/persistence.py)."""
+        from ..core.persistence import dump_strings, serialize
+        with self._lock:
+            slot = self._slot(tenant_id)
+            payload = {
+                "pool": self.name,
+                "template": self.template.key,
+                "tenant": tenant_id,
+                "queries": jax.device_get({
+                    qn: {"states": jax.tree_util.tree_map(
+                            lambda x: x[slot], self._states[qn]),
+                         "emitted": self._emitted[qn][slot]}
+                    for qn in self._order}),
+                "strings": dump_strings(),
+            }
+            return serialize(payload)
+
+    def restore_tenant(self, tenant_id: str, data: bytes) -> None:
+        """Write a snapshot back into the tenant's slot; every other
+        index of the tenant axis is untouched (bit-identical)."""
+        from ..core.persistence import deserialize, load_strings
+        payload = deserialize(data)
+        if payload.get("template") != self.template.key:
+            raise ValueError(
+                f"snapshot is for template {payload.get('template')!r}, "
+                f"pool '{self.name}' runs {self.template.key!r}")
+        with self._lock:
+            slot = self._slot(tenant_id)
+            load_strings(payload["strings"])
+            for qn in self._order:
+                snap = payload["queries"][qn]
+                self._states[qn] = jax.tree_util.tree_map(
+                    lambda full, s: full.at[slot].set(jnp.asarray(s)),
+                    self._states[qn], snap["states"])
+                self._emitted[qn] = self._emitted[qn].at[slot].set(
+                    jnp.asarray(snap["emitted"]))
+
+    # -- observability ----------------------------------------------------
+
+    def statistics(self) -> dict:
+        return self._collect_observability()[1]
+
+    def _collect_observability(self) -> tuple[dict, dict]:
+        """ONE walk shared by statistics() and the registry collector.
+        Device reads are O(templates), not O(tenants): the stacked
+        emitted counters come back in a single device_get per pool; the
+        per-tenant fan-out below is pure host-side numpy indexing."""
+        with self._lock:
+            host = jax.device_get({"emitted": self._emitted})
+            tenants = dict(self._tenants)
+            pending = dict(self._pending_rows)
+            errors = dict(self._error_counts)
+            pool_stats = {
+                "slots": self.slots, "active": len(tenants),
+                "max_tenants": self.max_tenants,
+                "batch_max": self.batch_max,
+                "rounds": self._rounds, "dispatches": self._dispatches,
+                "grows": self._grows,
+                "state_bytes_per_tenant": self.state_bytes_per_tenant,
+            }
+        p = f"siddhi.{self.name}"
+        flat: dict = {}
+        report: dict = {"pool": pool_stats, "tenants": {}}
+        emitted = host["emitted"]
+        for tid, slot in tenants.items():
+            per_q = {qn: int(emitted[qn][slot]) for qn in self._order}
+            entry = {"slot": slot, "emitted": per_q,
+                     "pending": pending.get(tid, 0),
+                     "errors": errors.get(tid, 0)}
+            report["tenants"][tid] = entry
+            base = f"{p}.tenant.{tid}"
+            flat[f"{base}.emitted"] = sum(per_q.values())
+            for qn, v in per_q.items():
+                flat[f"{base}.query.{qn}.emitted"] = v
+            flat[f"{base}.pending"] = entry["pending"]
+            flat[f"{base}.errors"] = entry["errors"]
+        for k, v in pool_stats.items():
+            flat[f"{p}.pool.{k}"] = v
+        comp = dict(self.proto.compile_service.summary())
+        # ONE compiled program set per template, shared by every tenant
+        # — the multi-tenant acceptance invariant (bench.py `tenants`)
+        comp["program_sets"] = 1
+        report["compile"] = comp
+        for k in ("warmups", "programs", "compile_ms", "cache_hits",
+                  "cache_misses", "program_sets"):
+            flat[f"{p}.pool.compile.{k}"] = comp.get(k, 0)
+        flat[f"{p}.pool.ready"] = int(self.ready)
+        return flat, report

@@ -122,3 +122,21 @@ def test_bench_join_quick_parses_frontier_and_breakdown():
     for row in d["frontier_grid"]:
         assert "error" not in row, row
         assert row["events_per_s"] > 0
+
+
+def test_bench_tenants_quick_parses():
+    """Multi-tenant serving config (ROADMAP item 2): pooled vs separate
+    aggregate events/s with ONE compile-service program set per
+    template. The smoke runs tiny pools; the full run measures
+    N in {64, 256, 1024}."""
+    os.environ.setdefault("SIDDHI_BENCH_TENANTS", "4,8")
+    os.environ.setdefault("SIDDHI_BENCH_TENANTS_SEP", "4")
+    d = _run_config("tenants")
+    assert d["unit"] == "events/s"
+    assert d["value"] > 0
+    assert d["eps_pooled"] > 0 and d["eps_separate"] > 0
+    assert d["speedup"] > 0
+    assert d["compile_ms"] > 0
+    for n, entry in d["tenants"].items():
+        assert entry["program_sets"] == 1, (n, entry)
+        assert entry["eps_pooled"] > 0

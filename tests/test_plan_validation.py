@@ -266,3 +266,100 @@ def test_table_scoped_filters_are_skipped():
         from S[a in T] select a insert into Out;
     """)
     assert codes(validate_app(app)) == []
+
+
+# ---- template-binding (tenant templates, serving/; docs/serving.md) ----
+
+TPL = """
+define stream S (price double, symbol string);
+@info(name='q')
+from S[price > ${lo:double}]
+select price insert into Out;
+"""
+
+
+def test_template_param_outside_template_mode_raises():
+    # a template deployed as a plain app = unbound literal: parse-time
+    # CompileError pointing at the serving front door
+    with pytest.raises(CompileError, match=r"template-binding.*unbound "
+                                           r"placeholder"):
+        parse(TPL)
+
+
+def test_template_param_parses_in_template_mode():
+    app = parse(TPL, template=True)
+    assert validate_app(app, allow_template_params=True) == []
+
+
+def test_untyped_placeholder_in_template_mode_raises():
+    with pytest.raises(CompileError, match="structural placeholder"):
+        parse("define stream S (p double);\n"
+              "from S[p > ${x}] select p insert into Out;",
+              template=True)
+
+
+def test_template_param_in_window_parameter_raises():
+    with pytest.raises(CompileError, match=r"window 'length' parameter "
+                                           r"is structural"):
+        parse("define stream S (p double);\n"
+              "from S#window.length(${n:int}) select p insert into Out;",
+              template=True)
+
+
+def test_template_param_in_aggregating_selector_raises():
+    with pytest.raises(CompileError, match="aggregating"):
+        parse("define stream S (p double);\n"
+              "from S#window.lengthBatch(4) "
+              "select sum(p) + ${base:double} as t insert into Out;",
+              template=True)
+
+
+def test_template_param_in_join_on_raises():
+    with pytest.raises(CompileError, match="join ON"):
+        parse("define stream A (x long); define stream B (y long);\n"
+              "from A#window.length(2) join B#window.length(2) "
+              "on A.x == B.y and A.x > ${lo:long} "
+              "select A.x insert into Out;", template=True)
+
+
+def test_template_param_conflicting_types_raise():
+    with pytest.raises(CompileError, match="conflicting types"):
+        parse("define stream S (p double, q double);\n"
+              "from S[p > ${x:double} and q > ${x:int}] "
+              "select p insert into Out;", template=True)
+
+
+def test_template_param_type_contradiction_caught_by_typecheck():
+    # `${t:string}` compared against a DOUBLE column: the PR 3
+    # comparability tables reject it at parse time
+    with pytest.raises(CompileError, match="string-numeric-compare"):
+        parse("define stream S (p double);\n"
+              "from S[p > ${t:string}] select p insert into Out;",
+              template=True)
+
+
+def test_check_template_bindings_unknown_unbound_and_type():
+    from siddhi_tpu.analysis.plan_rules import check_template_bindings
+    app = parse(TPL, template=True)
+    with pytest.raises(CompileError, match="unbound placeholder"):
+        check_template_bindings(app, {})
+    with pytest.raises(CompileError, match="unknown placeholder"):
+        check_template_bindings(app, {"lo": 1.0, "zz": 2})
+    with pytest.raises(CompileError, match="does not coerce"):
+        check_template_bindings(app, {"lo": "cheap"})
+    with pytest.raises(CompileError, match="does not coerce"):
+        # DOUBLE literal cannot narrow into an int param
+        check_template_bindings(
+            parse(TPL.replace("${lo:double}", "${lo:int}"),
+                  template=True), {"lo": 1.5})
+    # int widens into double under the promotion lattice
+    out = check_template_bindings(app, {"lo": 3})
+    assert out["lo"][0] == 3
+
+
+def test_unknown_placeholder_type_is_a_parse_error():
+    with pytest.raises(Exception, match="unknown template placeholder "
+                                        "type"):
+        parse("define stream S (p double);\n"
+              "from S[p > ${x:decimal}] select p insert into Out;",
+              template=True)

@@ -1,0 +1,629 @@
+"""Multi-tenant serving subsystem tests (siddhi_tpu/serving/,
+docs/serving.md): template hashing and binding, vmapped TenantPool
+correctness vs separate runtimes, tenant isolation (error-store
+partitions, per-tenant snapshot/restore, stats namespacing), admission
+control, fair batching, zero-recompile churn (counting-jit guard), and
+the service front door (deploy/429/ingest/undeploy, readiness in deploy
+responses, undeploy cancelling a background warmup).
+"""
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.service import SiddhiService
+from siddhi_tpu.ops.expr import CompileError
+from siddhi_tpu.serving import (AdmissionError, Template,
+                                TemplateRegistry, TenantPool)
+
+TPL = """
+define stream In (v double, k long);
+@info(name='q')
+from In[v > ${lo:double} and v < ${hi:double}]
+select v, k
+insert into Out;
+"""
+
+WINDOW_TPL = """
+define stream In (v double, k long);
+@info(name='q')
+from In[v > ${lo:double}]#window.lengthBatch(4)
+select v, k
+insert into Out;
+"""
+
+CHAIN_TPL = """
+define stream In (v double, k long);
+@info(name='q1')
+from In[v > ${lo:double}]
+select v * ${scale:double} as s, k
+insert into Mid;
+@info(name='q2')
+from Mid[s < 100.0]
+select s, k
+insert into Out;
+"""
+
+
+def _chunk(n=8, seed=3):
+    rng = np.random.default_rng(seed)
+    ts = 1_000_000 + np.arange(n, dtype=np.int64)
+    v = rng.uniform(0, 10, n)
+    k = np.arange(n, dtype=np.int64)
+    return ts, [v, k]
+
+
+def _mk_pool(text=TPL, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_tenants", 8)
+    kw.setdefault("batch_max", 16)
+    return TenantPool(Template(text), manager=SiddhiManager(), **kw)
+
+
+def _collect(pool, tid):
+    got = []
+    pool.add_callback(tid, got.extend)
+    return got
+
+
+# ---- Template ----------------------------------------------------------
+
+
+def test_template_hash_key_normalizes_whitespace():
+    a = Template(TPL)
+    b = Template("\n  " + TPL.replace("\n", "\n   ") + "  \n")
+    assert a.key == b.key
+
+
+def test_template_placeholder_split():
+    t = Template("""
+        define stream S (p double);
+        from S[p > ${lo:double}]#window.length(${n})
+        select p insert into ${out};
+    """)
+    assert set(t.value_params) == {"lo"}
+    assert t.structural == {"n", "out"}
+
+
+def test_template_conflicting_placeholder_kinds_raise():
+    with pytest.raises(CompileError, match="typed and untyped"):
+        Template("define stream S (p double);\n"
+                 "from S[p > ${x:double} and p < ${x}] "
+                 "select p insert into Out;")
+    with pytest.raises(CompileError, match="conflicting types"):
+        Template("define stream S (p double);\n"
+                 "from S[p > ${x:double} and p < ${x:int}] "
+                 "select p insert into Out;")
+
+
+def test_structural_bindings():
+    t = Template("""
+        define stream S (p double);
+        from S[p > ${lo:double}]#window.length(${n})
+        select p insert into Out;
+    """)
+    text = t.app_text(shared={"n": 5})
+    assert "#window.length(5)" in text
+    assert "${lo:double}" in text           # tenant param left for parse
+    with pytest.raises(CompileError, match="unbound structural"):
+        t.app_text()
+    with pytest.raises(CompileError, match="no structural placeholder"):
+        t.app_text(shared={"n": 5, "bogus": 1})
+
+
+def test_instantiate_static_bakes_literals():
+    t = Template(TPL)
+    text = t.instantiate_static({"lo": 1.0, "hi": 3.5},
+                                app_name="static_app")
+    assert "${" not in text
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(text)   # parses as a plain app
+    assert rt.name == "static_app"
+    with pytest.raises(CompileError, match="unbound placeholder"):
+        t.instantiate_static({"lo": 1.0})
+    with pytest.raises(CompileError, match="unknown placeholder"):
+        t.instantiate_static({"lo": 1.0, "hi": 2.0, "x": 3})
+
+
+def test_registry_dedups_by_content_and_shares_pools():
+    reg = TemplateRegistry()
+    t1 = reg.register(TPL)
+    t2 = reg.register("  " + TPL)
+    assert t1 is t2
+    p1 = reg.pool(TPL, warm=False, slots=2, max_tenants=4)
+    p2 = reg.pool("\n" + TPL, warm=False)
+    assert p1 is p2
+    reg.shutdown()
+
+
+# ---- TenantPool correctness -------------------------------------------
+
+
+def test_pool_matches_separate_runtimes():
+    """The acceptance equivalence: N pooled tenants emit exactly what N
+    separate statically-bound runtimes emit, per tenant."""
+    bindings = {"a": {"lo": 2.0, "hi": 8.0}, "b": {"lo": 5.0, "hi": 9.5}}
+    ts, cols = _chunk(12)
+
+    expected = {}
+    tpl = Template(TPL)
+    for tid, b in bindings.items():
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            tpl.instantiate_static(b, app_name=f"sep_{tid}"))
+        got = []
+        from siddhi_tpu import StreamCallback
+        rt.add_callback("Out", StreamCallback(fn=got.extend))
+        rt.start()
+        rt.get_input_handler("In").send_arrays(ts, cols)
+        rt.shutdown()
+        expected[tid] = [(e.timestamp, e.data) for e in got]
+        assert expected[tid], "baseline produced no rows"
+
+    pool = _mk_pool()
+    got = {}
+    for tid, b in bindings.items():
+        pool.add_tenant(tid, b)
+        got[tid] = _collect(pool, tid)
+    for tid in bindings:
+        pool.send(tid, ts, cols)
+    pool.flush()
+    for tid in bindings:
+        assert [(e.timestamp, e.data) for e in got[tid]] == expected[tid]
+
+
+def test_pool_chained_queries_and_select_params():
+    pool = _mk_pool(CHAIN_TPL)
+    pool.add_tenant("a", {"lo": 2.0, "scale": 10.0})
+    got_a = _collect(pool, "a")
+    ts, cols = _chunk(8)
+    pool.send("a", ts, cols)
+    pool.flush()
+    v = cols[0]
+    keep = v[(v > 2.0) & (v * 10.0 < 100.0)]
+    assert [round(e.data[0], 6) for e in got_a] == \
+        [round(x * 10.0, 6) for x in keep]
+
+
+def test_pool_window_template():
+    pool = _mk_pool(WINDOW_TPL)
+    pool.add_tenant("a", {"lo": 0.0})
+    got = _collect(pool, "a")
+    ts = np.arange(10, dtype=np.int64) + 1
+    v = np.arange(10, dtype=np.float64) + 1.0
+    k = np.arange(10, dtype=np.int64)
+    pool.send("a", ts, [v, k])
+    pool.flush()
+    # lengthBatch(4): two full batches fire, the 2-row tail is pending
+    assert [e.data[0] for e in got] == [1.0, 2.0, 3.0, 4.0,
+                                       5.0, 6.0, 7.0, 8.0]
+
+
+def test_pool_rejects_unpoolable_templates():
+    with pytest.raises(CompileError, match="not poolable"):
+        _mk_pool("""
+            define stream A (x long);
+            define stream B (y long);
+            from A#window.length(2) join B#window.length(2)
+            on A.x == B.y
+            select A.x insert into Out;
+        """)
+    with pytest.raises(CompileError, match="not poolable"):
+        _mk_pool("""
+            define stream A (x long);
+            define table T (x long);
+            from A select x insert into T;
+        """)
+    # a param in a join ON is caught even earlier, by the plan rule
+    with pytest.raises(CompileError, match="template-binding"):
+        _mk_pool("""
+            define stream A (x long);
+            define stream B (y long);
+            from A#window.length(2) join B#window.length(2)
+            on A.x == B.y and A.x > ${lo:long}
+            select A.x insert into Out;
+        """)
+
+
+def test_pool_binding_validation_routes_through_plan_rule():
+    pool = _mk_pool()
+    with pytest.raises(CompileError, match="unbound placeholder"):
+        pool.add_tenant("a", {"lo": 1.0})
+    with pytest.raises(CompileError, match="unknown placeholder"):
+        pool.add_tenant("a", {"lo": 1.0, "hi": 2.0, "zz": 1})
+    with pytest.raises(CompileError, match="does not coerce"):
+        pool.add_tenant("a", {"lo": "cheap", "hi": 2.0})
+    # int literals coerce upward into double params
+    pool.add_tenant("a", {"lo": 1, "hi": 4})
+
+
+# ---- isolation ---------------------------------------------------------
+
+
+def test_sink_failure_routes_to_own_error_partition():
+    pool = _mk_pool()
+    pool.add_tenant("a", {"lo": 0.0, "hi": 100.0})
+    pool.add_tenant("b", {"lo": 0.0, "hi": 100.0})
+
+    def explode(_events):
+        raise RuntimeError("tenant-a sink down")
+    pool.add_callback("a", explode)
+    got_b = _collect(pool, "b")
+
+    ts, cols = _chunk(6)
+    pool.send("a", ts, cols)
+    pool.send("b", ts, cols)
+    pool.flush()
+
+    store = pool.proto._error_store()
+    a_part = store.peek(pool.tenant_partition("a"))
+    assert len(a_part) == 1 and a_part[0].cause.startswith("RuntimeError")
+    assert len(a_part[0].events) == 6
+    assert store.peek(pool.tenant_partition("b")) == []
+    assert len(got_b) == 6                      # b undisturbed
+    assert pool.statistics()["tenants"]["a"]["errors"] == 6
+    assert pool.statistics()["tenants"]["b"]["errors"] == 0
+
+
+def test_tenant_snapshot_restore_leaves_others_bit_identical():
+    pool = _mk_pool(WINDOW_TPL)
+    pool.add_tenant("a", {"lo": 0.0})
+    pool.add_tenant("b", {"lo": 0.0})
+    ts, cols = _chunk(6)
+    pool.send("a", ts, cols)
+    pool.send("b", ts, cols)
+    pool.flush()
+
+    snap_a = pool.snapshot_tenant("a")
+    slot_b = pool._tenants["b"]
+
+    def slice_b():
+        return jax.device_get(jax.tree_util.tree_map(
+            lambda x: x[slot_b], {qn: pool._states[qn]
+                                  for qn in pool._order}))
+
+    before = slice_b()
+    # advance only tenant a, then roll it back
+    pool.send("a", ts + 100, cols)
+    pool.flush()
+    pool.restore_tenant("a", snap_a)
+    after = slice_b()
+    flat_b, _ = jax.tree_util.tree_flatten(before)
+    flat_a, _ = jax.tree_util.tree_flatten(after)
+    for x, y in zip(flat_b, flat_a):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    # a's restored state equals its snapshot bit-for-bit
+    roundtrip = pool.snapshot_tenant("a")
+    from siddhi_tpu.core.persistence import deserialize
+    p1, p2 = deserialize(snap_a), deserialize(roundtrip)
+    f1, _ = jax.tree_util.tree_flatten(p1["queries"])
+    f2, _ = jax.tree_util.tree_flatten(p2["queries"])
+    for x, y in zip(f1, f2):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_restore_rejects_other_template():
+    pool = _mk_pool()
+    other = _mk_pool(WINDOW_TPL)
+    pool.add_tenant("a", {"lo": 0.0, "hi": 9.0})
+    other.add_tenant("a", {"lo": 0.0})
+    snap = other.snapshot_tenant("a")
+    with pytest.raises(ValueError, match="template"):
+        pool.restore_tenant("a", snap)
+
+
+# ---- churn / growth / admission ---------------------------------------
+
+
+def test_tenant_churn_zero_recompiles(monkeypatch):
+    """Tenant add/remove at steady state is pure slot assignment: zero
+    new traces through any jit (the counting-jit guard the fusion and
+    ordering suites use)."""
+    import functools
+    real_jit = jax.jit
+    traces = [0]
+
+    def counting_jit(f, *a, **kw):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            traces[0] += 1
+            return f(*args, **kwargs)
+        return real_jit(wrapped, *a, **kw)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+
+    pool = _mk_pool(slots=4, max_tenants=4)
+    pool.add_tenant("a", {"lo": 1.0, "hi": 9.0})
+    pool.add_tenant("b", {"lo": 2.0, "hi": 8.0})
+    ts, cols = _chunk(8)
+    pool.send("a", ts, cols)
+    pool.flush()
+    warm = traces[0]
+    assert warm > 0
+    # steady-state churn: removes, adds, and traffic on a warm cap
+    for i in range(3):
+        pool.remove_tenant("b")
+        pool.add_tenant("b", {"lo": float(i), "hi": 9.0})
+        pool.add_tenant(f"c{i}", {"lo": 0.5, "hi": 9.5})
+        pool.remove_tenant(f"c{i}")
+        pool.send("a", ts, cols)
+        pool.send("b", ts, cols)
+        pool.flush()
+    assert traces[0] == warm, "tenant churn must not retrace"
+
+
+def test_pool_grows_by_doubling():
+    pool = _mk_pool(slots=1, max_tenants=8)
+    assert pool.slots == 1
+    pool.add_tenant("a", {"lo": 0.0, "hi": 9.0})
+    pool.add_tenant("b", {"lo": 0.0, "hi": 9.0})     # 1 -> 2
+    pool.add_tenant("c", {"lo": 0.0, "hi": 9.0})     # 2 -> 4
+    assert pool.slots == 4 and pool._grows == 2
+    got = _collect(pool, "c")
+    ts, cols = _chunk(5)
+    pool.send("c", ts, cols)
+    pool.flush()
+    assert len(got) == int(np.sum((cols[0] > 0.0) & (cols[0] < 9.0)))
+
+
+def test_admission_slots_exhausted_and_state_quota():
+    pool = _mk_pool(slots=2, max_tenants=2)
+    pool.add_tenant("a", {"lo": 0.0, "hi": 1.0})
+    pool.add_tenant("b", {"lo": 0.0, "hi": 1.0})
+    with pytest.raises(AdmissionError, match="slots exhausted"):
+        pool.add_tenant("c", {"lo": 0.0, "hi": 1.0})
+    ok, reason = pool.admit()
+    assert not ok and "slots exhausted" in reason
+
+    q = _mk_pool(state_quota_bytes=pool.state_bytes_per_tenant + 1)
+    q.add_tenant("a", {"lo": 0.0, "hi": 1.0})
+    with pytest.raises(AdmissionError, match="state quota"):
+        q.add_tenant("b", {"lo": 0.0, "hi": 1.0})
+
+
+def test_cap_annotation_dials():
+    pool = _mk_pool("@app:cap(tenants='3')\n" + TPL, max_tenants=None)
+    assert pool.max_tenants == 3
+
+
+def test_ingest_backpressure():
+    pool = _mk_pool(pending_cap=8)
+    pool.add_tenant("a", {"lo": 0.0, "hi": 1.0})
+    ts, cols = _chunk(8)
+    pool.send("a", ts, cols)
+    with pytest.raises(AdmissionError, match="backlog full"):
+        pool.send("a", ts, cols)
+    pool.flush()
+    pool.send("a", ts, cols)     # drained: accepted again
+
+
+# ---- fair batching -----------------------------------------------------
+
+
+def test_fair_round_robin_hot_tenant_cannot_starve():
+    pool = _mk_pool(batch_max=16)
+    pool.add_tenant("hot", {"lo": -1.0, "hi": 99.0})
+    pool.add_tenant("cold", {"lo": -1.0, "hi": 99.0})
+    got_cold = _collect(pool, "cold")
+    n_hot = 16 * 6
+    ts = np.arange(n_hot, dtype=np.int64) + 1
+    v = np.full(n_hot, 5.0)
+    k = np.arange(n_hot, dtype=np.int64)
+    pool.send("hot", ts, [v, k])
+    ts_c, cols_c = _chunk(4)
+    pool.send("cold", ts_c, cols_c)
+    # ONE round: the hot tenant gets exactly batch_max rows, the cold
+    # tenant's whole chunk rides the same dispatch
+    pool.pump()
+    assert len(got_cold) == 4
+    st = pool.statistics()["tenants"]
+    assert st["hot"]["pending"] == n_hot - 16
+    assert st["hot"]["emitted"]["q"] == 16
+    rounds = 1
+    while pool.pump():
+        rounds += 1
+    assert st["hot"]["pending"] / 16 <= rounds <= n_hot / 16 + 1
+
+
+# ---- observability -----------------------------------------------------
+
+
+def test_statistics_namespaced_per_tenant_one_program_set():
+    pool = _mk_pool(slots=8, max_tenants=8)
+    pool.warmup()
+    for i in range(6):
+        pool.add_tenant(f"t{i}", {"lo": float(i), "hi": 50.0})
+    ts, cols = _chunk(8)
+    for i in range(6):
+        pool.send(f"t{i}", ts, cols)
+    pool.flush()
+    stats = pool.statistics()
+    # ONE compile-service program set serves every tenant
+    assert stats["compile"]["program_sets"] == 1
+    assert stats["compile"]["warmups"] == 1
+    assert stats["compile"]["programs"] >= 1
+    assert len(stats["tenants"]) == 6
+    flat = pool.metrics.collect()
+    for i in range(6):
+        base = f"siddhi.{pool.name}.tenant.t{i}"
+        assert f"{base}.emitted" in flat
+        assert f"{base}.query.q.emitted" in flat
+        assert f"{base}.pending" in flat
+    assert flat[f"siddhi.{pool.name}.pool.compile.program_sets"] == 1
+
+
+def test_stats_collection_is_one_device_read_per_pool(monkeypatch):
+    """O(templates), not O(tenants): the registry walk makes exactly ONE
+    device_get no matter how many tenants are deployed."""
+    pool = _mk_pool(slots=8, max_tenants=8)
+    for i in range(8):
+        pool.add_tenant(f"t{i}", {"lo": 0.0, "hi": 9.0})
+    ts, cols = _chunk(4)
+    for i in range(8):
+        pool.send(f"t{i}", ts, cols)
+    pool.flush()
+    calls = [0]
+    real = jax.device_get
+
+    def counting(x):
+        calls[0] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", counting)
+    pool.statistics()
+    assert calls[0] == 1
+
+
+# ---- service front door ------------------------------------------------
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_service_tenant_deploy_ingest_stats_undeploy():
+    svc = SiddhiService()
+    svc.start()
+    try:
+        code, resp = _post(svc.port, "/siddhi/tenant/deploy", {
+            "template": TPL, "tenant": "acme",
+            "bindings": {"lo": 1.0, "hi": 9.0},
+            "pool": {"max_tenants": 2, "slots": 2, "batch_max": 16}})
+        assert code == 200 and resp["tenant"] == "acme"
+        assert "ready" in resp and resp["slot"] == 0
+        pool_name = resp["app"]
+
+        # bad bindings -> 400 naming the rule (slot still free, so this
+        # is the binding check, not admission)
+        code, r4 = _post(svc.port, "/siddhi/tenant/deploy", {
+            "template": TPL, "tenant": "x2",
+            "bindings": {"lo": "cheap", "hi": 9.0}})
+        assert code == 400 and "template-binding" in r4["error"]
+
+        # same template text -> same pool, next slot
+        code, r2 = _post(svc.port, "/siddhi/tenant/deploy", {
+            "template": "  " + TPL, "tenant": "globex",
+            "bindings": {"lo": 2.0, "hi": 8.0}})
+        assert code == 200 and r2["app"] == pool_name
+
+        # admission control: slots exhausted -> 429 with the reason
+        code, r3 = _post(svc.port, "/siddhi/tenant/deploy", {
+            "template": TPL, "tenant": "hooli",
+            "bindings": {"lo": 3.0, "hi": 7.0}})
+        assert code == 429 and "slots exhausted" in r3["reason"]
+
+        code, r5 = _post(svc.port,
+                         f"/siddhi/tenant/ingest/{pool_name}/acme",
+                         {"ts": [1, 2, 3],
+                          "rows": [[0.5, 1], [2.5, 2], [9.5, 3]]})
+        assert code == 200 and r5["accepted"] == 3
+        import time
+        deadline = time.monotonic() + 10
+        emitted = -1
+        while time.monotonic() < deadline:
+            code, st = _get(svc.port,
+                            f"/siddhi/tenant/stats/{pool_name}/acme")
+            emitted = st.get("emitted", {}).get("q", -1)
+            if emitted == 1:      # only 2.5 passes (1.0, 9.0)
+                break
+            time.sleep(0.05)
+        assert emitted == 1
+
+        # /metrics carries the per-tenant namespace
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{svc.port}/metrics") as r:
+            text = r.read().decode()
+        assert "tenant_acme" in text and "tenant_globex" in text
+
+        code, _ = _get(svc.port,
+                       f"/siddhi/tenant/undeploy/{pool_name}/globex")
+        assert code == 200
+        code, st = _get(svc.port, f"/siddhi/tenant/stats/{pool_name}")
+        assert set(st["tenants"]) == {"acme"}
+        code, arts = _get(svc.port, "/siddhi/artifacts")
+        assert pool_name in arts["pools"]
+    finally:
+        svc.stop()
+
+
+def test_service_deploy_response_reports_readiness():
+    svc = SiddhiService()
+    svc.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/siddhi/artifact/deploy",
+            data=b"define stream S (a int);\n"
+                 b"from S select a insert into Out;")
+        with urllib.request.urlopen(req) as r:
+            resp = json.loads(r.read())
+        assert resp["status"] == "deployed"
+        assert resp["ready"] is True          # no async warm configured
+        code, arts = _get(svc.port, "/siddhi/artifacts")
+        assert arts["ready"] == {resp["app"]: True}
+    finally:
+        svc.stop()
+
+
+def test_undeploy_cancels_background_warmup(monkeypatch):
+    """Undeploying a still-warming app must cancel its AOT compiles and
+    drain the inflight count to zero instead of leaking it behind the
+    daemon thread (satellite fix; core/compile.py cancel/join)."""
+    monkeypatch.setenv("SIDDHI_TPU_WARM_BUCKETS", "1024")
+    svc = SiddhiService()
+    svc.start()
+    try:
+        name = svc.deploy("""
+            define stream S (a int, b double);
+            from S[a > 0]#window.lengthBatch(8)
+            select a, sum(b) as sb group by a
+            insert into Out;
+        """)
+        rt = svc.manager.get_siddhi_app_runtime(name)
+        assert svc.undeploy(name)
+        cs = rt.compile_service
+        assert cs._inflight == 0, "undeploy leaked the inflight count"
+        assert cs.ready
+        assert not cs._threads, "warm thread still tracked after join"
+    finally:
+        svc.stop()
+
+
+def test_metrics_dump_tenant_filter_unit():
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "metrics_dump", os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "tools",
+            "metrics_dump.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    text = ("# TYPE siddhi_pool_x_tenant_a_emitted gauge\n"
+            "siddhi_pool_x_tenant_a_emitted 3 1\n"
+            "siddhi_pool_x_tenant_b_emitted 5 1\n"
+            "siddhi_pool_x_pool_slots 4 1\n")
+    out = mod.filter_tenant(text, "a")
+    assert "tenant_a_emitted 3" in out
+    assert "tenant_b" not in out and "pool_slots" not in out

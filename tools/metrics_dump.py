@@ -34,6 +34,26 @@ define stream S (v int);
 from S[v > 0] select v insert into Out;
 """
 
+# --tenant demo: a parameterized template deployed through the tenant
+# front door (docs/serving.md) so the scrape carries
+# siddhi.<pool>.tenant.<id>.* gauges to filter on
+DEMO_TEMPLATE = """
+define stream S (v int);
+@info(name = 'q')
+from S[v > ${lo:int}] select v insert into Out;
+"""
+
+
+def filter_tenant(text: str, tenant: str) -> str:
+    """Keep only the scrape lines (plus comments' following samples)
+    belonging to one tenant's ``siddhi.<pool>.tenant.<id>.*`` namespace
+    — per-tenant isolation applies to observability reads too."""
+    from siddhi_tpu.obs.metrics import prom_name
+    marker = prom_name(f"tenant.{tenant}.")
+    return "".join(
+        ln + "\n" for ln in text.splitlines()
+        if marker in ln)
+
 
 def _synthetic_traffic(rt, n: int) -> bool:
     """Push n ramp events into the app's first stream when its schema is
@@ -90,27 +110,57 @@ def main(argv=None) -> int:
                     "warmup)")
     ap.add_argument("--ready-timeout", type=float, default=120.0,
                     help="--wait-ready deadline in seconds")
+    ap.add_argument("--tenant", metavar="ID",
+                    help="deploy the app as a tenant template through "
+                    "the multi-tenant front door and print only this "
+                    "tenant's siddhi.<pool>.tenant.<ID>.* samples")
     args = ap.parse_args(argv)
 
     from siddhi_tpu.core.service import SiddhiService
-    ql = DEMO_APP if args.app is None else open(args.app).read()
     svc = SiddhiService()
     svc.start()
     try:
-        name = svc.deploy(ql)
-        if args.wait_ready and not _wait_ready(svc.port,
-                                               args.ready_timeout):
+        if args.tenant is not None:
+            ql = DEMO_TEMPLATE if args.app is None \
+                else open(args.app).read()
+            bindings = {"lo": 0} if args.app is None else {}
+            resp = svc.tenant_deploy({"template": ql,
+                                      "tenant": args.tenant,
+                                      "bindings": bindings})
+            pool = svc._pool(resp["app"])
+            if args.events > 0:
+                import numpy as np
+                schema = pool.proto.junctions[pool.ingest_stream].schema
+                n = args.events
+                ts = 1_000_000 + np.arange(n, dtype=np.int64)
+                from siddhi_tpu.core.types import np_dtype
+                cols = [(np.arange(n) % 97 + 1).astype(np_dtype(t))
+                        for t in schema.types]
+                pool.send(args.tenant, ts, cols)
+                pool.flush()
+        else:
+            ql = DEMO_APP if args.app is None else open(args.app).read()
+            name = svc.deploy(ql)
+            if args.wait_ready and not _wait_ready(svc.port,
+                                                   args.ready_timeout):
+                sys.stderr.write("metrics_dump: /ready never returned "
+                                 f"200 within {args.ready_timeout}s\n")
+                return 1
+            rt = svc._deployed[name]
+            if args.events > 0:
+                _synthetic_traffic(rt, args.events)
+        if args.wait_ready and args.tenant is not None and \
+                not _wait_ready(svc.port, args.ready_timeout):
             sys.stderr.write("metrics_dump: /ready never returned 200 "
                              f"within {args.ready_timeout}s\n")
             return 1
-        rt = svc._deployed[name]
-        if args.events > 0:
-            _synthetic_traffic(rt, args.events)
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{svc.port}/metrics") as r:
             text = r.read().decode()
     finally:
         svc.stop()
+    if args.tenant is not None:
+        text = filter_tenant(text, args.tenant)
     sys.stdout.write(text)
     return 0 if "siddhi_" in text else 1
 
