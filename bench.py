@@ -499,12 +499,67 @@ def _run_tenant_separate(n_tenants: int, rows: int):
     }
 
 
+def _run_tenant_slo(n_tenants: int, rows: int, batch_max: int,
+                    skew: int = 8):
+    """Skewed-traffic SLO arm (docs/observability.md "SLO engine"): one
+    HOT tenant sends ``skew``x the traffic of every cold tenant while
+    the pool tracks a p99 ingest->emit objective at stride 1. Reports
+    measured p50/p99 vs the configured bound, attainment, the burn-rate
+    state, and the hot-vs-cold p99 split — fairness must keep the cold
+    tenants' latency bounded while the hot tenant's backlog spans more
+    rounds."""
+    from siddhi_tpu.serving import TemplateRegistry
+    objective_p99_ms = float(
+        _env("SIDDHI_BENCH_SLO_P99_MS", "250") or 250)
+    reg = TemplateRegistry(SiddhiManager())
+    pool = reg.pool(TENANT_TEMPLATE, warm=False, slots=n_tenants,
+                    max_tenants=n_tenants, batch_max=batch_max,
+                    slo={"p99_ms": objective_p99_ms, "target": 0.99,
+                         "every": 1})
+    pool.warmup([batch_max])
+    for i in range(n_tenants):
+        pool.add_tenant(f"t{i}", _tenant_bindings(i))
+    ts, cols = _tenant_data(rows)
+    hot_ts, hot_cols = _tenant_data(rows * skew, seed=13)
+    for _ in range(3):
+        pool.send("t0", hot_ts, hot_cols)
+        for i in range(1, n_tenants):
+            pool.send(f"t{i}", ts, cols)
+        pool.flush()
+    rep = pool.slo_report()
+    scopes = rep["scopes"]
+    total = scopes.get("total", {})
+    hot = scopes.get("tenant=t0", {})
+    cold = [e.get("p99_ms")
+            for k, e in scopes.items()
+            if k.startswith("tenant=") and "," not in k
+            and k != "tenant=t0" and e.get("p99_ms") is not None]
+    pool.shutdown()
+    return {
+        "objective_p99_ms": objective_p99_ms,
+        "tenants": n_tenants,
+        "skew": skew,
+        "p50_ms": total.get("p50_ms"),
+        "p99_ms": total.get("p99_ms"),
+        "attainment": total.get("attainment"),
+        "state": rep.get("state"),
+        "hot_p99_ms": hot.get("p99_ms"),
+        "cold_p99_ms_max": max(cold) if cold else None,
+        "samples": total.get("count", 0),
+        "saturation": {k: rep.get("saturation", {}).get(k)
+                       for k in ("pending_rows", "queue_age_ms_max",
+                                 "drain_lag_ms")},
+    }
+
+
 def bench_tenants():
     """Multi-tenant serving acceptance (ROADMAP item 2): N tenants of
     ONE filter+window template as a vmapped TenantPool vs N separate
     runtimes. Reports eps_pooled/eps_separate/speedup per N and the
     pool's one-program-set compile story; the headline value is the
-    pooled aggregate events/s at the largest N."""
+    pooled aggregate events/s at the largest N. The ``slo`` block is
+    the skewed-traffic SLO arm: p50/p99 attainment vs the configured
+    objective with one hot tenant (docs/observability.md)."""
     n_list = [int(x) for x in
               _env("SIDDHI_BENCH_TENANTS", "64,256,1024").split(",")
               if x.strip()]
@@ -530,6 +585,7 @@ def bench_tenants():
             "program_sets": pooled["program_sets"],
             "rounds": pooled["rounds"],
         }
+    slo_arm = _run_tenant_slo(min(n_list), rows, batch_max)
     n_max = max(n_list)
     head = per_n[n_max]
     return {
@@ -543,6 +599,7 @@ def bench_tenants():
         "compile_ms": head["compile_ms"],
         "separate": sep,
         "tenants": {str(n): per_n[n] for n in n_list},
+        "slo": slo_arm,
     }
 
 

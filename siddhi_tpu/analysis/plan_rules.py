@@ -167,6 +167,7 @@ class PlanValidator:
     # -- checks --------------------------------------------------------
     def validate(self) -> list[PlanIssue]:
         self.check_app_statistics()
+        self.check_slo()
         self.check_watermarks()
         self.check_template_params()
         for sid, sd in self.app.stream_definitions.items():
@@ -265,6 +266,23 @@ class PlanValidator:
                             f"placeholder '${{{p.name}}}' declared with "
                             f"conflicting types {prev.value} and "
                             f"{p.type.value}")
+
+    def check_slo(self) -> None:
+        """``slo-config``: ``@app:slo(...)`` latency-objective hygiene.
+        Missing bound, unparseable time strings, target outside (0, 1),
+        fast window exceeding the slow window, warn.burn above
+        page.burn and bad strides are definite runtime rejections —
+        fail at parse time with the offending value named (shared
+        parser in obs/slo.py so validation cannot drift from planner
+        behavior — the watermark-config pattern)."""
+        ann = A.find_annotation(self.app.annotations, "slo")
+        if ann is None:
+            return
+        from ..obs.slo import config_from_annotation
+        try:
+            config_from_annotation(ann)
+        except ValueError as e:
+            self.add("slo-config", ERROR, "app", str(e))
 
     def check_watermarks(self) -> None:
         """``@app:watermark`` / per-stream ``@watermark`` annotations:

@@ -813,6 +813,14 @@ class QueryRuntime(Receiver):
         if not out_rows:
             return
         out_rows = self._host_shape_rows(out_rows)
+        # ingest->emit SLO mark (obs/slo.py): host rows for this query's
+        # sinks/callbacks just materialized — the device_get above
+        # already forced the sync, so the sample is honest. Fused
+        # segments land here via the tail member (FusedChain delegates
+        # its terminal delivery to tail._dispatch_output).
+        slo = self.app.slo
+        if slo is not None:
+            slo.on_emit(self.name, rows=len(out_rows))
         for h in row_handlers:
             h.handle(timestamp, out_rows)
         self.callback_handler.handle(timestamp, out_rows)
@@ -1678,6 +1686,10 @@ class SiddhiAppRuntime:
         # via cost_start() / SIDDHI_TPU_COST_PROFILE=1 it syncs every
         # SIDDHI_TPU_COST_EVERY'th chunk per step to measure wall ms
         self.cost = CostProfiler(self)
+        # SLO engine (obs/slo.py): None unless @app:slo is configured —
+        # the disabled path costs one attribute check per dispatch site
+        # (the CostProfiler contract); the Planner wires it below
+        self.slo = None
         self.metrics.register_collector(
             lambda: self._collect_observability()[0])
         self._checkpoint_supervisor = None  # wired by CheckpointSupervisor
@@ -2116,9 +2128,50 @@ class SiddhiAppRuntime:
         # rollup rides the statistics() view like 'compile'
         if self.cost.samples:
             report["cost"] = self.cost.report()
+        # SLO view (obs/slo.py): ingest->emit latency scopes, burn-rate
+        # states and saturation signals; labeled p99/burn/state gauge
+        # families land in the registry for /metrics
+        if self.slo is not None:
+            sat = self._slo_saturation()
+            report["slo"] = self.slo.evaluate(saturation=sat)
+            self.slo.publish(self.metrics, f"{p}.slo")
+            for k, v in sat.items():
+                if isinstance(v, (int, float)):
+                    flat[f"{p}.saturation.{k}"] = v
         flat[f"{p}.app.running"] = int(self.running)
         flat[f"{p}.app.ready"] = int(self.ready)
         return flat, report
+
+    def slo_report(self) -> Optional[dict]:
+        """The SLO/burn-rate view on its own (``GET /siddhi/slo``);
+        None when no ``@app:slo`` objective is configured."""
+        if self.slo is None:
+            return None
+        return self.slo.evaluate(saturation=self._slo_saturation())
+
+    def _slo_saturation(self) -> dict:
+        """Host-side pressure signals for the SLO report and flight
+        recorder: timer/scheduler lag, @Async queue depth, watermark
+        lag (event-time apps), error-store backlog. No device reads."""
+        sat: dict = {
+            "scheduler_pending": self.scheduler.pending(),
+            "scheduler_lag_ms": self.scheduler.lag_ms(
+                self.current_time()),
+        }
+        depths = [j._queue.qsize() for j in self.junctions.values()
+                  if j.async_conf is not None and j._queue is not None]
+        if depths:
+            sat["async_depth_max"] = max(depths)
+        if self._reorder:
+            sat["watermark_lag_ms_max"] = max(
+                b.lag_ms for b in self._reorder.values())
+            sat["reorder_depth_total"] = sum(
+                b.depth for b in self._reorder.values())
+        try:
+            sat["errorstore_backlog"] = self._error_store().size(self.name)
+        except Exception:  # noqa: BLE001 — store backends may be remote
+            pass
+        return sat
 
     def debug(self):
         """Attach a step debugger (SiddhiAppRuntimeImpl.debug():657)."""
@@ -2681,6 +2734,22 @@ class Planner:
                 ms = _time_str_ms(interval, "@app:statistics interval") \
                     if interval is not None else DEFAULT_INTERVAL_MS
                 app._stats_reporter_conf = (rname, ms, sa.element("file"))
+        # @app:slo(p99=..., target=..., window=..., fast=..., every=...)
+        # -> ingest->emit latency objective + burn-rate states
+        # (obs/slo.py; validated at parse time by the `slo-config` plan
+        # rule — planner backstop for validate=False / hand-built ASTs)
+        slo_ann = A.find_annotation(ast.annotations, "slo")
+        if slo_ann is not None:
+            from ..obs.slo import (FlightRecorder, SLOEngine,
+                                   config_from_annotation)
+            try:
+                objective = config_from_annotation(slo_ann)
+            except ValueError as e:
+                raise CompileError(str(e))
+            app.slo = SLOEngine(
+                app.name, objective=objective,
+                recorder=FlightRecorder(app.name),
+                context_fn=app._slo_saturation)
         # playback mode (+ optional idle-advance: SiddhiAppParser.java
         # :171-210 wires EventTimeBasedMillisTimestampGenerator so the
         # virtual clock advances by `increment` whenever sources stay

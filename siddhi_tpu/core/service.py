@@ -77,19 +77,40 @@ class SiddhiService:
         # (template, shared-bindings) pair
         from ..serving import TemplateRegistry
         self.templates = TemplateRegistry(self.manager)
+        # deploy-failure flight recorder (obs/slo.py): every failed
+        # deploy dumps a bounded ring of recent deploy/undeploy events
+        # so a broken rollout is diagnosable after the fact
+        from ..obs.slo import FlightRecorder
+        self.flight = FlightRecorder("service")
         service = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
-            def _send(self, code: int, payload: dict):
+            def _send(self, code: int, payload: dict, headers=None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _send_429(self, exc):
+                """Admission rejection: the body carries the
+                machine-readable saturation cause (which resource,
+                pressure signals) and Retry-After hints the backlog
+                drain estimate (docs/serving.md)."""
+                sat = dict(getattr(exc, "saturation", None) or {})
+                headers = {}
+                ra = sat.get("retry_after_ms")
+                if ra:
+                    headers["Retry-After"] = max(1, -(-int(ra) // 1000))
+                return self._send(429, {"error": exc.reason,
+                                        "reason": exc.reason,
+                                        "saturation": sat}, headers)
 
             def _send_text(self, code: int, text: str):
                 body = text.encode()
@@ -125,9 +146,8 @@ class SiddhiService:
                             self._json_body()))
                     except AdmissionError as e:
                         # admission control: slots / state quota
-                        # exhausted -> 429 with the reason spelled out
-                        return self._send(429, {"error": e.reason,
-                                                "reason": e.reason})
+                        # exhausted -> 429 + saturation cause
+                        return self._send_429(e)
                     except Exception as e:  # noqa: BLE001 — to client
                         return self._send(400, {"error": str(e)})
                 if self.path.startswith("/siddhi/tenant/ingest/"):
@@ -138,9 +158,9 @@ class SiddhiService:
                         return self._send(200, service.tenant_ingest(
                             parts[4], parts[5], self._json_body()))
                     except AdmissionError as e:
-                        # per-tenant backlog backpressure -> 429
-                        return self._send(429, {"error": e.reason,
-                                                "reason": e.reason})
+                        # per-tenant backlog backpressure -> 429 with
+                        # the saturation cause + Retry-After estimate
+                        return self._send_429(e)
                     except KeyError as e:
                         return self._send(404, {"error": str(e)})
                     except Exception as e:  # noqa: BLE001 — to client
@@ -177,6 +197,11 @@ class SiddhiService:
                     return self._send(401, {"error": "unauthorized"})
                 if self.path == "/metrics":
                     return self._send_text(200, service.metrics_text())
+                if self.path == "/siddhi/slo":
+                    # the SLO/burn-rate view over every deployed app
+                    # with an objective + every tenant pool
+                    # (docs/observability.md "SLO engine")
+                    return self._send(200, service.slo_report())
                 if self.path.startswith("/siddhi/artifact/undeploy/"):
                     name = self.path.rsplit("/", 1)[-1]
                     if service.undeploy(name):
@@ -252,13 +277,38 @@ class SiddhiService:
 
     def metrics_text(self) -> str:
         """One Prometheus scrape over every deployed app's registry plus
-        every tenant pool's (siddhi.<pool>.tenant.<id>.* gauges)."""
+        every tenant pool's (labeled ``tenant=`` sample families)."""
         parts = [rt.metrics.prometheus_text()
                  for rt in list(self._deployed.values())]
         parts += [pool.metrics.prometheus_text()
                   for pool in self.templates.pools.values()]
         text = "".join(p for p in parts if p)
         return text or "# no metrics (no apps deployed)\n"
+
+    def slo_report(self) -> dict:
+        """``GET /siddhi/slo``: per-scope latency/burn-rate states for
+        every deployed app carrying an ``@app:slo`` objective and every
+        tenant pool (pools always track; objectives optional). The
+        worst state rides at the top so a probe can alert on one
+        field."""
+        apps: dict = {}
+        worst = "OK"
+        order = {"OK": 0, "WARN": 1, "PAGE": 2}
+        for name, rt in list(self._deployed.items()):
+            rep = rt.slo_report() if hasattr(rt, "slo_report") else None
+            if rep is not None:
+                apps[name] = rep
+                st = rep.get("state")
+                if st in order and order[st] > order[worst]:
+                    worst = st
+        pools: dict = {}
+        for pool in self.templates.pools.values():
+            rep = pool.slo_report()
+            pools[pool.name] = rep
+            st = rep.get("state")
+            if st in order and order[st] > order[worst]:
+                worst = st
+        return {"state": worst, "apps": apps, "pools": pools}
 
     # -- tenant operations (serving/, docs/serving.md) ---------------------
     def tenant_deploy(self, body: dict) -> dict:
@@ -275,7 +325,8 @@ class SiddhiService:
         pool_conf = dict(body.get("pool") or {})
         pool_kwargs = {k: pool_conf[k] for k in
                        ("slots", "max_tenants", "state_quota_bytes",
-                        "batch_max", "pending_cap") if k in pool_conf}
+                        "batch_max", "pending_cap", "slo")
+                       if k in pool_conf}
         pool = self.templates.pool(template,
                                    shared=body.get("shared"),
                                    **pool_kwargs)
@@ -345,6 +396,28 @@ class SiddhiService:
 
     # -- operations -------------------------------------------------------
     def deploy(self, siddhi_ql: str) -> str:
+        try:
+            return self._deploy(siddhi_ql)
+        except Exception as exc:
+            # deploy failure -> flight-recorder artifact (the ring holds
+            # the recent deploy history; the path lands in the log so a
+            # failed rollout is diagnosable post-mortem)
+            self.flight.record("deploy-failure", error=str(exc),
+                               kind_of_error=type(exc).__name__)
+            try:
+                path = self.flight.dump(
+                    "deploy-failure",
+                    context={"deployed": sorted(self._deployed),
+                             "error": str(exc)})
+                import logging
+                logging.getLogger("siddhi_tpu.service").warning(
+                    "deploy failed (%s); flight-recorder artifact: %s",
+                    exc, path)
+            except Exception:  # noqa: BLE001 — recording must not mask
+                pass           # the real deploy error
+            raise
+
+    def _deploy(self, siddhi_ql: str) -> str:
         # both checks run on the PARSED app before any runtime is built:
         # a textual scan is comment-bypassable, and building a duplicate
         # runtime would clobber the manager registry entry of the live one
@@ -377,6 +450,7 @@ class SiddhiService:
                 rt.warmup_async(buckets=warm)
             finally:
                 rt.compile_service._end()
+        self.flight.record("deploy", app=rt.name)
         return rt.name
 
     def undeploy(self, name: str) -> bool:

@@ -143,11 +143,19 @@ class StreamJunction:
                 batch.extend(nxt)
                 n_items += 1
             _IN_DISPATCH.active = True
+            # @Async streams open the sampled SLO span at DISPATCH time
+            # (queue wait is a saturation signal — async.depth — not
+            # part of the ingest->emit latency; docs/observability.md)
+            slo = getattr(self._app, "slo", None)
+            tok = slo.ingest_begin(self.stream_id) if slo is not None \
+                else None
             try:
                 with self._app.barrier:
                     self._app.on_ingest(self.stream_id, batch)
                     self._publish_sync(batch)
             finally:
+                if tok is not None:
+                    slo.ingest_end(tok)
                 _IN_DISPATCH.active = False
             with self._drained:
                 self._pending -= n_items
@@ -377,14 +385,23 @@ class InputHandler:
         if self.junction._queue is not None:
             self.junction.publish(events)
             return
-        self.app.on_ingest(self.stream_id, events)
-        self.junction.publish(events)
-        # timers armed DURING processing (e.g. hop boundaries the
-        # chunk's own event-time jump crossed) fire now, not at the
-        # next external tick
-        if self.app._playback and \
-                self.app._playback_time is not None:
-            self.app.scheduler.advance_to(self.app._playback_time)
+        # sampled ingest->emit span (obs/slo.py): queries that decode
+        # host rows during this dispatch attribute against its start
+        slo = self.app.slo
+        tok = slo.ingest_begin(self.stream_id) if slo is not None \
+            else None
+        try:
+            self.app.on_ingest(self.stream_id, events)
+            self.junction.publish(events)
+            # timers armed DURING processing (e.g. hop boundaries the
+            # chunk's own event-time jump crossed) fire now, not at the
+            # next external tick
+            if self.app._playback and \
+                    self.app._playback_time is not None:
+                self.app.scheduler.advance_to(self.app._playback_time)
+        finally:
+            if tok is not None:
+                slo.ingest_end(tok)
 
     def send_arrays(self, ts, cols) -> None:
         """Columnar ingest: numpy timestamp + data column arrays
@@ -446,38 +463,48 @@ class InputHandler:
             # latency, big = throughput); no thread hop is added since
             # packed dispatch already pipelines device-side
             max_cap = min(max_cap, self.junction.async_conf[1])
+        slo = self.app.slo
         for start in range(0, n, max_cap):
             t = ts[start:start + max_cap]
             c = [col[start:start + max_cap] for col in cols]
             last_ts = int(t[-1])
             if mark:
                 self.junction.mark_ingest(len(t))
-            with maybe_span(self.app, "ingest", self.stream_id,
-                            rows=len(t)), self.app.barrier:
-                # columnar fast path: fire only dues STRICTLY BEFORE
-                # the chunk's span now — in-span window expiry happens
-                # inside the chunk's own step at exact per-row points, so
-                # firing intermediate timers first only adds dispatches
-                # (the post-publish advance_to below catches up the rest)
-                self.app.on_ingest_span(int(t[0]), last_ts)
-                if packed_ok:
-                    if self._encoder is None:
-                        self._encoder = PackedEncoder(self.junction.schema)
-                    chunk = PackedChunk.build(
-                        self._encoder, t, c, bucket_capacity(len(t)),
-                        now=self.app.current_time())
-                    for r in list(self.junction.receivers):
-                        r.process_packed(chunk)
-                else:
-                    batch = batch_from_columns(
-                        self.junction.schema, t, c,
-                        capacity=bucket_capacity(len(t)))
-                    self.junction.publish_batch(batch, last_ts)
-                if self.app._playback:
-                    # catch up timers the chunk's own steps did not
-                    # subsume (multi-boundary batch flushes, absent
-                    # deadlines past the span)
-                    self.app.scheduler.advance_to(last_ts)
+            # sampled ingest->emit span per device chunk (obs/slo.py)
+            tok = slo.ingest_begin(self.stream_id) if slo is not None \
+                else None
+            try:
+                with maybe_span(self.app, "ingest", self.stream_id,
+                                rows=len(t)), self.app.barrier:
+                    # columnar fast path: fire only dues STRICTLY BEFORE
+                    # the chunk's span now — in-span window expiry happens
+                    # inside the chunk's own step at exact per-row points,
+                    # so firing intermediate timers first only adds
+                    # dispatches (the post-publish advance_to below
+                    # catches up the rest)
+                    self.app.on_ingest_span(int(t[0]), last_ts)
+                    if packed_ok:
+                        if self._encoder is None:
+                            self._encoder = PackedEncoder(
+                                self.junction.schema)
+                        chunk = PackedChunk.build(
+                            self._encoder, t, c, bucket_capacity(len(t)),
+                            now=self.app.current_time())
+                        for r in list(self.junction.receivers):
+                            r.process_packed(chunk)
+                    else:
+                        batch = batch_from_columns(
+                            self.junction.schema, t, c,
+                            capacity=bucket_capacity(len(t)))
+                        self.junction.publish_batch(batch, last_ts)
+                    if self.app._playback:
+                        # catch up timers the chunk's own steps did not
+                        # subsume (multi-boundary batch flushes, absent
+                        # deadlines past the span)
+                        self.app.scheduler.advance_to(last_ts)
+            finally:
+                if tok is not None:
+                    slo.ingest_end(tok)
 
 
 class StreamCallback(Receiver):

@@ -33,15 +33,30 @@ def prom_name(dotted: str) -> str:
     return name
 
 
+def _label_str(labels) -> str:
+    """``(("tenant","t1"),)`` -> '{tenant="t1"}' with value escaping
+    per the exposition format (backslash, quote, newline)."""
+    if not labels:
+        return ""
+    parts = []
+    for k, v in labels:
+        v = str(v).replace("\\", r"\\").replace('"', r"\"") \
+            .replace("\n", r"\n")
+        parts.append(f'{prom_name(str(k))}="{v}"')
+    return "{" + ",".join(parts) + "}"
+
+
 class Counter:
     """Monotonic counter (Dropwizard Counter / Meter count)."""
 
-    __slots__ = ("name", "_value", "_lock")
+    __slots__ = ("name", "_value", "_lock", "family", "labels")
 
     def __init__(self, name: str):
         self.name = name
         self._value = 0
         self._lock = threading.Lock()
+        self.family = None
+        self.labels = ()
 
     def inc(self, n: int = 1) -> None:
         with self._lock:
@@ -55,14 +70,19 @@ class Counter:
 class Gauge:
     """Point-in-time value; either set directly or backed by a callable
     evaluated at collection time (so the instrumented path pays
-    nothing)."""
+    nothing). A gauge created through ``labeled_gauge`` additionally
+    carries its metric ``family`` and ``labels`` so the Prometheus
+    exposition emits ONE family with label-based samples instead of a
+    dotted name per label combination (docs/observability.md)."""
 
-    __slots__ = ("name", "_value", "_fn")
+    __slots__ = ("name", "_value", "_fn", "family", "labels")
 
     def __init__(self, name: str):
         self.name = name
         self._value: float = math.nan
         self._fn: Optional[Callable[[], float]] = None
+        self.family = None
+        self.labels = ()
 
     def set(self, value) -> None:
         self._value = value
@@ -134,6 +154,7 @@ class MetricsRegistry:
         self._lock = threading.RLock()
         self._metrics: dict[str, object] = {}
         self._collectors: list[Callable[[], dict]] = []
+        self._help: dict[str, str] = {}
 
     # -- instruments -----------------------------------------------------
     def _get(self, name: str, cls):
@@ -156,6 +177,43 @@ class MetricsRegistry:
 
     def histogram(self, name: str) -> Histogram:
         return self._get(name, Histogram)
+
+    def labeled_gauge(self, family: str, labels: dict,
+                      dotted: Optional[str] = None,
+                      help: Optional[str] = None) -> Gauge:
+        """A gauge that is one SAMPLE of a labeled metric family: the
+        exposition emits ``<family>{k="v",...}`` under one ``# TYPE``
+        header, while registry dumps / ``collect()`` keep the readable
+        ``dotted`` name (default: family + label values). This is the
+        cardinality-safe shape for per-tenant metrics — one family with
+        a ``tenant`` label, not a metric name per tenant."""
+        items = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        if dotted is None:
+            dotted = ".".join([family] + [v for _, v in items])
+        g = self.gauge(dotted)
+        g.family = family
+        g.labels = items
+        if help is not None:
+            self.describe(family, help)
+        return g
+
+    def describe(self, name: str, help_text: str) -> None:
+        """Attach ``# HELP`` text to a metric (family) name."""
+        with self._lock:
+            self._help.setdefault(name, help_text)
+
+    def prune_family(self, family: str, keep_dotted) -> int:
+        """Drop labeled samples of ``family`` whose dotted name is not
+        in ``keep_dotted`` (departed tenants/scopes must not linger in
+        scrapes); returns how many were removed."""
+        keep = set(keep_dotted)
+        removed = 0
+        with self._lock:
+            for n, m in list(self._metrics.items()):
+                if getattr(m, "family", None) == family and n not in keep:
+                    del self._metrics[n]
+                    removed += 1
+        return removed
 
     def set(self, name: str, value) -> None:
         self.gauge(name).set(value)
@@ -208,29 +266,42 @@ class MetricsRegistry:
         """Prometheus text exposition (version 0.0.4). Counters and
         gauges one sample each; histograms as summaries (quantile
         samples plus cumulative ``_sum``/``_count`` so scrapers can
-        ``rate()`` them)."""
+        ``rate()`` them). Labeled samples (``labeled_gauge``) group
+        under ONE ``# HELP``/``# TYPE`` header per family — the shape
+        real scrapers ingest as a single series family with a
+        ``tenant=``/``query=`` dimension."""
         ts_ms = int(time.time() * 1000)
         lines: list[str] = []
         # refresh collector-backed gauges first
         self.collect()
         with self._lock:
-            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+            metrics = sorted(
+                self._metrics.values(),
+                key=lambda m: (getattr(m, "family", None) or m.name,
+                               m.name))
+            helps = dict(self._help)
+        last_family = None
         for m in metrics:
-            name = prom_name(m.name)
+            family = getattr(m, "family", None) or m.name
+            fname = prom_name(family)
+            lab = _label_str(getattr(m, "labels", ()))
             if isinstance(m, Counter):
-                lines.append(f"# TYPE {name} counter")
-                lines.append(f"{name} {m.value} {ts_ms}")
+                mtype = "counter"
+                samples = [f"{fname}{lab} {m.value} {ts_ms}"]
             elif isinstance(m, Histogram):
+                mtype = "summary"
                 s = m.summary()
                 if s is None:
                     continue
-                lines.append(f"# TYPE {name} summary")
-                lines.append(f'{name}{{quantile="0.5"}} {s["p50"]}')
-                lines.append(f'{name}{{quantile="0.95"}} {s["p95"]}')
-                lines.append(f'{name}{{quantile="0.99"}} {s["p99"]}')
-                lines.append(f"{name}_sum {s['sum']}")
-                lines.append(f"{name}_count {s['count']}")
+                samples = [
+                    f'{fname}{{quantile="0.5"}} {s["p50"]}',
+                    f'{fname}{{quantile="0.95"}} {s["p95"]}',
+                    f'{fname}{{quantile="0.99"}} {s["p99"]}',
+                    f"{fname}_sum {s['sum']}",
+                    f"{fname}_count {s['count']}",
+                ]
             else:
+                mtype = "gauge"
                 v = m.value
                 if v is None or (isinstance(v, float) and math.isnan(v)):
                     continue
@@ -238,6 +309,12 @@ class MetricsRegistry:
                     v = int(v)
                 if not isinstance(v, (int, float)):
                     continue
-                lines.append(f"# TYPE {name} gauge")
-                lines.append(f"{name} {v} {ts_ms}")
+                samples = [f"{fname}{lab} {v} {ts_ms}"]
+            if family != last_family:
+                help_text = helps.get(family)
+                if help_text is not None:
+                    lines.append(f"# HELP {fname} {help_text}")
+                lines.append(f"# TYPE {fname} {mtype}")
+                last_family = family
+            lines.extend(samples)
         return "\n".join(lines) + ("\n" if lines else "")

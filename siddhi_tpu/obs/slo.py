@@ -1,0 +1,577 @@
+"""SLO engine: per-tenant / per-query ingest-to-emit latency attribution,
+multi-window burn-rate alerting, saturation signals, and a flight
+recorder.
+
+PR 6/7 answered *what flowed* (counters) and *which step eats device
+time* (cost profiler); this module answers the serving question ROADMAP
+item 2 is graded against: **is every tenant meeting its latency
+objective, and if not, why?**
+
+Measurement model (the PR 7 lesson, unchanged): on an async device
+pipeline the only honest ingest->emit number is host wall time around
+work that is *provably finished*, so the engine samples with a stride
+(``SIDDHI_TPU_SLO_EVERY``, default 64; the first span always samples so
+short runs still report) and puts the ``block_until_ready`` /
+host-decode sync on the sampled branch only. Zero jit options change —
+persistent compile-cache keys stay stable (docs/compile_cache.md) — and
+collection-time device reads stay batched: the pool's registry walk
+still makes ONE ``device_get`` per pool with SLO tracking on
+(tests/test_slo.py asserts the count).
+
+Attribution points:
+
+- ``TenantPool.send`` stamps every queued chunk with its host arrival
+  time; on a sampled fair round the pool syncs after each vmapped query
+  step and attributes ``arrival -> query emitted`` per (tenant, query)
+  plus tenant- and pool-level end-to-end spans.
+- ``InputHandler.send/send_arrays`` open a sampled span; each query that
+  decodes host rows for its sinks/callbacks during the dispatch marks
+  ``ingest -> emit`` under its own name (the host decode already forced
+  the device sync, so the number is honest). Fused segments attribute to
+  the tail member — the segment is one XLA program.
+
+Burn-rate semantics (the standard multi-window model): an objective is a
+latency bound (``p99='250 ms'``) plus a target attainment
+(``target='0.99'``). A sample is *bad* when it exceeds the bound; the
+error budget is ``1 - target``. ``burn = bad_fraction / budget`` over
+the FAST (default 5 min) and SLOW (default 1 h) windows;
+``min(burn_fast, burn_slow)`` >= ``warn.burn`` trips WARN, >=
+``page.burn`` trips PAGE. Requiring BOTH windows to burn keeps one
+slow chunk from paging while still paging fast on a real regression.
+
+The **flight recorder** is a bounded ring of recent spans, admission
+rejections and state transitions; entering PAGE (or an explicit caller
+trigger: deploy failure, chaos-scenario failure) dumps the ring plus a
+context snapshot as a JSON artifact under
+``<SIDDHI_TPU_CACHE_DIR>/flightrec/`` so the breach is diagnosable
+after the fact. See docs/observability.md "SLO engine".
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Callable, Optional
+
+EVERY_ENV = "SIDDHI_TPU_SLO_EVERY"
+DEFAULT_EVERY = 64
+FLIGHT_DIR_ENV = "SIDDHI_TPU_FLIGHT_DIR"
+
+FAST_WINDOW_MS = 5 * 60 * 1000       # fast burn window (5 min)
+SLOW_WINDOW_MS = 60 * 60 * 1000      # slow burn window / SLO window (1 h)
+DEFAULT_TARGET = 0.99
+DEFAULT_WARN_BURN = 2.0
+DEFAULT_PAGE_BURN = 14.4             # the classic 30d-budget page rate
+
+# bounded per-scope reservoir (same windowed model as obs/metrics
+# Histogram; scopes are per tenant/query so the cap bounds memory at
+# O(scopes * cap))
+WINDOW_CAP = 4096
+
+OK, WARN, PAGE = "OK", "WARN", "PAGE"
+_STATE_NUM = {OK: 0, WARN: 1, PAGE: 2}
+
+_TIME = re.compile(
+    r"(\d+(?:\.\d+)?)\s*(millisecond|milliseconds|ms|sec|second|seconds|"
+    r"s|min|minute|minutes|hour|hours|h)?")
+_UNIT_MS = {"millisecond": 1, "milliseconds": 1, "ms": 1,
+            "sec": 1000, "second": 1000, "seconds": 1000, "s": 1000,
+            "min": 60_000, "minute": 60_000, "minutes": 60_000,
+            "hour": 3_600_000, "hours": 3_600_000, "h": 3_600_000}
+
+
+def _time_ms(value, role: str) -> float:
+    """'250 ms' / '5 sec' / bare ms number -> milliseconds (ValueError
+    on anything else — the ``slo-config`` plan rule's to surface)."""
+    if isinstance(value, (int, float)) and not isinstance(value, bool):
+        ms = float(value)
+    else:
+        m = _TIME.fullmatch(str(value).strip().strip("'\""))
+        if not m:
+            raise ValueError(
+                f"{role}: cannot parse time '{value}' "
+                "(expected e.g. '250 ms', '5 sec', '1 min')")
+        ms = float(m.group(1)) * _UNIT_MS[m.group(2) or "ms"]
+    if ms <= 0:
+        raise ValueError(f"{role}: must be positive, got {value!r}")
+    return ms
+
+
+def default_flight_dir() -> str:
+    """Artifact directory: SIDDHI_TPU_FLIGHT_DIR, else ``flightrec/``
+    next to the persistent compile cache (costs.json's neighborhood)."""
+    d = os.environ.get(FLIGHT_DIR_ENV)
+    if d:
+        return d
+    cache = os.environ.get("SIDDHI_TPU_CACHE_DIR") or "./.jax_cache"
+    return os.path.join(cache, "flightrec")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOObjective:
+    """One latency objective: bound(s) + target attainment + burn
+    windows. ``p99_ms`` is the burn-rate bound; ``p50_ms`` is an
+    additional reported bound (attainment only, no paging)."""
+
+    p99_ms: Optional[float] = None
+    p50_ms: Optional[float] = None
+    target: float = DEFAULT_TARGET
+    window_ms: float = SLOW_WINDOW_MS     # slow burn / SLO window
+    fast_ms: float = FAST_WINDOW_MS       # fast burn window
+    warn_burn: float = DEFAULT_WARN_BURN
+    page_burn: float = DEFAULT_PAGE_BURN
+    every: Optional[int] = None           # sampling stride override
+
+    @property
+    def bound_ms(self) -> Optional[float]:
+        return self.p99_ms if self.p99_ms is not None else self.p50_ms
+
+    @property
+    def budget(self) -> float:
+        return max(1e-9, 1.0 - self.target)
+
+    def as_dict(self) -> dict:
+        d = {"target": self.target,
+             "window_ms": self.window_ms, "fast_ms": self.fast_ms,
+             "warn_burn": self.warn_burn, "page_burn": self.page_burn}
+        if self.p99_ms is not None:
+            d["p99_ms"] = self.p99_ms
+        if self.p50_ms is not None:
+            d["p50_ms"] = self.p50_ms
+        return d
+
+
+def config_from_annotation(ann) -> SLOObjective:
+    """``@app:slo(p99='250 ms', target='0.99', window='1 hour',
+    fast='5 min', warn.burn='2', page.burn='14.4', every='64')`` ->
+    SLOObjective. Raises ValueError on any bad value — shared by the
+    ``slo-config`` plan rule (parse time) and the planner backstop
+    (validate=False / hand-built ASTs) so validation cannot drift from
+    planner behavior (the watermark-config pattern)."""
+    def num(key, role, lo=None):
+        v = ann.element(key)
+        if v is None:
+            return None
+        try:
+            f = float(str(v).strip().strip("'\""))
+        except ValueError:
+            raise ValueError(f"@app:slo {role}: cannot parse '{v}'")
+        if lo is not None and f <= lo:
+            raise ValueError(f"@app:slo {role}: must be > {lo}, got {v}")
+        return f
+
+    p99 = ann.element("p99")
+    p50 = ann.element("p50")
+    if p99 is None and p50 is None:
+        raise ValueError(
+            "@app:slo needs a latency bound: p99='...' and/or p50='...'")
+    kw: dict = {}
+    if p99 is not None:
+        kw["p99_ms"] = _time_ms(p99, "@app:slo p99")
+    if p50 is not None:
+        kw["p50_ms"] = _time_ms(p50, "@app:slo p50")
+    target = num("target", "target", lo=0.0)
+    if target is not None:
+        if not (0.0 < target < 1.0):
+            raise ValueError(
+                f"@app:slo target: must be in (0, 1), got {target}")
+        kw["target"] = target
+    w = ann.element("window")
+    if w is not None:
+        kw["window_ms"] = _time_ms(w, "@app:slo window")
+    f = ann.element("fast")
+    if f is not None:
+        kw["fast_ms"] = _time_ms(f, "@app:slo fast")
+    if kw.get("fast_ms", FAST_WINDOW_MS) > kw.get("window_ms",
+                                                  SLOW_WINDOW_MS):
+        raise ValueError(
+            "@app:slo fast window must not exceed the slow window")
+    wb = num("warn.burn", "warn.burn", lo=0.0)
+    pb = num("page.burn", "page.burn", lo=0.0)
+    if wb is not None:
+        kw["warn_burn"] = wb
+    if pb is not None:
+        kw["page_burn"] = pb
+    if kw.get("warn_burn", DEFAULT_WARN_BURN) > \
+            kw.get("page_burn", DEFAULT_PAGE_BURN):
+        raise ValueError("@app:slo warn.burn must not exceed page.burn")
+    ev = ann.element("every")
+    if ev is not None:
+        try:
+            n = int(str(ev).strip().strip("'\""))
+        except ValueError:
+            n = 0
+        if n <= 0:
+            raise ValueError(
+                f"@app:slo every: must be a positive integer, got '{ev}'")
+        kw["every"] = n
+    return SLOObjective(**kw)
+
+
+def objective_from_dials(dials: dict) -> SLOObjective:
+    """Pool-level ``slo={...}`` dial -> SLOObjective (constructor-style
+    keys; time-ish values accept '250 ms' strings too)."""
+    kw: dict = {}
+    for key in ("p99_ms", "p50_ms", "window_ms", "fast_ms"):
+        if key in dials and dials[key] is not None:
+            kw[key] = _time_ms(dials[key], f"slo dial {key}")
+    for key in ("target", "warn_burn", "page_burn"):
+        if key in dials and dials[key] is not None:
+            kw[key] = float(dials[key])
+    if "target" in kw and not (0.0 < kw["target"] < 1.0):
+        raise ValueError(
+            f"slo dial target must be in (0, 1), got {kw['target']}")
+    if "every" in dials and dials["every"] is not None:
+        kw["every"] = max(1, int(dials["every"]))
+    if kw.get("p99_ms") is None and kw.get("p50_ms") is None:
+        raise ValueError(
+            "slo dial needs a latency bound: p99_ms and/or p50_ms")
+    return SLOObjective(**kw)
+
+
+class FlightRecorder:
+    """Bounded ring of recent observability events (sampled spans,
+    admission rejections, state transitions) that ``dump()`` serializes
+    — with a caller-supplied context snapshot — into a JSON artifact.
+
+    The ring records host-side dicts only: no device reads, no locks
+    beyond its own. ``dump()`` writes tmp+rename (the filesystem error
+    store's atomicity contract) and returns the artifact path; callers
+    put that path in log lines and assertion messages so a failed run
+    is diagnosable after the process is gone."""
+
+    CAP = 256
+
+    def __init__(self, name: str, cap: int = CAP,
+                 dirpath: Optional[str] = None):
+        self.name = name
+        self.dirpath = dirpath
+        self._ring: deque = deque(maxlen=max(1, int(cap)))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.dumps: list[str] = []
+
+    def record(self, kind: str, **data) -> None:
+        entry = {"t_wall_ms": int(time.time() * 1000), "kind": kind}
+        entry.update(data)
+        with self._lock:
+            self._ring.append(entry)
+
+    def snapshot(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def dump(self, reason: str, context: Optional[dict] = None,
+             path: Optional[str] = None) -> str:
+        """Write the artifact; returns its path. Artifact schema
+        (docs/observability.md): ``{name, reason, dumped_at_ms, spans:
+        [ring entries oldest-first], context: {...}}``."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            spans = list(self._ring)
+        if path is None:
+            d = self.dirpath or default_flight_dir()
+            os.makedirs(d, exist_ok=True)
+            slug = re.sub(r"[^A-Za-z0-9._-]", "_", f"{self.name}.{reason}")
+            path = os.path.join(
+                d, f"{slug}.{int(time.time() * 1000)}.{seq}.json")
+        payload = {"name": self.name, "reason": reason,
+                   "dumped_at_ms": int(time.time() * 1000),
+                   "spans": spans, "context": context or {}}
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True, default=str)
+        os.replace(tmp, path)
+        with self._lock:
+            self.dumps.append(path)
+        return path
+
+
+class _Window:
+    """Bounded (wall_ms, latency_ms) reservoir for one scope."""
+
+    __slots__ = ("samples", "count", "sum")
+
+    def __init__(self):
+        self.samples: deque = deque(maxlen=WINDOW_CAP)
+        self.count = 0      # cumulative, survives reservoir wrap
+        self.sum = 0.0
+
+    def add(self, t_ms: float, lat_ms: float) -> None:
+        self.samples.append((t_ms, lat_ms))
+        self.count += 1
+        self.sum += lat_ms
+
+    def in_window(self, now_ms: float, window_ms: float) -> list:
+        lo = now_ms - window_ms
+        return [v for t, v in self.samples if t >= lo]
+
+
+def _percentiles(vals: list) -> dict:
+    s = sorted(vals)
+    n = len(s)
+    if not n:
+        return {}
+    return {"p50_ms": round(s[n // 2], 3),
+            "p95_ms": round(s[min(n - 1, (n * 95) // 100)], 3),
+            "p99_ms": round(s[min(n - 1, (n * 99) // 100)], 3)}
+
+
+def scope_name(labels: tuple) -> str:
+    """``()`` -> 'total'; ``(("tenant","t1"),("query","q"))`` ->
+    'tenant=t1,query=q' — the statistics()['slo']['scopes'] key."""
+    if not labels:
+        return "total"
+    return ",".join(f"{k}={v}" for k, v in labels)
+
+
+class SLOEngine:
+    """Latency objective tracking for one app runtime or tenant pool.
+
+    Hot-path contract (the obs/ design rule): ``observe()`` appends one
+    tuple to a bounded deque under the engine lock — no device work.
+    The sampled sync that makes a latency honest lives at the CALL
+    sites (pool round drain / host row decode), on the sampled branch
+    only. ``evaluate()`` / ``publish()`` run at collection time.
+
+    Scope keys are label tuples: ``()`` is the app/pool aggregate,
+    ``(("tenant", tid),)``, ``(("query", q),)`` and
+    ``(("tenant", tid), ("query", q))`` the attribution axes — the same
+    labels the Prometheus exposition carries (no dotted-name
+    cardinality explosion; docs/observability.md)."""
+
+    def __init__(self, name: str, objective: Optional[SLOObjective] = None,
+                 every: Optional[int] = None,
+                 recorder: Optional[FlightRecorder] = None,
+                 context_fn: Optional[Callable[[], dict]] = None):
+        self.name = name
+        self.objective = objective
+        if every is None:
+            every = objective.every if objective is not None and \
+                objective.every else None
+        if every is None:
+            every = max(1, int(os.environ.get(EVERY_ENV, "")
+                               or DEFAULT_EVERY))
+        self.every = max(1, int(every))
+        self.recorder = recorder
+        self.context_fn = context_fn
+        # RLock: a collector walk may re-enter via publish() while a
+        # dispatch thread observes (the PR 7 registry race pattern)
+        self._lock = threading.RLock()
+        self._windows: dict[tuple, _Window] = {}
+        self._ticks: dict = {}
+        self._states: dict[tuple, str] = {}
+        self._tls = threading.local()
+        self.breaches = 0          # transitions into PAGE
+
+    # -- stride sampling --------------------------------------------------
+    def tick(self, site) -> bool:
+        """True on the sampled stride for ``site`` (first call always —
+        short runs must still report)."""
+        with self._lock:
+            n = self._ticks.get(site, 0)
+            self._ticks[site] = n + 1
+        return n % self.every == 0
+
+    # -- ingest->emit span (runtime path; see core/stream.py) ------------
+    def ingest_begin(self, stream_id: str):
+        """Open a sampled ingest span on this thread; returns a token
+        (None off-stride). Queries that decode host rows during the
+        dispatch call ``on_emit`` and attribute against this span."""
+        if not self.tick(("ingest", stream_id)):
+            return None
+        self._tls.t0 = time.perf_counter()
+        self._tls.emitted = False
+        return stream_id
+
+    def on_emit(self, query: str, rows: int = 0) -> None:
+        """Ingest->emit mark for one query: host rows for its
+        sinks/callbacks just materialized (the device_get that decoded
+        them already forced the sync — honest by construction)."""
+        t0 = getattr(self._tls, "t0", None)
+        if t0 is None:
+            return
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        self._tls.emitted = True
+        self.observe((("query", query),), dt_ms, rows=rows)
+
+    def ingest_end(self, token) -> None:
+        """Close the span; records the aggregate end-to-end sample iff
+        some query emitted during it (otherwise there was no host-side
+        sync and the number would be a dispatch-enqueue time, not an
+        ingest->emit latency)."""
+        t0 = getattr(self._tls, "t0", None)
+        self._tls.t0 = None
+        if t0 is None or not getattr(self._tls, "emitted", False):
+            return
+        self.observe((), (time.perf_counter() - t0) * 1000.0)
+
+    # -- recording --------------------------------------------------------
+    def observe(self, labels: tuple, lat_ms: float,
+                t_wall_ms: Optional[float] = None, rows: int = 0) -> None:
+        """One latency sample for a scope. ``labels`` is a tuple of
+        (name, value) pairs (possibly empty = aggregate); ``t_wall_ms``
+        defaults to now (tests inject explicit times for deterministic
+        window math)."""
+        t = time.time() * 1000.0 if t_wall_ms is None else float(t_wall_ms)
+        with self._lock:
+            w = self._windows.get(labels)
+            if w is None:
+                w = self._windows[labels] = _Window()
+            w.add(t, float(lat_ms))
+        if self.recorder is not None:
+            self.recorder.record("span", scope=scope_name(labels),
+                                 lat_ms=round(lat_ms, 3), rows=rows)
+
+    # -- evaluation -------------------------------------------------------
+    def _scope_entry(self, w: _Window, now_ms: float) -> dict:
+        obj = self.objective
+        slow_ms = obj.window_ms if obj else SLOW_WINDOW_MS
+        vals = w.in_window(now_ms, slow_ms)
+        entry = {"count": w.count, "window_count": len(vals),
+                 **_percentiles(vals)}
+        if obj is None or not vals:
+            return entry
+        bound = obj.bound_ms
+        bad_slow = sum(1 for v in vals if v > bound)
+        fast_vals = w.in_window(now_ms, obj.fast_ms)
+        bad_fast = sum(1 for v in fast_vals if v > bound)
+        frac_slow = bad_slow / len(vals)
+        frac_fast = bad_fast / len(fast_vals) if fast_vals else 0.0
+        burn_slow = frac_slow / obj.budget
+        burn_fast = frac_fast / obj.budget
+        # round before thresholding: 1 - target is not exactly
+        # representable (0.02/0.01 must compare as exactly 2.0)
+        burn = round(min(burn_fast, burn_slow), 9)
+        state = PAGE if burn >= obj.page_burn else \
+            WARN if burn >= obj.warn_burn else OK
+        entry.update({
+            "attainment": round(1.0 - frac_slow, 5),
+            "burn_fast": round(burn_fast, 3),
+            "burn_slow": round(burn_slow, 3),
+            "state": state,
+        })
+        if obj.p50_ms is not None and "p50_ms" in entry:
+            entry["p50_attained"] = entry["p50_ms"] <= obj.p50_ms
+        return entry
+
+    def evaluate(self, now_ms: Optional[float] = None,
+                 saturation: Optional[dict] = None) -> dict:
+        """The SLO report: per-scope percentiles, attainment, fast/slow
+        burn rates and WARN/PAGE states. Detects state transitions; a
+        transition into PAGE auto-dumps the flight recorder (once per
+        transition, not per scrape)."""
+        now = time.time() * 1000.0 if now_ms is None else float(now_ms)
+        with self._lock:
+            snapshot = list(self._windows.items())
+        scopes: dict = {}
+        transitions: list = []
+        worst = OK
+        for labels, w in snapshot:
+            entry = self._scope_entry(w, now)
+            sname = scope_name(labels)
+            scopes[sname] = entry
+            st = entry.get("state")
+            if st is not None:
+                if _STATE_NUM[st] > _STATE_NUM[worst]:
+                    worst = st
+                with self._lock:
+                    prev = self._states.get(labels, OK)
+                    if st != prev:
+                        self._states[labels] = st
+                        transitions.append((sname, prev, st))
+        paged = [t for t in transitions if t[2] == PAGE]
+        if self.recorder is not None:
+            for sname, prev, st in transitions:
+                self.recorder.record("slo-state", scope=sname,
+                                     frm=prev, to=st)
+        report = {"name": self.name, "every": self.every,
+                  "objective": self.objective.as_dict()
+                  if self.objective else None,
+                  "state": worst if self.objective else None,
+                  "breaches": self.breaches,
+                  "scopes": scopes}
+        if saturation is not None:
+            report["saturation"] = saturation
+        if paged:
+            self.breaches += len(paged)
+            report["breaches"] = self.breaches
+            if self.recorder is not None:
+                ctx = {"slo": {k: v for k, v in report.items()
+                               if k != "saturation"},
+                       "paged_scopes": [s for s, _p, _t in paged]}
+                if saturation is not None:
+                    ctx["saturation"] = saturation
+                if self.context_fn is not None:
+                    try:
+                        ctx["runtime"] = self.context_fn()
+                    except Exception:  # noqa: BLE001 — context is
+                        pass           # best-effort at dump time
+                report["flight_artifact"] = self.recorder.dump(
+                    "slo-breach", context=ctx)
+        if self.recorder is not None and self.recorder.dumps:
+            report["flight_artifacts"] = list(self.recorder.dumps)
+        return report
+
+    @property
+    def state(self) -> str:
+        """Worst current scope state (cheap view over the last
+        evaluate(); OK before any evaluation)."""
+        with self._lock:
+            states = list(self._states.values())
+        worst = OK
+        for s in states:
+            if _STATE_NUM[s] > _STATE_NUM[worst]:
+                worst = s
+        return worst
+
+    # -- registry publication (labeled families) -------------------------
+    def publish(self, registry, prefix: str,
+                now_ms: Optional[float] = None) -> None:
+        """Set labeled gauges — ONE metric family per measure
+        (``<prefix>.p99_ms`` etc.) with ``tenant=``/``query=`` labels,
+        never a dotted name per tenant — and prune scopes that vanished
+        (departed tenants must not leak stale samples into scrapes)."""
+        now = time.time() * 1000.0 if now_ms is None else float(now_ms)
+        with self._lock:
+            snapshot = list(self._windows.items())
+        fams = ("p50_ms", "p95_ms", "p99_ms", "attainment",
+                "burn_fast", "burn_slow", "state", "window_count")
+        keep: dict[str, set] = {f"{prefix}.{f}": set() for f in fams}
+        for labels, w in snapshot:
+            entry = self._scope_entry(w, now)
+            ld = dict(labels)
+            mid = "".join(f"{k}.{v}." for k, v in labels)
+            for fam in fams:
+                v = entry.get(fam)
+                if fam == "state" and v is not None:
+                    v = _STATE_NUM[v]
+                if v is None or (isinstance(v, float) and math.isnan(v)):
+                    continue
+                family = f"{prefix}.{fam}"
+                dotted = f"{prefix}.{mid}{fam}" if mid else family
+                registry.labeled_gauge(
+                    family, ld, dotted=dotted,
+                    help=_FAMILY_HELP.get(fam)).set(v)
+                keep[family].add(dotted)
+        for family, dotted in keep.items():
+            registry.prune_family(family, dotted)
+
+
+_FAMILY_HELP = {
+    "p50_ms": "ingest-to-emit latency p50 over the SLO window (ms)",
+    "p95_ms": "ingest-to-emit latency p95 over the SLO window (ms)",
+    "p99_ms": "ingest-to-emit latency p99 over the SLO window (ms)",
+    "attainment": "fraction of samples inside the latency bound "
+                  "over the SLO window",
+    "burn_fast": "error-budget burn rate over the fast window",
+    "burn_slow": "error-budget burn rate over the slow window",
+    "state": "SLO state: 0=OK 1=WARN 2=PAGE",
+    "window_count": "latency samples inside the SLO window",
+}

@@ -29,9 +29,19 @@ class FaultInjector:
         self.rng = random.Random(seed)
         self.seed = seed
         self.injected = collections.Counter()   # fault kind -> count
+        # fault schedule log: one entry per armed fault (kind + knobs)
+        # — lands in the flight-recorder artifact a failed chaos run
+        # dumps (resilience/scenarios.py failure_artifact), so the
+        # post-mortem knows exactly what was injected with which seed
+        self.events: list[dict] = []
         self._patches: list[tuple[object, str, object]] = []
         # delay_ingest holdback state per patched handler (id -> state)
         self._delayed: dict = {}
+
+    def _arm(self, kind: str, **knobs) -> None:
+        self.events.append({"fault": kind, "seed": self.seed,
+                            **{k: v for k, v in knobs.items()
+                               if v is not None}})
 
     # -- lifecycle --------------------------------------------------------
     def __enter__(self) -> "FaultInjector":
@@ -72,6 +82,7 @@ class FaultInjector:
         - match=fn: only payloads where fn(payload) is truthy can fail
         """
         from ..core.io import ConnectionUnavailableException
+        self._arm("break_sink", fail=fail, rate=rate)
         orig = sink.publish
         calls = {"n": 0}
 
@@ -93,6 +104,7 @@ class FaultInjector:
     def break_source(self, source, fail: int = 1) -> None:
         """Make source.connect raise for the first ``fail`` attempts."""
         from ..core.io import ConnectionUnavailableException
+        self._arm("break_source", fail=fail)
         orig = source.connect
         calls = {"n": 0}
 
@@ -112,6 +124,7 @@ class FaultInjector:
         """Make callback.receive raise for the first ``times`` deliveries
         (times=None: until healed) — exercises the junction's @OnError
         routing."""
+        self._arm("break_callback", times=times)
         orig = callback.receive
         calls = {"n": 0}
 
@@ -142,6 +155,8 @@ class FaultInjector:
         reorder buffer with ``lateness >= max_skew_ms`` repairs the
         disorder exactly (resilience/ordering.py)."""
         import numpy as np
+        self._arm("shuffle_ingest", max_skew_ms=max_skew_ms,
+                  stream=getattr(handler, "stream_id", None))
         orig_rows, orig_cols = handler.send, handler.send_arrays
         rng = self._np_rng()
 
@@ -177,6 +192,8 @@ class FaultInjector:
         with ``dedup='true'`` detects every injected duplicate while
         both copies share the reorder window."""
         import numpy as np
+        self._arm("duplicate_ingest", rate=rate,
+                  stream=getattr(handler, "stream_id", None))
         orig_cols = handler.send_arrays
         rng = self._np_rng()
 
@@ -202,6 +219,8 @@ class FaultInjector:
         the stream's late-event policy. ``release_delayed(handler)``
         flushes still-held rows at scenario end."""
         import numpy as np
+        self._arm("delay_ingest", delay_ms=delay_ms, rate=rate,
+                  stream=getattr(handler, "stream_id", None))
         orig_cols = handler.send_arrays
         rng = self._np_rng()
         held = {"ts": [], "cols": None, "frontier": None}
@@ -266,6 +285,7 @@ class FaultInjector:
         """Damage snapshot bytes on their way into PersistenceStore.save:
         ``truncate`` keeps the first third; ``flip`` XORs seeded bytes.
         times=N damages only the first N saves (None: all)."""
+        self._arm("corrupt_saves", mode=mode, times=times)
         orig = store.save
         calls = {"n": 0}
 

@@ -38,6 +38,35 @@ def _fresh_topic(tag: str) -> str:
     return f"chaos.{tag}.{next(_TOPIC_SEQ)}"
 
 
+def failure_artifact(name: str, result: dict,
+                     dirpath: Optional[str] = None) -> str:
+    """Dump a flight-recorder artifact for a FAILED chaos scenario and
+    return its path. The ring carries the scenario's armed-fault
+    schedule (``result['faults']`` — FaultInjector.events, seed
+    included) so the exact injection plan survives the process; the
+    context carries the full result dict the assertion rejected."""
+    from ..obs.slo import FlightRecorder
+    rec = FlightRecorder(f"chaos.{name}", dirpath=dirpath)
+    for ev in result.get("faults") or []:
+        rec.record("fault-armed", **ev)
+    rec.record("scenario-failed", scenario=name)
+    return rec.dump("chaos-failure", context={"result": result})
+
+
+def assert_scenario(name: str, ok: bool, result: dict,
+                    dirpath: Optional[str] = None) -> None:
+    """Assert a scenario outcome; on failure, write the flight-recorder
+    artifact FIRST and put its path in the assertion message — failed
+    chaos runs must be diagnosable after the fact (tools/chaos.py and
+    tests/test_resilience.py route through this)."""
+    if ok:
+        return
+    path = failure_artifact(name, result, dirpath=dirpath)
+    raise AssertionError(
+        f"chaos scenario '{name}' failed — flight-recorder artifact: "
+        f"{path}; result={result}")
+
+
 def run_sink_outage_crash_recovery(seed: int = 0, n_events: int = 8,
                                    rate: Optional[float] = None) -> dict:
     """Sink outage longer than the retry budget + mid-run crash.
@@ -97,6 +126,7 @@ def run_sink_outage_crash_recovery(seed: int = 0, n_events: int = 8,
         "checkpoint": revision,
         "restored": restored,
         "replayed": replayed,
+        "faults": fi.events,
     }
 
 
@@ -146,6 +176,7 @@ def run_corrupt_snapshot_fallback(seed: int = 0) -> dict:
         "fell_back": restored == good_rev,
         "post_restore_sums": got,
         "expected_sums": [9],
+        "faults": fi.events,
     }
 
 
@@ -223,13 +254,14 @@ def run_disorder_equivalence(seed: int = 0, n: int = 512,
                 hl.send_arrays(lts, lcols)
                 hr.send_arrays(rts, rcols)
             injected = dict(fi.injected)
+            faults = list(fi.events)
         rt.shutdown()   # final watermark flush releases the tail
         counters = {sid: dict(b.counters)
                     for sid, b in rt._reorder.items()}
-        return got_j, got_w, injected, counters
+        return got_j, got_w, injected, counters, faults
 
-    oj, ow, _, _ = _run(disorder=False)
-    dj, dw, injected, counters = _run(disorder=True)
+    oj, ow, _, _, _ = _run(disorder=False)
+    dj, dw, injected, counters, faults = _run(disorder=True)
     return {
         "equal": oj == dj and ow == dw,
         "join_ordered": len(oj), "join_disorder": len(dj),
@@ -238,6 +270,7 @@ def run_disorder_equivalence(seed: int = 0, n: int = 512,
         "reorder": counters,
         "duplicates_detected": counters.get("L", {}).get("duplicates", 0),
         "late": sum(c.get("late", 0) for c in counters.values()),
+        "faults": faults,
     }
 
 

@@ -36,7 +36,10 @@ Isolation:
 from __future__ import annotations
 
 import logging
+import math
+import os
 import threading
+import time
 from collections import deque
 from typing import Callable, Optional
 
@@ -52,6 +55,9 @@ from ..core.runtime import (BATCH_BUCKETS, InsertIntoStreamHandler,
 from ..core.stream import Event
 from ..core.types import AttrType, GLOBAL_STRINGS, np_dtype
 from ..lang import ast as A
+from ..obs.slo import (EVERY_ENV as _SLO_EVERY_ENV, FlightRecorder,
+                       SLOEngine, config_from_annotation as _slo_from_ann,
+                       objective_from_dials)
 from ..ops.expr import CompileError
 
 log = logging.getLogger("siddhi_tpu.serving")
@@ -59,15 +65,30 @@ log = logging.getLogger("siddhi_tpu.serving")
 _DEFAULT_MAX_TENANTS = 1024
 _DEFAULT_BATCH_MAX = 1024
 _DEFAULT_PENDING_CAP = 1 << 20   # rows buffered per tenant before 429
+# SLO sampling stride for pool rounds: one fair round already advances
+# EVERY tenant, so rounds are far rarer than per-tenant chunks — an 8x
+# stride keeps histograms dense while the sampled block_until_ready
+# serializes at most 1-in-8 rounds (SIDDHI_TPU_SLO_EVERY overrides)
+_POOL_DEFAULT_EVERY = 8
+
+_TENANT_HELP = {
+    "emitted": "events emitted for one tenant across its queries",
+    "pending": "rows queued for one tenant awaiting a fair round",
+    "errors": "events routed to one tenant's error-store partition",
+}
 
 
 class AdmissionError(Exception):
     """Deploy/ingest rejected by admission control (HTTP 429 at the
-    front door); `.reason` names the exhausted resource."""
+    front door); `.reason` names the exhausted resource and
+    `.saturation` carries the machine-readable cause (which resource,
+    current pressure signals, a Retry-After estimate) so clients and
+    autoscalers don't have to parse prose (docs/serving.md)."""
 
-    def __init__(self, reason: str):
+    def __init__(self, reason: str, saturation: Optional[dict] = None):
         super().__init__(reason)
         self.reason = reason
+        self.saturation = saturation or {}
 
 
 def _pow2(n: int) -> int:
@@ -90,7 +111,8 @@ class TenantPool:
                  slots: int = 8, max_tenants: Optional[int] = None,
                  state_quota_bytes: Optional[int] = None,
                  batch_max: Optional[int] = None,
-                 pending_cap: int = _DEFAULT_PENDING_CAP):
+                 pending_cap: int = _DEFAULT_PENDING_CAP,
+                 slo: Optional[dict] = None):
         from ..core.manager import SiddhiManager
         from ..obs.metrics import MetricsRegistry
         self.template = template
@@ -162,6 +184,37 @@ class TenantPool:
         self.metrics = MetricsRegistry()
         self.metrics.register_collector(
             lambda: self._collect_observability()[0])
+        # -- SLO engine + saturation signals (obs/slo.py) -----------------
+        # Always on for pools (per-tenant p99 is the ROADMAP item 2
+        # deliverable); the latency OBJECTIVE is optional and comes from
+        # the template's `@app:slo(...)` annotation or the constructor's
+        # `slo={...}` dial (dial wins — it is the deployment's word).
+        slo_dials = dict(slo or {})
+        flight_dir = slo_dials.pop("flight_dir", None)
+        objective = None
+        if slo_dials:
+            objective = objective_from_dials(slo_dials)
+        else:
+            slo_ann = A.find_annotation(app_ast.annotations, "slo")
+            if slo_ann is not None:
+                try:
+                    objective = _slo_from_ann(slo_ann)
+                except ValueError as e:
+                    raise CompileError(str(e))
+        every = objective.every if objective is not None and \
+            objective.every else None
+        if every is None:
+            env = os.environ.get(_SLO_EVERY_ENV, "")
+            every = int(env) if env else _POOL_DEFAULT_EVERY
+        self.flight = FlightRecorder(self.name, dirpath=flight_dir)
+        self.slo_engine = SLOEngine(
+            self.name, objective=objective, every=every,
+            recorder=self.flight, context_fn=self._flight_context)
+        # admission-rejection saturation counters (host-side only)
+        self._rejections: dict[str, int] = {}
+        self._rejection_times: deque = deque(maxlen=512)
+        self._last_pump_wall: Optional[float] = None
+        self._round_ms_ema: Optional[float] = None
 
     # -- planning ---------------------------------------------------------
 
@@ -280,17 +333,83 @@ class TenantPool:
     def admit(self) -> tuple[bool, str]:
         """Admission control: (ok, reason). Checked by add_tenant and by
         the service front door BEFORE building anything (429 + reason)."""
+        ok, reason, _cause = self._admit_check()
+        return ok, reason
+
+    def _admit_check(self) -> tuple[bool, str, str]:
+        """(ok, human reason, machine cause) — the cause slug rides the
+        429's ``saturation`` payload (docs/serving.md)."""
         if len(self._tenants) >= self.max_tenants:
             return False, (f"pool '{self.name}' tenant slots exhausted "
-                           f"(cap {self.max_tenants})")
+                           f"(cap {self.max_tenants})"), "slots-exhausted"
         if self.state_quota_bytes is not None:
             need = (len(self._tenants) + 1) * self.state_bytes_per_tenant
             if need > self.state_quota_bytes:
                 return False, (
                     f"pool '{self.name}' per-tenant state quota "
                     f"exhausted ({need} > {self.state_quota_bytes} bytes "
-                    f"at {self.state_bytes_per_tenant} bytes/tenant)")
-        return True, ""
+                    f"at {self.state_bytes_per_tenant} bytes/tenant)"), \
+                    "state-quota"
+        return True, "", ""
+
+    # -- saturation signals (obs/slo.py; docs/observability.md) -----------
+
+    def _retry_after_ms(self, pending_rows: int) -> int:
+        """Backlog drain estimate: rounds needed at the fair-share rate
+        times the EMA round duration — the 429's Retry-After hint."""
+        rounds = max(1, math.ceil(pending_rows / max(1, self.batch_max)))
+        per_round = self._round_ms_ema if self._round_ms_ema else 1.0
+        return int(math.ceil(rounds * max(per_round, 1.0)))
+
+    def _reject(self, cause: str, reason: str,
+                tenant: Optional[str] = None, **info):
+        """Count + flight-record an admission rejection, then raise
+        AdmissionError carrying the machine-readable saturation payload
+        (caller holds the pool lock)."""
+        self._rejections[cause] = self._rejections.get(cause, 0) + 1
+        self._rejection_times.append(time.perf_counter())
+        sat = {"cause": cause, **info, **self._saturation_locked()}
+        if tenant is not None:
+            sat["tenant"] = tenant
+        self.flight.record("admission-reject", cause=cause,
+                           tenant=tenant, reason=reason)
+        raise AdmissionError(reason, saturation=sat)
+
+    def _saturation_locked(self) -> dict:
+        """Current pressure signals (host-side only; caller holds the
+        lock): queue age, backlog, round-drain lag, rejection counts."""
+        now = time.perf_counter()
+        ages = [now - q[0][2] for q in self._pending.values() if q]
+        pending_total = sum(self._pending_rows.values())
+        lag = 0.0
+        if pending_total and self._last_pump_wall is not None:
+            lag = (now - self._last_pump_wall) * 1000.0
+        recent = sum(1 for t in self._rejection_times if now - t <= 60.0)
+        return {
+            "pending_rows": pending_total,
+            "queue_age_ms_max": round(max(ages) * 1000.0, 1)
+            if ages else 0.0,
+            "drain_lag_ms": round(lag, 1),
+            "round_ms_ema": round(self._round_ms_ema, 2)
+            if self._round_ms_ema is not None else None,
+            "rejections": dict(self._rejections),
+            "rejections_last_60s": recent,
+        }
+
+    def saturation(self) -> dict:
+        with self._lock:
+            return self._saturation_locked()
+
+    def _flight_context(self) -> dict:
+        """Host-side pool snapshot for flight-recorder dumps (no device
+        reads, no registry re-entrancy)."""
+        with self._lock:
+            return {
+                "pool": self.name, "slots": self.slots,
+                "active": len(self._tenants), "rounds": self._rounds,
+                "pending": dict(self._pending_rows),
+                "saturation": self._saturation_locked(),
+            }
 
     def add_tenant(self, tenant_id: str,
                    bindings: Optional[dict] = None) -> int:
@@ -303,9 +422,11 @@ class TenantPool:
                 raise ValueError(
                     f"tenant '{tenant_id}' is already deployed in pool "
                     f"'{self.name}'")
-            ok, reason = self.admit()
+            ok, reason, cause = self._admit_check()
             if not ok:
-                raise AdmissionError(reason)
+                self._reject(cause, reason, tenant=tenant_id,
+                             active=len(self._tenants),
+                             max_tenants=self.max_tenants)
             vals = check_template_bindings(self.proto.ast,
                                            dict(bindings or {}))
             if not self._free:
@@ -340,9 +461,12 @@ class TenantPool:
     def _grow(self) -> None:
         new_slots = self.slots * 2
         if new_slots > self._slot_cap:
-            raise AdmissionError(
+            self._reject(
+                "slots-exhausted",
                 f"pool '{self.name}' tenant slots exhausted "
-                f"(cap {self.max_tenants})")
+                f"(cap {self.max_tenants})",
+                active=len(self._tenants),
+                max_tenants=self.max_tenants)
         log.info("pool '%s': growing tenant axis %d -> %d slots "
                  "(programs recompile at the new width)",
                  self.name, self.slots, new_slots)
@@ -385,73 +509,127 @@ class TenantPool:
     def send(self, tenant_id: str, ts, cols) -> None:
         """Queue one columnar chunk for a tenant (numpy ts + columns,
         STRING columns as dictionary codes — the send_arrays contract).
-        Dispatch happens in fair rounds via pump()/flush() or the
-        background worker."""
+        Every chunk is stamped with its host arrival time (one
+        perf_counter read — the queue-age saturation signal and the
+        ingest side of the sampled ingest->emit span). Dispatch happens
+        in fair rounds via pump()/flush() or the background worker."""
         ts = np.asarray(ts, dtype=np.int64)
         n = int(ts.shape[0])
         if n == 0:
             return
         cols = [np.ascontiguousarray(c) for c in cols]
+        t_arr = time.perf_counter()
         with self._lock:
             self._slot(tenant_id)
             if self._pending_rows[tenant_id] + n > self.pending_cap:
-                raise AdmissionError(
+                self._reject(
+                    "ingest-backlog",
                     f"tenant '{tenant_id}' ingest backlog full "
                     f"({self._pending_rows[tenant_id]} rows pending, "
-                    f"cap {self.pending_cap})")
-            self._pending[tenant_id].append((ts, cols))
+                    f"cap {self.pending_cap})",
+                    tenant=tenant_id,
+                    pending_rows=self._pending_rows[tenant_id],
+                    pending_cap=self.pending_cap,
+                    retry_after_ms=self._retry_after_ms(
+                        self._pending_rows[tenant_id]))
+            self._pending[tenant_id].append((ts, cols, t_arr))
             self._pending_rows[tenant_id] += n
             self._work.notify()
 
     def _take(self, tenant_id: str, limit: int):
         """Up to `limit` rows off a tenant's pending queue (splitting a
-        chunk re-queues the remainder at the head — order preserved)."""
+        chunk re-queues the remainder at the head — order AND arrival
+        stamp preserved). Returns (ts, cols, oldest_arrival)."""
         q = self._pending.get(tenant_id)
         if not q:
             return None
         ts_parts, col_parts, taken = [], [], 0
+        t_oldest = None
         while q and taken < limit:
-            ts, cols = q.popleft()
+            ts, cols, t_arr = q.popleft()
             room = limit - taken
             if len(ts) > room:
-                q.appendleft((ts[room:], [c[room:] for c in cols]))
+                q.appendleft((ts[room:], [c[room:] for c in cols], t_arr))
                 ts, cols = ts[:room], [c[:room] for c in cols]
             ts_parts.append(ts)
             col_parts.append(cols)
             taken += len(ts)
+            if t_oldest is None:
+                t_oldest = t_arr
         if not taken:
             return None
         self._pending_rows[tenant_id] -= taken
         ts = np.concatenate(ts_parts)
         cols = [np.concatenate([p[i] for p in col_parts])
                 for i in range(len(col_parts[0]))]
-        return ts, cols
+        return ts, cols, t_oldest
 
     def pump(self) -> int:
         """One fair dispatch round: every tenant contributes up to
         batch_max rows, ONE vmapped step per query advances all of them.
-        Returns rows dispatched (0 = nothing pending)."""
+        Returns rows dispatched (0 = nothing pending).
+
+        On every ``slo_engine.every``-th round the SLO engine samples:
+        the round blocks after each vmapped query step (the sampled
+        branch only — the PR 7 stride contract) and attributes
+        arrival->emit latency per (tenant), (tenant, query) and
+        pool-wide from the chunks' host arrival stamps."""
+        t_round0 = time.perf_counter()
         with self._lock:
             per_slot = {}
+            stamps: dict[str, float] = {}
             taken = 0
             last_ts = self._now
             for tid, slot in self._tenants.items():
                 got = self._take(tid, self.batch_max)
                 if got is None:
                     continue
-                per_slot[slot] = got
-                taken += len(got[0])
-                last_ts = max(last_ts, int(got[0][-1]))
+                ts_a, cols_a, t_arr = got
+                per_slot[slot] = (ts_a, cols_a)
+                stamps[tid] = t_arr
+                taken += len(ts_a)
+                last_ts = max(last_ts, int(ts_a[-1]))
             if not taken:
+                self._last_pump_wall = time.perf_counter()
                 return 0
             self._now = max(self._now, last_ts)
             cap = bucket_capacity(
                 max(len(r[0]) for r in per_slot.values()))
             batch = self._stacked_batch(per_slot, cap)
-            terminal = self._dispatch(batch, self._now)
+            sampled = self.slo_engine.tick("round")
+            terminal, qtimes = self._dispatch(batch, self._now,
+                                              sample=sampled)
             self._rounds += 1
+            if sampled and qtimes:
+                self._slo_attribute(stamps, qtimes, taken)
+            dur_ms = (time.perf_counter() - t_round0) * 1000.0
+            self._round_ms_ema = dur_ms if self._round_ms_ema is None \
+                else 0.8 * self._round_ms_ema + 0.2 * dur_ms
+            self._last_pump_wall = time.perf_counter()
         self._deliver(terminal)
         return taken
+
+    def _slo_attribute(self, stamps: dict, qtimes: dict,
+                       taken: int) -> None:
+        """Fold one sampled round's per-query completion times into the
+        SLO windows (host wall math only — the sync already happened on
+        the sampled branch of _dispatch)."""
+        eng = self.slo_engine
+        t_end = max(qtimes.values()) if qtimes else None
+        oldest = min(stamps.values()) if stamps else None
+        for tid, t_arr in stamps.items():
+            for qn, t_q in qtimes.items():
+                eng.observe((("tenant", tid), ("query", qn)),
+                            (t_q - t_arr) * 1000.0)
+            if t_end is not None:
+                eng.observe((("tenant", tid),),
+                            (t_end - t_arr) * 1000.0)
+        if t_end is not None and oldest is not None:
+            lat = (t_end - oldest) * 1000.0
+            eng.observe((), lat)
+            self.flight.record("round", rows=taken,
+                               tenants=len(stamps),
+                               lat_ms=round(lat, 3))
 
     def flush(self) -> int:
         """Drain every pending chunk through fair rounds."""
@@ -469,7 +647,7 @@ class TenantPool:
         with self._lock:
             self._now = max(self._now, int(now_ms))
             batch = self._stacked_batch({}, BATCH_BUCKETS[0])
-            terminal = self._dispatch(batch, self._now)
+            terminal, _qt = self._dispatch(batch, self._now)
         self._deliver(terminal)
 
     # -- dispatch ---------------------------------------------------------
@@ -525,12 +703,19 @@ class TenantPool:
             self._vsteps[key] = fn
         return fn
 
-    def _dispatch(self, ingest_batch: EventBatch, now: int) -> dict:
+    def _dispatch(self, ingest_batch: EventBatch, now: int,
+                  sample: bool = False) -> tuple[dict, dict]:
         """Run the template's query chain over one stacked round;
-        returns {terminal stream id: stacked out batch} (device)."""
+        returns ({terminal stream id: stacked out batch} (device),
+        {query: host completion time}). The completion times are only
+        populated when ``sample`` is set: that branch blocks after each
+        vmapped step (``block_until_ready`` — NOT a device_get; the
+        one-device-read-per-pool stats contract is untouched) so the
+        per-query ingest->emit attribution is honest."""
         now_dev = jnp.asarray(now, dtype=jnp.int64)
         stream_batches = {self.ingest_stream: ingest_batch}
         terminal: dict = {}
+        qtimes: dict = {}
         for qname in self._order:
             batch = stream_batches.get(self._q_in[qname])
             if batch is None:
@@ -543,12 +728,19 @@ class TenantPool:
             self._states[qname] = states
             self._emitted[qname] = emitted
             self._dispatches += 1
+            if sample:
+                # sampled branch ONLY (1-in-slo_engine.every rounds):
+                # the sync is the point — per-query ingest->emit
+                # attribution needs the step provably finished
+                # (the PR 7 sampled-probe pattern)
+                jax.block_until_ready(out.valid)  # lint: disable=host-sync-in-loop
+                qtimes[qname] = time.perf_counter()
             tgt = self._q_out[qname]
             if tgt in self._terminal:
                 terminal[tgt] = out
             elif tgt is not None:
                 stream_batches[tgt] = out
-        return terminal
+        return terminal, qtimes
 
     def _deliver(self, terminal: dict) -> None:
         for fn in self.batch_callbacks:
@@ -731,11 +923,19 @@ class TenantPool:
     def statistics(self) -> dict:
         return self._collect_observability()[1]
 
+    def slo_report(self) -> dict:
+        """The SLO/burn-rate view on its own (``GET /siddhi/slo``):
+        per-scope latency percentiles, attainment, burn rates, states,
+        plus the pool's saturation signals."""
+        return self.slo_engine.evaluate(saturation=self.saturation())
+
     def _collect_observability(self) -> tuple[dict, dict]:
         """ONE walk shared by statistics() and the registry collector.
         Device reads are O(templates), not O(tenants): the stacked
         emitted counters come back in a single device_get per pool; the
-        per-tenant fan-out below is pure host-side numpy indexing."""
+        per-tenant fan-out below is pure host-side numpy indexing (the
+        SLO windows are host-side too — tracking ON adds zero device
+        reads here; tests/test_slo.py monkeypatch-counts this)."""
         with self._lock:
             host = jax.device_get({"emitted": self._emitted})
             tenants = dict(self._tenants)
@@ -749,10 +949,20 @@ class TenantPool:
                 "grows": self._grows,
                 "state_bytes_per_tenant": self.state_bytes_per_tenant,
             }
+            saturation = self._saturation_locked()
         p = f"siddhi.{self.name}"
         flat: dict = {}
         report: dict = {"pool": pool_stats, "tenants": {}}
         emitted = host["emitted"]
+        # per-tenant gauges: ONE metric family per measure with a
+        # `tenant` (and `query`) label — scrapers see a labeled series
+        # family, registry dumps keep the readable dotted name
+        # (docs/observability.md "label conventions")
+        fams = {key: f"{p}.tenant.{key}" for key in
+                ("emitted", "pending", "errors")}
+        qfam = f"{p}.tenant.query.emitted"
+        keep: dict[str, set] = {f: set() for f in fams.values()}
+        keep[qfam] = set()
         for tid, slot in tenants.items():
             per_q = {qn: int(emitted[qn][slot]) for qn in self._order}
             entry = {"slot": slot, "emitted": per_q,
@@ -760,13 +970,40 @@ class TenantPool:
                      "errors": errors.get(tid, 0)}
             report["tenants"][tid] = entry
             base = f"{p}.tenant.{tid}"
-            flat[f"{base}.emitted"] = sum(per_q.values())
+            for key, value in (("emitted", sum(per_q.values())),
+                               ("pending", entry["pending"]),
+                               ("errors", entry["errors"])):
+                dotted = f"{base}.{key}"
+                self.metrics.labeled_gauge(
+                    fams[key], {"tenant": tid}, dotted=dotted,
+                    help=_TENANT_HELP[key]).set(value)
+                keep[fams[key]].add(dotted)
             for qn, v in per_q.items():
-                flat[f"{base}.query.{qn}.emitted"] = v
-            flat[f"{base}.pending"] = entry["pending"]
-            flat[f"{base}.errors"] = entry["errors"]
+                dotted = f"{base}.query.{qn}.emitted"
+                self.metrics.labeled_gauge(
+                    qfam, {"tenant": tid, "query": qn},
+                    dotted=dotted,
+                    help="events emitted by one tenant's query").set(v)
+                keep[qfam].add(dotted)
+        for fam, dotted in keep.items():
+            # departed tenants must not linger in scrapes
+            self.metrics.prune_family(fam, dotted)
         for k, v in pool_stats.items():
             flat[f"{p}.pool.{k}"] = v
+        # SLO + saturation (obs/slo.py): host-side windows, labeled
+        # p99/burn/state families, machine-readable pressure signals
+        report["slo"] = self.slo_engine.evaluate(saturation=saturation)
+        self.slo_engine.publish(self.metrics, f"{p}.slo")
+        for k in ("pending_rows", "queue_age_ms_max", "drain_lag_ms",
+                  "rejections_last_60s"):
+            v = saturation.get(k)
+            if isinstance(v, (int, float)):
+                flat[f"{p}.saturation.{k}"] = v
+        for cause, n in saturation["rejections"].items():
+            self.metrics.labeled_gauge(
+                f"{p}.saturation.rejections", {"cause": cause},
+                dotted=f"{p}.saturation.rejections.{cause}",
+                help="admission rejections by saturation cause").set(n)
         comp = dict(self.proto.compile_service.summary())
         # ONE compiled program set per template, shared by every tenant
         # — the multi-tenant acceptance invariant (bench.py `tenants`)

@@ -140,3 +140,13 @@ def test_bench_tenants_quick_parses():
     for n, entry in d["tenants"].items():
         assert entry["program_sets"] == 1, (n, entry)
         assert entry["eps_pooled"] > 0
+    # skewed-traffic SLO arm (obs/slo.py): measured p50/p99 attainment
+    # vs the configured objective must parse with burn-rate state
+    slo = d["slo"]
+    assert slo["objective_p99_ms"] > 0
+    assert slo["samples"] > 0, slo
+    assert slo["p99_ms"] > 0 and slo["p50_ms"] > 0
+    assert 0.0 <= slo["attainment"] <= 1.0
+    assert slo["state"] in ("OK", "WARN", "PAGE")
+    assert slo["hot_p99_ms"] > 0 and slo["cold_p99_ms_max"] > 0
+    assert slo["skew"] > 1

@@ -180,6 +180,43 @@ def test_valid_on_error_actions_parse():
     """)
 
 
+def test_slo_missing_bound_raises():
+    with pytest.raises(CompileError, match="slo-config"):
+        parse("@app:slo(target='0.99')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_slo_bad_time_and_target_raise():
+    with pytest.raises(CompileError, match="slo-config"):
+        parse("@app:slo(p99='fast-ish')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+    with pytest.raises(CompileError, match="slo-config"):
+        parse("@app:slo(p99='100 ms', target='1.5')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_slo_window_ordering_and_stride_raise():
+    with pytest.raises(CompileError, match="slo-config"):
+        parse("@app:slo(p99='100 ms', fast='2 hours', window='1 min')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+    with pytest.raises(CompileError, match="slo-config"):
+        parse("@app:slo(p99='100 ms', every='-2')\n"
+              "define stream S (a int);\n"
+              "from S select a insert into Out;")
+
+
+def test_valid_slo_config_parses():
+    app = parse("@app:slo(p99='250 ms', p50='50 ms', target='0.999', "
+                "window='30 min', fast='1 min')\n"
+                "define stream S (a int);\n"
+                "from S select a insert into Out;")
+    assert app is not None
+
+
 def test_unknown_watermark_policy_raises():
     with pytest.raises(CompileError, match="watermark-config"):
         parse("@app:watermark(lateness='10', policy='YOLO')\n"

@@ -552,11 +552,18 @@ def test_service_tenant_deploy_ingest_stats_undeploy():
             time.sleep(0.05)
         assert emitted == 1
 
-        # /metrics carries the per-tenant namespace
+        # /metrics carries per-tenant samples as ONE labeled family
+        # (tenant= label), not a dotted metric name per tenant
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{svc.port}/metrics") as r:
             text = r.read().decode()
-        assert "tenant_acme" in text and "tenant_globex" in text
+        assert 'tenant="acme"' in text and 'tenant="globex"' in text
+        assert "tenant_acme" not in text  # no dotted-name explosion
+        fam = [ln for ln in text.splitlines()
+               if ln.startswith("# TYPE") and "tenant_emitted" in ln]
+        assert len(fam) == 1, fam  # one TYPE header per family
+        assert any(ln.startswith("# HELP") and "tenant_emitted" in ln
+                   for ln in text.splitlines())
 
         code, _ = _get(svc.port,
                        f"/siddhi/tenant/undeploy/{pool_name}/globex")
@@ -620,10 +627,14 @@ def test_metrics_dump_tenant_filter_unit():
             "metrics_dump.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    text = ("# TYPE siddhi_pool_x_tenant_a_emitted gauge\n"
-            "siddhi_pool_x_tenant_a_emitted 3 1\n"
-            "siddhi_pool_x_tenant_b_emitted 5 1\n"
-            "siddhi_pool_x_pool_slots 4 1\n")
+    # labeled family samples (the exposition shape since the tenant
+    # label conversion) plus a legacy dotted line for compatibility
+    text = ('# TYPE siddhi_pool_x_tenant_emitted gauge\n'
+            'siddhi_pool_x_tenant_emitted{tenant="a"} 3 1\n'
+            'siddhi_pool_x_tenant_emitted{tenant="b"} 5 1\n'
+            'siddhi_pool_x_tenant_a_pending 2 1\n'
+            'siddhi_pool_x_pool_slots 4 1\n')
     out = mod.filter_tenant(text, "a")
-    assert "tenant_a_emitted 3" in out
-    assert "tenant_b" not in out and "pool_slots" not in out
+    assert 'tenant="a"} 3' in out
+    assert "tenant_a_pending 2" in out     # legacy dotted still matches
+    assert 'tenant="b"' not in out and "pool_slots" not in out

@@ -36,28 +36,36 @@ def run(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from siddhi_tpu.resilience.scenarios import (
-        run_corrupt_snapshot_fallback, run_disorder_equivalence,
-        run_sink_outage_crash_recovery, run_soak)
+        failure_artifact, run_corrupt_snapshot_fallback,
+        run_disorder_equivalence, run_sink_outage_crash_recovery,
+        run_soak)
 
     failures = 0
 
-    def report(name: str, ok: bool, detail: str) -> None:
+    def report(name: str, ok: bool, detail: str,
+               result: dict = None) -> None:
         nonlocal failures
         failures += 0 if ok else 1
         print(f"[{'PASS' if ok else 'FAIL'}] {name}: {detail}")
+        if not ok and result is not None:
+            # failed chaos runs must be diagnosable after the fact:
+            # dump the flight-recorder artifact (armed-fault schedule +
+            # full result) and print where it landed
+            path = failure_artifact(name, result)
+            print(f"       flight-recorder artifact: {path}")
 
     res = run_sink_outage_crash_recovery(seed=args.seed)
     report("sink-outage-crash-recovery",
            not res["lost"] and res["restored"] == res["checkpoint"],
            f"stored={res['stored_backlog']} replayed={res['replayed']} "
-           f"lost={res['lost']} duplicates={res['duplicates']}")
+           f"lost={res['lost']} duplicates={res['duplicates']}", res)
 
     res = run_corrupt_snapshot_fallback(seed=args.seed)
     report("corrupt-snapshot-fallback",
            res["fell_back"]
            and res["post_restore_sums"] == res["expected_sums"],
            f"restored={res['restored']} "
-           f"sums={res['post_restore_sums']}")
+           f"sums={res['post_restore_sums']}", res)
 
     res = run_disorder_equivalence(seed=args.seed)
     report("disorder-equivalence",
@@ -65,14 +73,14 @@ def run(argv=None) -> int:
            f"join={res['join_disorder']}/{res['join_ordered']} "
            f"window={res['window_disorder']}/{res['window_ordered']} "
            f"dups_detected={res['duplicates_detected']} "
-           f"injected={res['injected']}")
+           f"injected={res['injected']}", res)
 
     if args.soak:
         for i, r in enumerate(run_soak(seed=args.seed,
                                        rounds=args.soak)):
             report(f"soak-round-{i}", not r["lost"],
                    f"stored={r['stored_backlog']} "
-                   f"replayed={r['replayed']} lost={r['lost']}")
+                   f"replayed={r['replayed']} lost={r['lost']}", r)
 
     status = "OK" if failures == 0 else f"{failures} scenario(s) FAILED"
     print(f"chaos suite: {status} (seed {args.seed})")

@@ -45,14 +45,18 @@ from S[v > ${lo:int}] select v insert into Out;
 
 
 def filter_tenant(text: str, tenant: str) -> str:
-    """Keep only the scrape lines (plus comments' following samples)
-    belonging to one tenant's ``siddhi.<pool>.tenant.<id>.*`` namespace
-    — per-tenant isolation applies to observability reads too."""
+    """Keep only the scrape lines belonging to one tenant: samples of
+    the labeled tenant families carrying ``tenant="<id>"`` (the
+    exposition shape since the label conversion — one metric family per
+    measure, a ``tenant`` label per sample) plus any legacy dotted
+    ``...tenant_<id>_...`` names. Per-tenant isolation applies to
+    observability reads too."""
     from siddhi_tpu.obs.metrics import prom_name
-    marker = prom_name(f"tenant.{tenant}.")
+    dotted_marker = prom_name(f"tenant.{tenant}.")
+    label_marker = f'tenant="{tenant}"'
     return "".join(
         ln + "\n" for ln in text.splitlines()
-        if marker in ln)
+        if label_marker in ln or dotted_marker in ln)
 
 
 def _synthetic_traffic(rt, n: int) -> bool:

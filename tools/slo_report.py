@@ -1,0 +1,173 @@
+#!/usr/bin/env python
+"""Scrape ``GET /siddhi/slo`` and print the SLO / burn-rate table — the
+CI smoke probe for the SLO engine (docs/observability.md "SLO engine").
+
+    python tools/slo_report.py                     # built-in demo app
+    python tools/slo_report.py app.siddhi          # your @app:slo app
+    python tools/slo_report.py --watch 5           # 5 periodic scrapes
+    python tools/slo_report.py --url http://host:9090   # existing service
+
+Self-hosted mode spins up a loopback SiddhiService, deploys the app
+(default: a demo with an intentionally-loose objective), pushes
+synthetic traffic, then scrapes. ``--watch N`` repeats the scrape N
+times at ``--interval`` seconds — the periodic mode for watching a
+rollout burn down.
+
+Exit status: 0 when every objective is OK/WARN, **1 when any scope is
+in PAGE state** on the final scrape — usable exactly like
+tools/metrics_dump.py as a CI gate:
+
+    python tools/slo_report.py || echo "latency SLO paging"
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+DEMO_APP = """
+@app:name('slo_probe')
+@app:playback
+@app:slo(p99='2 sec', target='0.9', every='1')
+define stream S (v int);
+@info(name = 'q')
+from S[v > 0] select v insert into Out;
+"""
+
+_COLS = ("scope", "n", "p50_ms", "p99_ms", "attain", "burn_f",
+         "burn_s", "state")
+
+
+def _fmt_row(vals) -> str:
+    return ("{:<36} {:>6} {:>9} {:>9} {:>7} {:>7} {:>7} {:>5}"
+            .format(*vals))
+
+
+def render(report: dict, out=sys.stdout) -> bool:
+    """Print the table; returns True when any scope pages."""
+    paged = False
+    out.write(_fmt_row(_COLS) + "\n")
+    for kind in ("apps", "pools"):
+        for name, rep in sorted((report.get(kind) or {}).items()):
+            obj = rep.get("objective")
+            bound = obj.get("p99_ms") if obj else None
+            for sname, e in sorted((rep.get("scopes") or {}).items()):
+                state = e.get("state", "-")
+                paged |= state == "PAGE"
+                out.write(_fmt_row((
+                    f"{name}/{sname}"[:36],
+                    e.get("window_count", e.get("count", 0)),
+                    e.get("p50_ms", "-"), e.get("p99_ms", "-"),
+                    e.get("attainment", "-"),
+                    e.get("burn_fast", "-"), e.get("burn_slow", "-"),
+                    state)) + "\n")
+            if bound is not None:
+                out.write(f"  objective[{name}]: p99<={bound}ms "
+                          f"target={obj.get('target')}\n")
+            sat = rep.get("saturation")
+            if sat:
+                keys = ("pending_rows", "queue_age_ms_max",
+                        "drain_lag_ms", "async_depth_max",
+                        "watermark_lag_ms_max", "rejections_last_60s")
+                parts = [f"{k}={sat[k]}" for k in keys
+                         if sat.get(k) not in (None, 0, 0.0)]
+                if parts:
+                    out.write(f"  saturation[{name}]: "
+                              + " ".join(parts) + "\n")
+            art = rep.get("flight_artifacts")
+            if art:
+                out.write(f"  flight-recorder[{name}]: {art[-1]}\n")
+    out.write(f"overall: {report.get('state', '-')}\n")
+    return paged
+
+
+def _scrape(url: str) -> dict:
+    with urllib.request.urlopen(f"{url}/siddhi/slo", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _synthetic_traffic(rt, n: int) -> None:
+    import numpy as np
+    for sid, handler in rt.input_handlers.items():
+        schema = rt.schemas[sid]
+        from siddhi_tpu.core.types import np_dtype
+        try:
+            cols = [(np.arange(n) % 97 + 1).astype(np_dtype(a.type))
+                    for a in schema.attributes]
+        except TypeError:
+            continue
+        ts = 1_000_000 + np.arange(n, dtype=np.int64)
+        handler.send_arrays(ts, cols)
+        return
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("app", nargs="?", help="path to a .siddhi app with "
+                    "an @app:slo annotation (default: built-in demo)")
+    ap.add_argument("--url", help="scrape an already-running service "
+                    "instead of self-hosting")
+    ap.add_argument("--watch", type=int, default=1, metavar="N",
+                    help="number of periodic scrapes (default 1)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between scrapes in --watch mode")
+    ap.add_argument("--events", type=int, default=256,
+                    help="synthetic events per round in self-hosted "
+                    "mode (0 = none)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw /siddhi/slo JSON instead of "
+                    "the table")
+    args = ap.parse_args(argv)
+
+    svc = None
+    rt = None
+    if args.url is None:
+        from siddhi_tpu.core.service import SiddhiService
+        svc = SiddhiService()
+        svc.start()
+        ql = DEMO_APP if args.app is None else open(args.app).read()
+        name = svc.deploy(ql)
+        rt = svc._deployed[name]
+        # ingest->emit needs an emit: subscribe a no-op callback on
+        # every terminal (consumer-less) stream so the dispatch decodes
+        # host rows and the SLO spans sample
+        from siddhi_tpu.core.stream import StreamCallback
+        for sid, j in rt.junctions.items():
+            if not j.receivers and not sid.startswith("!"):
+                rt.add_callback(sid, StreamCallback(fn=lambda evs: None))
+        url = f"http://127.0.0.1:{svc.port}"
+    else:
+        url = args.url.rstrip("/")
+
+    paged = False
+    try:
+        for i in range(max(1, args.watch)):
+            if rt is not None and args.events > 0:
+                _synthetic_traffic(rt, args.events)
+            report = _scrape(url)
+            if args.json:
+                print(json.dumps(report, indent=1, sort_keys=True))
+                paged = report.get("state") == "PAGE"
+            else:
+                if args.watch > 1:
+                    print(f"--- scrape {i + 1}/{args.watch} ---")
+                paged = render(report)
+            if i + 1 < max(1, args.watch):
+                time.sleep(args.interval)
+    finally:
+        if svc is not None:
+            svc.stop()
+    return 1 if paged else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
