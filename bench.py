@@ -1120,6 +1120,53 @@ def bench_warmstart():
             "apps": apps}
 
 
+def bench_multichip():
+    """Mesh scale-out (ROADMAP item 1): aggregate events/s at 8 devices
+    vs 1 device for the filter (data-parallel ingest), seq5 (per-shard
+    NFA state) and tenants (slot-axis-sharded TenantPool) arms —
+    {n_devices, eps_aggregate, eps_per_device, scaling_efficiency} per
+    arm via parallel/mesh.py measure_scaling. Runs in-process on a
+    backend with enough devices (8-chip TPU: hardware numbers);
+    otherwise re-execs itself under the forced-host-device CPU shim
+    (plumbing guard — `host_device_shim: true` marks those numbers as
+    shared-core, docs/performance.md "Multi-chip execution")."""
+    n = int(_env("SIDDHI_BENCH_MC_DEVICES", "8") or 8)
+    if len(jax.devices()) < n:
+        import subprocess
+        import sys
+        env = dict(os.environ)
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n}")
+        env["XLA_FLAGS"] = " ".join(flags)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["SIDDHI_BENCH_PLATFORM"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, __file__, "multichip"],
+            capture_output=True, text=True, env=env,
+            timeout=max(BUDGET_S, 240.0))
+        lines = [ln for ln in proc.stdout.splitlines()
+                 if ln.startswith("{")]
+        if proc.returncode != 0 or not lines:
+            raise RuntimeError(
+                f"multichip shim child rc={proc.returncode}: "
+                f"{(proc.stderr or proc.stdout)[-800:]}")
+        return json.loads(lines[-1])
+    from siddhi_tpu.parallel.mesh import measure_scaling
+    out = measure_scaling(
+        n_devices=n,
+        chunk=int(_env("SIDDHI_BENCH_MC_CHUNK", "16384") or 16384),
+        seq_chunk=int(_env("SIDDHI_BENCH_MC_SEQ_CHUNK", "4096")
+                      or 4096),
+        iters=int(_env("SIDDHI_BENCH_MC_ITERS", "4") or 4),
+        reps=REPS,
+        tenants=int(_env("SIDDHI_BENCH_MC_TENANTS", "512") or 512),
+        tenant_rows=int(_env("SIDDHI_BENCH_MC_ROWS", "1024") or 1024))
+    head = out["arms"].get("filter", {})
+    return {"value": head.get("eps_aggregate", 0), "unit": "events/s",
+            "baseline": "n/a", **out}
+
+
 # join_fanout: the 2M-pair executable compiles server-side in ~2-2.5 min
 # (the tunnel backend does not reuse the client persistent cache for it)
 # — r5's default run timed out on exactly this, so expensive configs run
@@ -1130,7 +1177,7 @@ def bench_warmstart():
 # and the cold/warm split is the PR-5 acceptance metric.
 BENCHES = ("seq5", "chain3", "warmstart", "tenants", "filter",
            "window_agg", "seq2", "kleene", "join", "join_eq",
-           "join_fanout")
+           "join_fanout", "multichip")
 
 
 def main():
@@ -1158,6 +1205,15 @@ def main():
         env.setdefault("SIDDHI_BENCH_TENANTS_SEP", "8")
         os.environ.setdefault("SIDDHI_BENCH_TENANTS", "16,64")
         os.environ.setdefault("SIDDHI_BENCH_TENANTS_SEP", "8")
+        # multichip smoke: tiny arms so the forced-8-device shim child
+        # (test_bench_smoke) stays inside its subprocess timeout
+        for k, v in (("SIDDHI_BENCH_MC_CHUNK", "2048"),
+                     ("SIDDHI_BENCH_MC_SEQ_CHUNK", "512"),
+                     ("SIDDHI_BENCH_MC_ITERS", "2"),
+                     ("SIDDHI_BENCH_MC_TENANTS", "32"),
+                     ("SIDDHI_BENCH_MC_ROWS", "256")):
+            env.setdefault(k, v)
+            os.environ.setdefault(k, v)
         globals().update(
             SCALE=float(env["SIDDHI_BENCH_SCALE"]),
             REPS=int(env["SIDDHI_BENCH_REPS"]),

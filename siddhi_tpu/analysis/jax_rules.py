@@ -375,6 +375,75 @@ def quadratic_grid_hazard(ctx: ModuleContext) -> Iterator[Finding]:
 
 
 # ---------------------------------------------------------------------
+# rule: cross-shard-transfer-hazard
+# ---------------------------------------------------------------------
+
+# names that carry a leading slot/shard axis somewhere in this codebase:
+# the partition key-slot state (qstates/slot_tbl, parallel/partition.py),
+# the tenant-pool stacked states/emitted counters (serving/pool.py), and
+# the join side buffers (core/runtime.py) — on a mesh these are SHARDED
+# over devices (parallel/sharding.py rule tables)
+_SLOT_STATE_NAMES = {"qstates", "_states", "_emitted", "slot_tbl",
+                     "side_states"}
+
+
+def _mentions_slot_state(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _SLOT_STATE_NAMES:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _SLOT_STATE_NAMES:
+            return True
+    return False
+
+
+@register(
+    "cross-shard-transfer-hazard", WARNING,
+    "jax.device_get/np.asarray on slot-axis state inside a loop pulls "
+    "one (possibly cross-device) shard per iteration; batch per-shard "
+    "reads through the one-read-per-device collection path "
+    "(x.addressable_shards, or ONE device_get of the whole pytree)")
+def cross_shard_transfer_hazard(ctx: ModuleContext) -> Iterator[Finding]:
+    """On a mesh, `[K]`-leading / slot-axis state is sharded over
+    devices (parallel/sharding.py): a `device_get`/`np.asarray` of it
+    inside a Python loop gathers shards across the interconnect once
+    per iteration — the multi-chip flavor of host-sync-in-loop.
+    Sanctioned shapes: one batched `device_get` of the whole pytree
+    outside the loop, or per-DEVICE `addressable_shards` reads (the
+    serving/pool.py `_collect_sharded_locked` pattern — those args
+    reference the shard objects, not the state names, so they pass)."""
+    flagged: dict[int, str] = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if not ctx.in_loop(node):
+            continue
+        c = ctx.canon(node.func)
+        if c not in (("jax", "device_get"), ("numpy", "asarray"),
+                     ("numpy", "array")):
+            continue
+        arg = node.args[0]
+        # blessed: enumerating addressable shards IS the per-device
+        # batched path
+        if any(isinstance(s, ast.Attribute)
+               and s.attr == "addressable_shards"
+               for s in ast.walk(arg)):
+            continue
+        if _mentions_slot_state(arg):
+            flagged[id(node)] = ".".join(c)
+    for node in ast.walk(ctx.tree):
+        if id(node) not in flagged:
+            continue
+        if any(id(anc) in flagged for anc in ctx.ancestors(node)):
+            continue  # one transfer, report the outermost call
+        yield _finding(
+            "cross-shard-transfer-hazard", WARNING, ctx, node,
+            f"'{flagged[id(node)]}' on slot-axis state inside a loop — "
+            "on a mesh this gathers a shard across devices per "
+            "iteration; hoist ONE pytree device_get out of the loop or "
+            "read per-device addressable_shards")
+
+
+# ---------------------------------------------------------------------
 # rule: float64-literal
 # ---------------------------------------------------------------------
 

@@ -143,6 +143,11 @@ class CompileService:
         self.records: list[dict] = []   # [{"step", "ms"}...]
         self.total_ms = 0.0
         self.programs = 0
+        # programs whose example args carry a multi-device sharding
+        # (mesh pools / sharded partitions warm through here — the
+        # telemetry proves the AOT pass compiled the SHARDED program,
+        # not a single-device twin that never dispatches)
+        self.sharded_programs = 0
         self.cache_hits = 0
         self.cache_misses = 0
         self.warmups = 0
@@ -550,6 +555,10 @@ class CompileService:
             s0 = time.perf_counter()
             try:
                 fn, args = spec.build()
+                sharded = any(
+                    len(getattr(getattr(leaf, "sharding", None),
+                                "device_set", ())) > 1
+                    for leaf in jax.tree_util.tree_leaves(args))
                 out = fn(*args)
                 jax.block_until_ready(out)
             except Exception as e:  # noqa: BLE001 — warmup is best-effort:
@@ -557,9 +566,11 @@ class CompileService:
                 errors.append({"step": spec.key,
                                "error": f"{type(e).__name__}: {e}"})
                 return
-            records.append({"step": spec.key,
-                            "ms": round((time.perf_counter() - s0) * 1e3,
-                                        1)})
+            rec = {"step": spec.key,
+                   "ms": round((time.perf_counter() - s0) * 1e3, 1)}
+            if sharded:
+                rec["sharded"] = True
+            records.append(rec)
 
         nworkers = workers or _workers_from_env()
         if specs:
@@ -571,8 +582,10 @@ class CompileService:
                 "lazily: %s", self.app.name, len(errors), errors[:3])
         wall = time.perf_counter() - t0
         after = cache_counts()
+        n_sharded = sum(1 for r in records if r.get("sharded"))
         result = {
             "programs": len(records),
+            "sharded_programs": n_sharded,
             "seconds": round(wall, 3),
             "compile_ms": round(wall * 1e3, 1),
             "cache_hits": after["hits"] - before["hits"],
@@ -586,6 +599,7 @@ class CompileService:
         with self._lock:
             self.warmups += 1
             self.programs += result["programs"]
+            self.sharded_programs += n_sharded
             self.total_ms += result["compile_ms"]
             self.cache_hits += result["cache_hits"]
             self.cache_misses += result["cache_misses"]
@@ -597,6 +611,7 @@ class CompileService:
             out = {
                 "warmups": self.warmups,
                 "programs": self.programs,
+                "sharded_programs": self.sharded_programs,
                 "compile_ms": round(self.total_ms, 1),
                 "cache_hits": self.cache_hits,
                 "cache_misses": self.cache_misses,

@@ -19,11 +19,14 @@ class SiddhiManager:
         self.persistence_store = None
         self.error_store = None
 
-    def create_siddhi_app_runtime(self, source,
-                                  partition_mesh=None) -> SiddhiAppRuntime:
-        """partition_mesh: optional jax.sharding.Mesh — partition blocks
-        then shard their key-slot axis over its first axis (multi-chip
-        key-partitioned execution, parallel/partition.py)."""
+    def create_siddhi_app_runtime(self, source, partition_mesh=None,
+                                  mesh=None) -> SiddhiAppRuntime:
+        """mesh: optional jax.sharding.Mesh — partition blocks then
+        shard their key-slot axis over its first axis via the regex
+        rule table (multi-chip key-partitioned execution,
+        parallel/partition.py + parallel/sharding.py), and the runtime
+        reports per-device placement in statistics()['mesh'].
+        ``partition_mesh`` is the pre-PR-12 name, kept as an alias."""
         if isinstance(source, str):
             app_ast = parse(source)
         elif isinstance(source, A.SiddhiApp):
@@ -31,7 +34,8 @@ class SiddhiManager:
         else:
             raise TypeError("expected SiddhiQL text or SiddhiApp")
         rt = SiddhiAppRuntime(app_ast, manager=self,
-                              partition_mesh=partition_mesh)
+                              partition_mesh=partition_mesh
+                              if partition_mesh is not None else mesh)
         self.app_runtimes[rt.name] = rt
         return rt
 

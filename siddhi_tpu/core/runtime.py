@@ -1638,8 +1638,11 @@ class SiddhiAppRuntime:
         self.aggregations: dict = {}  # id -> AggregationRuntime
         self.partitions: dict = {}  # name -> PartitionBlockRuntime
         # jax.sharding.Mesh: when set, partition blocks shard their key-slot
-        # axis over the mesh's first axis (see parallel/partition.py)
+        # axis over the mesh's first axis via the PARTITION_STATE_RULES
+        # regex table (parallel/partition.py + parallel/sharding.py);
+        # `mesh` is the forward-facing name, partition_mesh the original
         self.partition_mesh = partition_mesh
+        self.mesh = partition_mesh
         self.running = False
         self._playback = False
         self._playback_time: Optional[int] = None
@@ -2105,6 +2108,26 @@ class SiddhiAppRuntime:
         flat[f"{p}.scheduler.pending"] = self.scheduler.pending()
         flat[f"{p}.scheduler.lag_ms"] = \
             self.scheduler.lag_ms(self.current_time())
+        # mesh placement (multi-chip partition execution): which devices
+        # carry how many key slots, as a `device=` labeled gauge family
+        if self.mesh is not None and self.partitions:
+            axis = self.mesh.axis_names[0]
+            n = int(self.mesh.shape[axis])
+            slots_per_dev = [0] * n
+            mesh_rep = {"axis": axis, "n_devices": n, "partitions": {}}
+            for name, blk in self.partitions.items():
+                mesh_rep["partitions"][name] = {
+                    "slots": blk.K, "slots_per_device": blk.K // n}
+                for d in range(n):
+                    slots_per_dev[d] += blk.K // n
+            for d in range(n):
+                self.metrics.labeled_gauge(
+                    f"{p}.mesh.slots_placed", {"device": str(d)},
+                    dotted=f"{p}.mesh.device.{d}.slots_placed",
+                    help="partition key slots placed on one mesh "
+                    "device").set(slots_per_dev[d])
+            report["mesh"] = mesh_rep
+            flat[f"{p}.mesh.n_devices"] = n
         # AOT compile telemetry (only once a warmup ran): program count,
         # compile wall ms, persistent-cache hits/misses; DETAIL level
         # adds the per-step timing list (view only)

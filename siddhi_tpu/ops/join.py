@@ -204,6 +204,31 @@ def analyze_equi_join(on: A.Expression, side_scope: Scope):
     return None, None
 
 
+def equi_route_columns(on: A.Expression, side_scope: Scope):
+    """``{'L': col_idx, 'R': col_idx}`` when the first top-level
+    ``==`` conjunct compares BARE attribute references on both sides —
+    the mesh router's key columns (parallel/mesh.py): hash-routing both
+    streams by this column puts every band (and therefore every joined
+    pair — key equality is the band) wholly on its owning shard, so the
+    sorted pools stay device-local and shard outputs union to the
+    single-chip replay. ``None`` when the band key is an expression
+    (routable only by materializing it host-side first)."""
+    for c in _flatten_and(on):
+        if not isinstance(c, A.Compare) or c.op != "==":
+            continue
+        if not (isinstance(c.left, A.Variable)
+                and isinstance(c.right, A.Variable)):
+            continue
+        try:
+            (ltag, lidx), _lt = side_scope.resolve(c.left)
+            (rtag, ridx), _rt = side_scope.resolve(c.right)
+        except CompileError:
+            continue
+        if {ltag, rtag} == {"L", "R"}:
+            return {ltag: lidx, rtag: ridx}
+    return None
+
+
 class JoinCross:
     """One trigger direction of a join: cross the trigger side's
     window-output batch with the opposite window buffer."""
@@ -236,10 +261,15 @@ class JoinCross:
         self.equi: Optional[EquiKey] = None
         self.residual: Optional[CompiledExpr] = None
         self.kernel = "grid"   # planner sets "probe" (core/runtime.py)
+        # mesh routing key: the band key's bare column indices per side
+        # (None when the band key is an expression) — parallel/mesh.py
+        # derives route_cols="auto" from this
+        self.route_cols = None
         if on is not None:
             self.cond = compile_expression(on, side_scope)
             if self.cond.type is not AttrType.BOOL:
                 raise CompileError("join ON condition must be BOOL")
+            self.route_cols = equi_route_columns(on, side_scope)
             equi, residual_ast = analyze_equi_join(on, side_scope)
             if equi is not None:
                 self.equi = equi

@@ -1,0 +1,15 @@
+"""Multi-chip execution: partitioned key-slot blocks
+(parallel/partition.py), regex partition-rule sharding tables
+(parallel/sharding.py), and measured data-parallel mesh execution
+(parallel/mesh.py)."""
+from .sharding import (  # noqa: F401
+    DATA_PARALLEL_RULES,
+    PARTITION_STATE_RULES,
+    POOL_STATE_RULES,
+    REPLICATE,
+    SHARD,
+    build_mesh,
+    match_partition_rules,
+    placement_stats,
+    shard_pytree,
+)

@@ -292,6 +292,9 @@ class PartitionBlockRuntime:
             getattr(op, "sort_heavy", False)
             for p in plans for op in p.operators) else None
         if mesh is not None:
+            from . import sharding as _sharding
+            _sharding.check_divisible(self.K, mesh,
+                                      f"partition '{name}' slots")
             self._apply_mesh_sharding()
 
     # -- state layout -----------------------------------------------------
@@ -304,24 +307,26 @@ class PartitionBlockRuntime:
                           dtype=jnp.asarray(x).dtype),
             state)
 
-    def _apply_mesh_sharding(self):
-        """Place the [K]-leading state arrays sharded over the mesh's first
-        axis; XLA then partitions the slot-vmap across devices (each device
-        owns K/n key slots — GSPMD routing, see module docstring)."""
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        axis = self.mesh.axis_names[0]
-        n = self.mesh.shape[axis]
-        if self.K % n:
-            raise ValueError(
-                f"partition slots ({self.K}) must divide evenly over mesh "
-                f"axis '{axis}' ({n} devices)")
-
-        def shard(x):
-            spec = P(axis, *([None] * (x.ndim - 1)))
-            return jax.device_put(x, NamedSharding(self.mesh, spec))
-
-        self.qstates = {qn: jax.tree_util.tree_map(shard, st)
-                        for qn, st in self.qstates.items()}
+    def _apply_mesh_sharding(self, qstates=None, slot_tbl=None):
+        """Place the block state per the regex rule table
+        (parallel/sharding.py PARTITION_STATE_RULES): [K]-leading
+        qstates shard over the mesh's first axis — XLA then partitions
+        the slot-vmap across devices (each device owns K/n key slots —
+        GSPMD routing, see module docstring) — and the key-slot table
+        replicates. Accepts HOST pytrees (restore): a sharded
+        `device_put` of a numpy leaf is ONE placement that never
+        aliases the payload, so restore re-places shards directly
+        instead of a fresh-copy-then-re-place double transfer
+        (`shard_pytree` also skips already-placed leaves — redundant
+        calls transfer nothing; tests/test_mesh.py counts both)."""
+        from . import sharding
+        placed = sharding.shard_pytree(
+            {"qstates": qstates if qstates is not None else self.qstates,
+             "slot_tbl": slot_tbl if slot_tbl is not None
+             else self.slot_tbl},
+            self.mesh, sharding.PARTITION_STATE_RULES)
+        self.qstates = placed["qstates"]
+        self.slot_tbl = placed["slot_tbl"]
 
     # -- key computation --------------------------------------------------
     def _slots_for(self, spec, batch: EventBatch, now, slot_tbl):
@@ -564,11 +569,22 @@ class PartitionBlockRuntime:
     def restore_state(self, snap: dict) -> None:
         from ..core.runtime import _fresh_device
         with self._lock:
-            # snapshot payloads are host numpy; device_put may alias them
-            # zero-copy, so every restore routes through _fresh_device
-            # before the state re-enters a step (core/runtime.py)
-            self.slot_tbl = _fresh_device(snap["slot_tbl"])
-            self.qstates = _fresh_device(snap["qstates"])
+            if self.mesh is not None:
+                # restore RE-PLACES shards straight from the host
+                # snapshot: ONE sharded device_put per leaf (fresh
+                # buffers by construction — a sharded put never aliases
+                # the numpy payload, so the _fresh_device donation
+                # guard is subsumed), never a fresh single-device copy
+                # that a second pass then re-places
+                self._apply_mesh_sharding(qstates=snap["qstates"],
+                                          slot_tbl=snap["slot_tbl"])
+            else:
+                # snapshot payloads are host numpy; device_put may
+                # alias them zero-copy, so single-device restores route
+                # through _fresh_device before the state re-enters a
+                # donated step (core/runtime.py)
+                self.slot_tbl = _fresh_device(snap["slot_tbl"])
+                self.qstates = _fresh_device(snap["qstates"])
             self._emitted = {k: jnp.array(v, copy=True)
                              for k, v in snap["emitted"].items()}
             self._lost = {k: jnp.array(v, copy=True)
@@ -579,8 +595,6 @@ class PartitionBlockRuntime:
                 port = self.ports.get(qn)
                 if port is not None and port.rate_limiter is not None:
                     port.rate_limiter.restore_state(rsnap)
-            if self.mesh is not None:
-                self._apply_mesh_sharding()
 
     def reschedule(self) -> None:
         """Re-arm per-query timers from restored [K]-stacked states."""

@@ -112,7 +112,13 @@ class TenantPool:
                  state_quota_bytes: Optional[int] = None,
                  batch_max: Optional[int] = None,
                  pending_cap: int = _DEFAULT_PENDING_CAP,
-                 slo: Optional[dict] = None):
+                 slo: Optional[dict] = None,
+                 mesh=None):
+        """``mesh``: optional ``jax.sharding.Mesh`` — the tenant slot
+        axis then shards over its first axis (1/n of the slots per
+        device, parallel/sharding.py POOL_STATE_RULES), ingest rounds
+        place the stacked batch the same way, and admission control
+        accounts per-device slot budgets (docs/serving.md)."""
         from ..core.manager import SiddhiManager
         from ..obs.metrics import MetricsRegistry
         self.template = template
@@ -150,13 +156,34 @@ class TenantPool:
         self.batch_max = bucket_capacity(int(batch_max))
         self.pending_cap = int(pending_cap)
 
-        self.slots = _pow2(max(1, min(int(slots), self.max_tenants)))
-        self._slot_cap = _pow2(self.max_tenants)
+        # -- mesh (slot-axis sharding over devices) -----------------------
+        self.mesh = mesh
+        if mesh is not None:
+            from ..parallel import sharding as _sh
+            self.mesh_axis = mesh.axis_names[0]
+            self.n_devices = int(mesh.shape[self.mesh_axis])
+            self._sharding = _sh
+        else:
+            self.mesh_axis = None
+            self.n_devices = 1
+            self._sharding = None
+        self.slots = _pow2(max(1, self.n_devices,
+                               min(int(slots), self.max_tenants)))
+        self._slot_cap = max(_pow2(self.max_tenants), self.n_devices)
+        if mesh is not None:
+            # pow2 slot axes divide pow2 meshes; anything else is a
+            # config error, caught at pool build not first dispatch
+            self._sharding.check_divisible(self.slots, mesh,
+                                           f"pool '{self.name}' slots")
         # stacked per-query state: leading axis = tenant slot
         self._states = {qn: self._stack_init(qn, self.slots)
                         for qn in self._order}
         self._emitted = {qn: jnp.zeros((self.slots,), jnp.int64)
                          for qn in self._order}
+        self._rows_per_device = [0] * self.n_devices
+        self._collect_ms_per_device = [0.0] * self.n_devices
+        if mesh is not None:
+            self._place_state()   # initial slot-axis placement
         # per-tenant state bytes (quota accounting): one slot's slice of
         # every query state plus its emitted counter
         self.state_bytes_per_tenant = 8 * len(self._order) + sum(
@@ -296,6 +323,59 @@ class TenantPool:
     def ingest_stream(self) -> str:
         return self._ingest_streams[0]
 
+    # -- mesh placement (parallel/sharding.py) ----------------------------
+
+    @property
+    def slots_per_device(self) -> int:
+        return self.slots // self.n_devices
+
+    def _device_of_slot(self, slot: int) -> int:
+        return slot // self.slots_per_device if self.mesh is not None \
+            else 0
+
+    def _place_state(self) -> None:
+        """Shard the stacked tenant states over the mesh's slot axis.
+        Runs ONLY on initial build and slot-axis growth (the two events
+        that change layout); `shard_pytree` skips leaves that are
+        already placed, so even a redundant call transfers nothing
+        (the dedupe contract, tests/test_mesh.py counts it)."""
+        placed = self._sharding.shard_pytree(
+            {"states": self._states, "emitted": self._emitted},
+            self.mesh, self._sharding.POOL_STATE_RULES,
+            axis=self.mesh_axis)
+        self._states = placed["states"]
+        self._emitted = placed["emitted"]
+
+    def _place_batch(self, batch):
+        """Stacked (slots, cap) round batch -> device(s): sharded over
+        the slot axis on a mesh (each device receives ONLY its tenants'
+        rows — one transfer either way)."""
+        if self.mesh is None:
+            return jax.device_put(batch)
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.device_put(batch, NamedSharding(
+            self.mesh, PartitionSpec(self.mesh_axis)))
+
+    def _device_loads_locked(self) -> list:
+        """Tenants currently placed per device (host-side bookkeeping;
+        caller holds the lock)."""
+        loads = [0] * self.n_devices
+        for slot in self._tenants.values():
+            loads[self._device_of_slot(slot)] += 1
+        return loads
+
+    def _pick_slot(self) -> int:
+        """Pop a free slot, mesh-aware: choose the slot on the device
+        with the fewest placed tenants so the vmapped work stays
+        balanced across the mesh (single-device pools keep LIFO order)."""
+        if self.mesh is None:
+            return self._free.pop()
+        loads = self._device_loads_locked()
+        best = min(range(len(self._free)),
+                   key=lambda i: (loads[self._device_of_slot(
+                       self._free[i])], -self._free[i]))
+        return self._free.pop(best)
+
     # -- state stacking ---------------------------------------------------
 
     def _stack_init(self, qname: str, slots: int):
@@ -338,10 +418,24 @@ class TenantPool:
 
     def _admit_check(self) -> tuple[bool, str, str]:
         """(ok, human reason, machine cause) — the cause slug rides the
-        429's ``saturation`` payload (docs/serving.md)."""
+        429's ``saturation`` payload (docs/serving.md). On a mesh the
+        slot budget is accounted PER DEVICE: max_tenants splits evenly
+        over the mesh, and admission rejects when every device's budget
+        is spent (balanced placement makes this coincide with the
+        global cap; an unbalanced restore surfaces here instead of
+        overloading one device)."""
         if len(self._tenants) >= self.max_tenants:
             return False, (f"pool '{self.name}' tenant slots exhausted "
                            f"(cap {self.max_tenants})"), "slots-exhausted"
+        if self.mesh is not None:
+            budget = -(-self.max_tenants // self.n_devices)  # ceil
+            loads = self._device_loads_locked()
+            if min(loads) >= budget:
+                return False, (
+                    f"pool '{self.name}' per-device slot budgets "
+                    f"exhausted ({budget} tenants/device x "
+                    f"{self.n_devices} devices, placed {loads})"), \
+                    "slots-exhausted"
         if self.state_quota_bytes is not None:
             need = (len(self._tenants) + 1) * self.state_bytes_per_tenant
             if need > self.state_quota_bytes:
@@ -431,7 +525,7 @@ class TenantPool:
                                            dict(bindings or {}))
             if not self._free:
                 self._grow()
-            slot = self._free.pop()
+            slot = self._pick_slot()
             for qn in self._order:
                 init = self._tenant_init_states(qn, vals)
                 self._states[qn] = jax.tree_util.tree_map(
@@ -480,6 +574,11 @@ class TenantPool:
         self._emitted = {qn: pad(e) for qn, e in self._emitted.items()}
         self._free.extend(range(new_slots - 1, self.slots - 1, -1))
         self.slots = new_slots
+        if self.mesh is not None:
+            # slot-axis growth is one of the two re-placement events
+            # (the other is restore): the concatenated arrays come back
+            # sharded over the NEW width in one placement pass
+            self._place_state()
         self._vsteps.clear()
         self._grows += 1
         self._warmed = False
@@ -593,6 +692,12 @@ class TenantPool:
                 self._last_pump_wall = time.perf_counter()
                 return 0
             self._now = max(self._now, last_ts)
+            if self.mesh is not None:
+                # per-device ingest attribution (host counters only;
+                # the `device=` labeled gauge family)
+                for slot, (ts_a, _c) in per_slot.items():
+                    self._rows_per_device[
+                        self._device_of_slot(slot)] += len(ts_a)
             cap = bucket_capacity(
                 max(len(r[0]) for r in per_slot.values()))
             batch = self._stacked_batch(per_slot, cap)
@@ -672,7 +777,7 @@ class TenantPool:
             ts=ts, cols=tuple(cols),
             nulls=tuple(np.zeros((N, cap), np.bool_) for _ in cols),
             kind=kind, valid=valid)
-        return jax.device_put(batch)
+        return self._place_batch(batch)
 
     def _vstep_for(self, qname: str, cap: int) -> Callable:
         # warm_specs builders run on compile-pool threads; the lock keeps
@@ -825,6 +930,20 @@ class TenantPool:
                                         for _ in schema.types),
                             kind=jnp.zeros((N, cap), jnp.int32),
                             valid=jnp.zeros((N, cap), jnp.bool_))
+                        if self.mesh is not None:
+                            # warm SHARDED programs: the example args
+                            # must carry the runtime placement or the
+                            # AOT compile lands on a different (and
+                            # never-dispatched) single-device program
+                            placed = self._sharding.shard_pytree(
+                                {"states": {qname: states},
+                                 "emitted": {qname: emitted}},
+                                self.mesh,
+                                self._sharding.POOL_STATE_RULES,
+                                axis=self.mesh_axis)
+                            states = placed["states"][qname]
+                            emitted = placed["emitted"][qname]
+                            batch = self._place_batch(batch)
                         return fn, (states, emitted, batch,
                                     jnp.asarray(0, jnp.int64))
                     specs.append(CompileSpec(
@@ -929,15 +1048,43 @@ class TenantPool:
         plus the pool's saturation signals."""
         return self.slo_engine.evaluate(saturation=self.saturation())
 
+    def _collect_sharded_locked(self) -> dict:
+        """Mesh pools collect with ONE read PER DEVICE: each device's
+        addressable shard of the stacked emitted counters is fetched
+        directly (no cross-device gather ever materializes on the
+        mesh), timed per device for the ``collect_ms{device=}`` gauge
+        family. Caller holds the pool lock."""
+        dev_pos = {d.id: i for i, d in
+                   enumerate(self.mesh.devices.flat)}
+        times = [0.0] * self.n_devices
+        emitted = {}
+        for qn in self._order:
+            arr = self._emitted[qn]
+            out = np.zeros(arr.shape, np.int64)
+            for sh in arr.addressable_shards:   # one read per device
+                t0 = time.perf_counter()
+                data = np.asarray(sh.data)
+                times[dev_pos.get(sh.device.id, 0)] += \
+                    time.perf_counter() - t0
+                out[sh.index] = data
+            emitted[qn] = out
+        self._collect_ms_per_device = [round(t * 1000.0, 3)
+                                       for t in times]
+        return {"emitted": emitted}
+
     def _collect_observability(self) -> tuple[dict, dict]:
         """ONE walk shared by statistics() and the registry collector.
         Device reads are O(templates), not O(tenants): the stacked
-        emitted counters come back in a single device_get per pool; the
+        emitted counters come back in a single device_get per pool
+        (per DEVICE on a mesh — `_collect_sharded_locked`); the
         per-tenant fan-out below is pure host-side numpy indexing (the
         SLO windows are host-side too — tracking ON adds zero device
         reads here; tests/test_slo.py monkeypatch-counts this)."""
         with self._lock:
-            host = jax.device_get({"emitted": self._emitted})
+            if self.mesh is not None:
+                host = self._collect_sharded_locked()
+            else:
+                host = jax.device_get({"emitted": self._emitted})
             tenants = dict(self._tenants)
             pending = dict(self._pending_rows)
             errors = dict(self._error_counts)
@@ -950,6 +1097,23 @@ class TenantPool:
                 "state_bytes_per_tenant": self.state_bytes_per_tenant,
             }
             saturation = self._saturation_locked()
+            mesh_info = None
+            if self.mesh is not None:
+                loads = self._device_loads_locked()
+                mesh_info = {
+                    "axis": self.mesh_axis,
+                    "n_devices": self.n_devices,
+                    "slots_per_device": self.slots_per_device,
+                    "per_device": {
+                        str(d): {
+                            "slots_placed": loads[d],
+                            "slot_budget":
+                                -(-self.max_tenants // self.n_devices),
+                            "rows_ingested": self._rows_per_device[d],
+                            "collect_ms":
+                                self._collect_ms_per_device[d],
+                        } for d in range(self.n_devices)},
+                }
         p = f"siddhi.{self.name}"
         flat: dict = {}
         report: dict = {"pool": pool_stats, "tenants": {}}
@@ -990,6 +1154,26 @@ class TenantPool:
             self.metrics.prune_family(fam, dotted)
         for k, v in pool_stats.items():
             flat[f"{p}.pool.{k}"] = v
+        if mesh_info is not None:
+            # per-device labeled gauge FAMILIES (`device=` label — the
+            # cardinality-safe shape, docs/observability.md): slots
+            # placed, rows ingested, per-device collection read time
+            report["mesh"] = mesh_info
+            flat[f"{p}.mesh.n_devices"] = mesh_info["n_devices"]
+            flat[f"{p}.mesh.slots_per_device"] = \
+                mesh_info["slots_per_device"]
+            fam_help = {
+                "slots_placed": "tenants placed on one mesh device",
+                "rows_ingested": "rows dispatched to one mesh device",
+                "collect_ms": "stats shard-read time for one device",
+            }
+            for d, entry in mesh_info["per_device"].items():
+                for key in ("slots_placed", "rows_ingested",
+                            "collect_ms"):
+                    self.metrics.labeled_gauge(
+                        f"{p}.mesh.{key}", {"device": d},
+                        dotted=f"{p}.mesh.device.{d}.{key}",
+                        help=fam_help[key]).set(entry[key])
         # SLO + saturation (obs/slo.py): host-side windows, labeled
         # p99/burn/state families, machine-readable pressure signals
         report["slo"] = self.slo_engine.evaluate(saturation=saturation)

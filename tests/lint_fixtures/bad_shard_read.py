@@ -1,0 +1,57 @@
+"""Fixture: cross-shard-transfer-hazard — per-iteration device reads of
+slot-axis state (sharded over a mesh) vs the blessed one-read-per-device
+and one-pytree-transfer collection paths."""
+import jax
+import numpy as np
+
+
+def bad_per_tenant_read(self):
+    # one gather across the mesh PER TENANT: O(tenants) interconnect
+    # round trips instead of one collection pass
+    out = {}
+    for qn in self._order:
+        out[qn] = jax.device_get(self._states[qn])
+    return out
+
+
+def bad_asarray_slot_loop(self, slots):
+    totals = []
+    for slot in slots:
+        totals.append(np.asarray(self._emitted["q"][slot]))
+    return totals
+
+
+def bad_qstates_while(self):
+    while self.running:
+        jax.device_get(self.qstates)
+
+
+def fine_batched_read(self):
+    # ONE pytree transfer outside any loop: the sanctioned shape
+    host = jax.device_get({"emitted": self._emitted,
+                           "states": self._states})
+    for qn, v in host["emitted"].items():
+        pass
+    return host
+
+
+def fine_per_device_shards(self, arr):
+    # per-DEVICE shard enumeration IS the batched path (serving/pool.py
+    # _collect_sharded_locked): one read per device, no cross-device
+    # gather
+    parts = []
+    for sh in arr.addressable_shards:
+        parts.append(np.asarray(sh.data))
+    return parts
+
+
+def fine_shard_read_mentioning_state(self):
+    # addressable_shards access that references the state name directly
+    # is still the blessed per-device path
+    for sh in self._emitted["q"].addressable_shards:
+        pass
+
+
+def suppressed_read(self, slots):
+    for slot in slots:
+        jax.device_get(self._states["q"])  # lint: disable=cross-shard-transfer-hazard

@@ -124,6 +124,30 @@ def test_bench_join_quick_parses_frontier_and_breakdown():
         assert row["events_per_s"] > 0
 
 
+def test_bench_multichip_quick_parses():
+    """Mesh scale-out config (ROADMAP item 1): the forced-8-device CPU
+    shim child must emit {n_devices, eps_aggregate, eps_per_device,
+    scaling_efficiency} per arm — guards the rc=124/empty-tail class
+    before hardware rounds. Scaling VALUES are not asserted: on a
+    shared-core host the shim cannot scale (host_device_shim marks it);
+    the >=6x acceptance is read off the TPU-hardware MULTICHIP round."""
+    d = _run_config("multichip")
+    assert d["unit"] == "events/s"
+    assert d["n_devices"] == 8
+    assert d["host_device_shim"] in (True, False)
+    assert set(d["arms"]) == {"filter", "seq5", "tenants"}
+    for arm, entry in d["arms"].items():
+        assert entry["n_devices"] == 8, (arm, entry)
+        assert entry["eps_aggregate"] > 0
+        assert entry["eps_per_device"] > 0
+        assert abs(entry["eps_per_device"] * 8
+                   - entry["eps_aggregate"]) < 1.0
+        assert entry["eps_1dev"] > 0
+        assert entry["scaling_efficiency"] > 0
+    assert d["value"] == d["arms"]["filter"]["eps_aggregate"]
+    assert d["arms"]["tenants"]["tenants"] > 0
+
+
 def test_bench_tenants_quick_parses():
     """Multi-tenant serving config (ROADMAP item 2): pooled vs separate
     aggregate events/s with ONE compile-service program set per

@@ -55,6 +55,23 @@ def test_host_sync_in_loop_covers_metric_recording_paths():
     assert all(f.line <= 39 for f in fs)
 
 
+def test_cross_shard_transfer_hazard():
+    """Per-iteration device reads of slot-axis state (qstates /
+    _states / _emitted / slot_tbl — sharded over a mesh by the
+    parallel/sharding.py rule tables) fire; the batched one-pytree
+    transfer, the per-device addressable_shards read (serving/pool.py
+    _collect_sharded_locked), and pragma'd sites stay clean."""
+    fs = findings_for("bad_shard_read.py")
+    assert lines_of(fs, "cross-shard-transfer-hazard") == [13, 20, 26]
+    f = [x for x in fs if x.rule == "cross-shard-transfer-hazard"][0]
+    assert f.severity == "warning"
+    assert "addressable_shards" in f.message
+
+
+def test_cross_shard_transfer_hazard_registered():
+    assert "cross-shard-transfer-hazard" in rule_names()
+
+
 def test_quadratic_grid_hazard_fires_once_per_expression():
     """[B,W]-style cross products ([:, None] against [None, :]) fire
     once per outermost expression; single-axis broadcasts, the

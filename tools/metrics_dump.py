@@ -59,6 +59,20 @@ def filter_tenant(text: str, tenant: str) -> str:
         if label_marker in ln or dotted_marker in ln)
 
 
+def filter_device(text: str, device: str) -> str:
+    """Keep only the scrape lines for one mesh device: samples of the
+    ``device=`` labeled families (pool/runtime mesh gauges —
+    docs/observability.md "label conventions") plus any legacy dotted
+    ``...device_<n>_...`` names. The mesh-placement view of one device
+    without the other seven's noise."""
+    from siddhi_tpu.obs.metrics import prom_name
+    label_marker = f'device="{device}"'
+    dotted_marker = prom_name(f"device.{device}.")
+    return "".join(
+        ln + "\n" for ln in text.splitlines()
+        if label_marker in ln or dotted_marker in ln)
+
+
 def _synthetic_traffic(rt, n: int) -> bool:
     """Push n ramp events into the app's first stream when its schema is
     all-numeric; returns True when traffic was sent."""
@@ -118,6 +132,10 @@ def main(argv=None) -> int:
                     help="deploy the app as a tenant template through "
                     "the multi-tenant front door and print only this "
                     "tenant's siddhi.<pool>.tenant.<ID>.* samples")
+    ap.add_argument("--device", metavar="N",
+                    help="print only the mesh samples labeled "
+                    'device="N" (per-device slots/rows/collect gauges '
+                    "of sharded pools and partitions)")
     args = ap.parse_args(argv)
 
     from siddhi_tpu.core.service import SiddhiService
@@ -165,6 +183,8 @@ def main(argv=None) -> int:
         svc.stop()
     if args.tenant is not None:
         text = filter_tenant(text, args.tenant)
+    if args.device is not None:
+        text = filter_device(text, args.device)
     sys.stdout.write(text)
     return 0 if "siddhi_" in text else 1
 
