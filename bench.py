@@ -145,6 +145,20 @@ def _metrics_snapshot(rt):
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _plan_block(rt_or_pool):
+    """Plan-explain block for the per-config JSON line (BENCH_r06+):
+    {plan_hash, decisions} so the artifact records WHAT was measured —
+    which queries fused, which join kernel ran and why, which window
+    compaction variant was active — not just how fast it went
+    (obs/explain.py; tools/bench_diff.py gates on the hash)."""
+    try:
+        rep = rt_or_pool.explain(live=False)
+        return {"plan_hash": rep["plan_hash"],
+                "decisions": rep["decisions"]}
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail a run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _stage_breakdown(rt, send):
     """Per-step cost attribution (obs/costmodel.py), run AFTER the timed
     reps — every sampled chunk serializes the pipeline, so it must never
@@ -319,9 +333,10 @@ def bench_filter(n=1_000_000):
         h.send_arrays(ts[:8192], [sym[:8192], price[:8192], vol[:8192]]),
         _drain(outs)))
     met = _metrics_snapshot(rt)
+    plan = _plan_block(rt)
     rt.shutdown()
     extra = {"ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
-             "stage_breakdown": sb, **cinfo}
+             "plan": plan, "stage_breakdown": sb, **cinfo}
     if dis is not None:
         extra["disorder"] = dis
     return _entry("filter", n, dt, extra=extra)
@@ -372,6 +387,7 @@ def _run_chain3(n: int, fused: bool):
                                           price[:8192]]),
                 outs.drain()))
         cinfo["metrics"] = _metrics_snapshot(rt)
+        cinfo["plan"] = _plan_block(rt)
         rt.shutdown()
         return dt, ttfr, cinfo
     finally:
@@ -444,8 +460,10 @@ def _run_tenant_pool(n_tenants: int, rows: int, batch_max: int):
     dt = min(_timed(one_pass) for _ in range(REPS))
     stats = pool.statistics()
     comp = stats["compile"]
+    plan = _plan_block(pool)
     pool.shutdown()
     return {
+        "plan": plan,
         "eps": round(n_tenants * rows / dt, 1),
         "seconds": round(dt, 3),
         "compile_ms": wu["compile_ms"],
@@ -568,10 +586,14 @@ def bench_tenants():
     rows = _scaled(2048, batch_max)
     sep = _run_tenant_separate(min(sep_n, min(n_list)), rows)
     per_n = {}
+    plan = None
     for n in n_list:
         pooled = _run_tenant_pool(n, rows, batch_max)
         assert pooled["program_sets"] == 1 and \
             pooled["pool_warmups"] == 1, pooled
+        # ONE template plan regardless of N (pools of one template
+        # share the plan_hash — slot counts are live facts, not plan)
+        plan = pooled.get("plan") or plan
         per_n[n] = {
             "eps_pooled": pooled["eps"],
             # flat extrapolation of the measured separate-runtimes
@@ -599,6 +621,7 @@ def bench_tenants():
         "compile_ms": head["compile_ms"],
         "separate": sep,
         "tenants": {str(n): per_n[n] for n in n_list},
+        "plan": plan,
         "slo": slo_arm,
     }
 
@@ -634,10 +657,11 @@ def bench_window_agg(n=1_000_000):
         h.send_arrays(ts[:8192], [sym[:8192], price[:8192], vol[:8192]]),
         _drain(outs)))
     met = _metrics_snapshot(rt)
+    plan = _plan_block(rt)
     rt.shutdown()
     return _entry("window_agg", n, dt, extra={
         "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
-        "stage_breakdown": sb, **cinfo})
+        "plan": plan, "stage_breakdown": sb, **cinfo})
 
 
 def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int,
@@ -742,6 +766,7 @@ def _run_join_inner(n_symbols, chunk, join_pairs, n_side, frontier):
         cinfo["stage_breakdown"] = _stage_breakdown(
             rt, lambda: send_pair(2048))
     cinfo["metrics"] = _metrics_snapshot(rt)
+    cinfo["plan"] = _plan_block(rt)
     # which kernel actually ran (grid vs banded probe) + the planner's
     # reason — the acceptance artifact must name it
     kernels = rt.statistics().get("compile", {}).get("join_kernels", {})
@@ -854,10 +879,11 @@ def bench_seq2(n=262_144, chunk=65_536):
     sb = _stage_breakdown(rt, lambda: (send(2 + REPS * n_chunks, chunk),
                                        _drain(outs)))
     met = _metrics_snapshot(rt)
+    plan = _plan_block(rt)
     rt.shutdown()
     return _entry("seq2", 2 * n_chunks * chunk, dt, extra={
         "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
-        "stage_breakdown": sb, **cinfo})
+        "plan": plan, "stage_breakdown": sb, **cinfo})
 
 
 def bench_kleene(n=262_144, chunk=65_536):
@@ -905,10 +931,11 @@ def bench_kleene(n=262_144, chunk=65_536):
     sb = _stage_breakdown(rt, lambda: (send(2 + REPS * n_chunks, chunk),
                                        _drain(outs)))
     met = _metrics_snapshot(rt)
+    plan = _plan_block(rt)
     rt.shutdown()
     return _entry("kleene", 2 * n_chunks * chunk, dt, extra={
         "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
-        "stage_breakdown": sb, **cinfo})
+        "plan": plan, "stage_breakdown": sb, **cinfo})
 
 
 SEQ5_APP = """
@@ -1018,12 +1045,13 @@ def bench_seq5(n=1_048_576, chunk=65_536):
     sb = _stage_breakdown(rt, lambda: (h.send_arrays(*mk(chunk)),
                                        _drain(outs)))
     met = _metrics_snapshot(rt)
+    plan = _plan_block(rt)
     rt.shutdown()
     lat_ms = np.array(lat) * 1000.0
     lat1k_ms = np.array(lat1k) * 1000.0
     return _entry("seq5", n_chunks * chunk, dt, extra={
         **({"disorder": dis} if dis is not None else {}),
-        "metrics": met,
+        "metrics": met, "plan": plan,
         "frontier": fr, "stage_breakdown": sb,
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),

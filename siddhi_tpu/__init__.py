@@ -42,6 +42,7 @@ from .core.persistence import (  # noqa: E402
 from .core.stream import Event, QueryCallback, StreamCallback  # noqa: E402
 from .core.types import AttrType  # noqa: E402
 from .lang import parser as compiler  # noqa: E402
+from .obs.explain import ExplainReport, explain_diff  # noqa: E402
 from .lang.parser import (  # noqa: E402
     parse,
     parse_expression,
@@ -66,6 +67,8 @@ from .serving import (  # noqa: E402
 __all__ = [
     "AdmissionError",
     "AttrType",
+    "ExplainReport",
+    "explain_diff",
     "CheckpointSupervisor",
     "ErrorStore",
     "ErroredEvent",

@@ -478,3 +478,51 @@ def float64_literal(ctx: ModuleContext) -> Iterator[Finding]:
                     f"dtype=float64 in {'.'.join(c)}(...) triggers x64 "
                     "promotion — use float32 (or gate behind an explicit "
                     "x64 config) on TPU")
+
+
+# ---------------------------------------------------------------------
+# rule: bare-gauge-family
+# ---------------------------------------------------------------------
+
+
+@register(
+    "bare-gauge-family", WARNING,
+    "a labeled gauge family registered without a HELP string scrapes as "
+    "an undocumented metric; pass help= to labeled_gauge (or describe() "
+    "the family) so /metrics stays self-documenting")
+def bare_gauge_family(ctx: ModuleContext) -> Iterator[Finding]:
+    """Every ``labeled_gauge(family, labels, ...)`` call must carry a
+    ``# HELP`` string: either the ``help=`` keyword (4th positional
+    works too) or a ``describe(<same family literal>, ...)`` call in
+    the same module. Labeled families are the cardinality-safe
+    exposition shape (docs/observability.md "label conventions") —
+    a family with no HELP line is a metric nobody can interpret from
+    a scrape, which defeats the explain/metrics self-documentation
+    contract. Plain ``gauge()`` instruments are exempt: collector-fed
+    dotted gauges are documented by the statistics() schema."""
+    described: set = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "describe" and node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+                described.add(a0.value)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute) \
+                or node.func.attr != "labeled_gauge":
+            continue
+        if len(node.args) >= 4:           # positional help=
+            continue
+        if any(kw.arg == "help" for kw in node.keywords):
+            continue
+        a0 = node.args[0] if node.args else None
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str) \
+                and a0.value in described:
+            continue                       # family described() nearby
+        yield _finding(
+            "bare-gauge-family", WARNING, ctx, node,
+            "labeled_gauge(...) without a HELP string — pass help= (or "
+            "describe() the family) so the metric family is "
+            "self-documenting in /metrics scrapes")

@@ -109,8 +109,9 @@ def bucket_capacity(n: int) -> int:
 JOIN_KERNEL_ENV = "SIDDHI_TPU_JOIN_KERNEL"
 
 
-def _pick_join_kernel(app_name: str, qname: str, cross) -> tuple[str, str]:
-    """Join kernel for one JoinCross: ``(kernel, reason)``.
+def _pick_join_kernel(app_name: str, qname: str,
+                      cross) -> tuple[str, str, str]:
+    """Join kernel for one JoinCross: ``(kernel, reason, cause)``.
 
     Policy (docs/performance.md "join kernels"): the banded searchsorted
     probe whenever the ON condition carries an ``L == R`` equi conjunct,
@@ -120,33 +121,44 @@ def _pick_join_kernel(app_name: str, qname: str, cross) -> tuple[str, str]:
     (``.jax_cache/costs.json``, obs/costmodel.load_costs) is consulted:
     when a prior profile shows this join's grid centers dominating the
     app's measured step time, the probe pick is recorded as
-    evidence-backed rather than heuristic."""
+    evidence-backed rather than heuristic.
+
+    ``cause`` is a machine-readable slug — ``env-override`` /
+    ``no-equi-conjunct`` / ``cost-evidence`` / ``no-cost-table`` /
+    ``equi-default`` — so explain (obs/explain.py) never shows a
+    decision without a cause, even when the cost table is absent."""
     env = os.environ.get(JOIN_KERNEL_ENV, "").strip().lower()
     eligible = cross.equi is not None
     if env == "grid":
-        return "grid", "SIDDHI_TPU_JOIN_KERNEL=grid override"
+        return "grid", "SIDDHI_TPU_JOIN_KERNEL=grid override", \
+            "env-override"
     if env == "probe":
         if eligible:
-            return "probe", "SIDDHI_TPU_JOIN_KERNEL=probe override"
+            return "probe", "SIDDHI_TPU_JOIN_KERNEL=probe override", \
+                "env-override"
         return "grid", ("SIDDHI_TPU_JOIN_KERNEL=probe requested but the "
                         "ON condition has no equi conjunct — grid "
-                        "fallback")
+                        "fallback"), "no-equi-conjunct"
     if not eligible:
         return "grid", ("no equi conjunct in ON condition (the banded "
-                        "probe needs one)")
+                        "probe needs one)"), "no-equi-conjunct"
     try:
         from ..obs.costmodel import load_costs
         tbl = load_costs().get(app_name) or {}
     except Exception:  # noqa: BLE001 — costs are advisory
         tbl = {}
-    if tbl:
-        key, costs = max(tbl.items(),
-                         key=lambda kv: kv[1].get("ms_total", 0.0))
-        if key.startswith(f"join/{qname}.") and "[probe]" not in key:
-            return "probe", (
-                f"cost table: grid-dominated center {key} "
-                f"({costs.get('ms_total', 0)} ms_total) — probe selected")
-    return "probe", "equi ON condition (banded searchsorted probe)"
+    if not tbl:
+        return "probe", ("equi ON condition (banded searchsorted probe); "
+                         "no cost table measured yet"), "no-cost-table"
+    key, costs = max(tbl.items(),
+                     key=lambda kv: kv[1].get("ms_total", 0.0))
+    if key.startswith(f"join/{qname}.") and "[probe]" not in key:
+        return "probe", (
+            f"cost table: grid-dominated center {key} "
+            f"({costs.get('ms_total', 0)} ms_total) — probe selected"), \
+            "cost-evidence"
+    return "probe", "equi ON condition (banded searchsorted probe)", \
+        "equi-default"
 
 
 def _donate(*argnums):
@@ -1705,6 +1717,14 @@ class SiddhiAppRuntime:
         # for the buckets configured via SIDDHI_TPU_WARM_BUCKETS
         from .compile import CompileService
         self.compile_service = CompileService(self)
+        # flight-recorder identity: every artifact this app's recorder
+        # dumps carries {app, pool, plan_hash} so a PAGE dump is
+        # attributable to a plan change (obs/slo.py; the hash is
+        # computed lazily at dump time — dumps are rare, plans can
+        # change on live graph edits)
+        if self.slo is not None and self.slo.recorder is not None:
+            self.slo.recorder.identity_fn = lambda: {
+                "app": self.name, "plan_hash": self.plan_hash()}
         self.scheduler.playback = self._playback
         # start-state absent deadlines are based at app start, not the
         # first event (AbsentStreamPreStateProcessor.partitionCreated);
@@ -1872,36 +1892,57 @@ class SiddhiAppRuntime:
 
     # -- chain fusion (docs/performance.md) -------------------------------
     def _fusible_next(self, q) -> Optional["QueryRuntime"]:
-        """The single downstream QueryRuntime the hop q -> next can fuse
-        into, or None. Fusible means: q is a plain single-stream query
-        whose ONLY output is `insert into` a synchronous junction with
-        exactly one subscriber that is itself a plain QueryRuntime taking
-        device batches — no row-level consumers (query callbacks, rate
-        limiters, device taps) on q, no @Async/@OnError machinery on the
-        intermediate stream, and no sort-heavy capacity cap downstream
-        (capped queries re-split batches on the host, which a fused trace
-        cannot do)."""
+        return self._fusible_next_info(q)[0]
+
+    def _fusible_next_info(self, q) -> tuple:
+        """``(next, reason)``: the single downstream QueryRuntime the
+        hop q -> next can fuse into (reason None), or (None, slug)
+        naming WHY the hop broke the chain — the machine-readable
+        fusion evidence explain surfaces (obs/explain.py). Fusible
+        means: q is a plain single-stream query whose ONLY output is
+        `insert into` a synchronous junction with exactly one
+        subscriber that is itself a plain QueryRuntime taking device
+        batches — no row-level consumers (query callbacks, rate
+        limiters, device taps) on q, no @Async/@OnError machinery on
+        the intermediate stream, and no sort-heavy capacity cap
+        downstream (capped queries re-split batches on the host, which
+        a fused trace cannot do)."""
         if type(q) is not QueryRuntime:
-            return None
-        if q.rate_limiter is not None or q.callback_handler.callbacks \
-                or q.batch_callbacks:
-            return None
+            return None, "not-plain-query"
+        if q.rate_limiter is not None:
+            return None, "rate-limiter"
+        if q.callback_handler.callbacks:
+            return None, "row-callbacks"
+        if q.batch_callbacks:
+            return None, "device-taps"
         if len(q.output_handlers) != 1:
-            return None
+            return None, "fan-out" if len(q.output_handlers) > 1 \
+                else "no-insert-into-output"
         h = q.output_handlers[0]
         if type(h) is not InsertIntoStreamHandler:
-            return None
+            return None, "non-stream-output"
         j = h.junction
-        if j.async_conf is not None or j.fault_junction is not None \
-                or j.on_error_action != "LOG":
-            return None
+        if j.async_conf is not None:
+            return None, "async-junction"
+        if j.fault_junction is not None or j.on_error_action != "LOG":
+            return None, "on-error-machinery"
         if len(j.receivers) != 1:
-            return None
+            return None, "multi-subscriber" if len(j.receivers) > 1 \
+                else "no-subscriber"
         r = j.receivers[0]
-        if type(r) is not QueryRuntime or r is q \
-                or r.max_step_capacity is not None:
-            return None
-        return r
+        if type(r) is not QueryRuntime:
+            return None, "downstream-not-plain-query"
+        if r is q:
+            return None, "self-loop"
+        if r.max_step_capacity is not None:
+            return None, "downstream-capacity-capped"
+        return r, None
+
+    def _fusion_enabled(self) -> bool:
+        """Whether segment derivation runs at all (explain evidence):
+        off under SIDDHI_TPU_FUSE=0 or an attached debugger."""
+        return os.environ.get("SIDDHI_TPU_FUSE", "1") != "0" \
+            and self.debugger is None
 
     def _build_fused_chains(self) -> None:
         """Walk the junction graph and compile each maximal fusible
@@ -1913,9 +1954,7 @@ class SiddhiAppRuntime:
         for q in self.queries.values():
             if type(q) is QueryRuntime:
                 q._fused_chain = None
-        if os.environ.get("SIDDHI_TPU_FUSE", "1") == "0":
-            return
-        if self.debugger is not None:
+        if not self._fusion_enabled():
             return
         nxt = {}
         for q in self.queries.values():
@@ -2164,6 +2203,28 @@ class SiddhiAppRuntime:
         flat[f"{p}.app.running"] = int(self.running)
         flat[f"{p}.app.ready"] = int(self.ready)
         return flat, report
+
+    def explain(self, live: bool = True) -> dict:
+        """The full plan-explain document (obs/explain.py,
+        docs/observability.md "Explain"): junction dataflow graph,
+        every planner decision with its machine-readable reason
+        (fusion segments + break causes, join kernel picks + evidence,
+        window compaction variant, watermark/late-policy config, SLO
+        objectives, mesh placement per state leaf), the AOT program
+        inventory, and live edge annotations. ``plan_hash`` is stable
+        across deploys of the same plan; assembly compiles nothing and
+        reads nothing off-device (tested in tests/test_explain.py)."""
+        from ..obs.explain import ExplainReport
+        return ExplainReport.from_runtime(self, live=live).as_dict()
+
+    def plan_hash(self) -> str:
+        """Stable content hash of the compiled plan (decisions + graph
+        only, never live stats). Stamped into flight-recorder artifacts
+        so a PAGE dump is attributable to a plan change."""
+        from ..obs.explain import (compute_plan_hash, runtime_decisions,
+                                   runtime_graph)
+        return compute_plan_hash(runtime_graph(self),
+                                 runtime_decisions(self))
 
     def slo_report(self) -> Optional[dict]:
         """The SLO/burn-rate view on its own (``GET /siddhi/slo``);
@@ -3714,10 +3775,13 @@ class Planner:
             cross = crosses[key]
             if cross is None:
                 continue
-            kernel, reason = _pick_join_kernel(app.name, name, cross)
+            kernel, reason, cause = _pick_join_kernel(app.name, name,
+                                                      cross)
             cross.kernel = kernel
+            # the cause slug guarantees explain never shows a kernel
+            # pick without a machine-readable reason (obs/explain.py)
             app._join_kernels[f"{name}.{side_name}"] = {
-                "kernel": kernel, "reason": reason}
+                "kernel": kernel, "reason": reason, "cause": cause}
 
         sel_scope = JoinCombinedScope(side_scope, len(l_schema.types))
         if needs_agg:

@@ -202,6 +202,12 @@ class SiddhiService:
                     # with an objective + every tenant pool
                     # (docs/observability.md "SLO engine")
                     return self._send(200, service.slo_report())
+                if self.path == "/siddhi/explain":
+                    # the plan-explain view: every deployed app's and
+                    # pool's full decision document with its stable
+                    # plan_hash (docs/observability.md "Explain");
+                    # auth-protected — the plan describes app internals
+                    return self._send(200, service.explain_report())
                 if self.path.startswith("/siddhi/artifact/undeploy/"):
                     name = self.path.rsplit("/", 1)[-1]
                     if service.undeploy(name):
@@ -310,6 +316,26 @@ class SiddhiService:
                 worst = st
         return {"state": worst, "apps": apps, "pools": pools}
 
+    def explain_report(self) -> dict:
+        """``GET /siddhi/explain``: the full plan-explain document for
+        every deployed app and tenant pool, keyed by name, each with
+        its stable ``plan_hash`` (docs/observability.md "Explain").
+        Assembly is a host-side view — no compiles, no device reads —
+        so probing this endpoint is always safe on a serving box."""
+        apps = {}
+        for name, rt in list(self._deployed.items()):
+            try:
+                apps[name] = rt.explain()
+            except Exception as e:  # noqa: BLE001 — one broken app must
+                apps[name] = {"error": str(e)}  # not kill the probe
+        pools = {}
+        for pool in list(self.templates.pools.values()):
+            try:
+                pools[pool.name] = pool.explain()
+            except Exception as e:  # noqa: BLE001 — ditto
+                pools[pool.name] = {"error": str(e)}
+        return {"apps": apps, "pools": pools}
+
     # -- tenant operations (serving/, docs/serving.md) ---------------------
     def tenant_deploy(self, body: dict) -> dict:
         """Template + bindings -> pool slot. The FIRST deploy of a
@@ -396,18 +422,35 @@ class SiddhiService:
 
     # -- operations -------------------------------------------------------
     def deploy(self, siddhi_ql: str) -> str:
+        # identity holder: _deploy fills it as the failing deploy gets
+        # further (parsed name, then plan hash once a runtime exists) so
+        # the failure artifact names WHAT failed, not just that
+        # something did — {app, pool, plan_hash} context uniformly
+        # (obs/slo.py FlightRecorder identity contract)
+        ident: dict = {"app": None, "pool": None, "plan_hash": None}
         try:
-            return self._deploy(siddhi_ql)
+            return self._deploy(siddhi_ql, ident)
         except Exception as exc:
+            if ident.get("app") is None:
+                # parse-time failures never reached the name: re-parse
+                # WITHOUT validation just to recover the identity (the
+                # artifact must name what failed even for a bad plan)
+                try:
+                    from ..lang.parser import parse
+                    ident["app"] = parse(siddhi_ql, validate=False).name
+                except Exception:  # noqa: BLE001 — identity is
+                    pass           # best-effort
             # deploy failure -> flight-recorder artifact (the ring holds
             # the recent deploy history; the path lands in the log so a
             # failed rollout is diagnosable post-mortem)
             self.flight.record("deploy-failure", error=str(exc),
+                               app=ident.get("app"),
                                kind_of_error=type(exc).__name__)
             try:
                 path = self.flight.dump(
                     "deploy-failure",
-                    context={"deployed": sorted(self._deployed),
+                    context={**ident,
+                             "deployed": sorted(self._deployed),
                              "error": str(exc)})
                 import logging
                 logging.getLogger("siddhi_tpu.service").warning(
@@ -417,12 +460,14 @@ class SiddhiService:
                 pass           # the real deploy error
             raise
 
-    def _deploy(self, siddhi_ql: str) -> str:
+    def _deploy(self, siddhi_ql: str, ident: Optional[dict] = None) -> str:
+        ident = ident if ident is not None else {}
         # both checks run on the PARSED app before any runtime is built:
         # a textual scan is comment-bypassable, and building a duplicate
         # runtime would clobber the manager registry entry of the live one
         from ..lang.parser import parse
         app_ast = parse(siddhi_ql)
+        ident["app"] = app_ast.name
         if not self.allow_scripts and app_ast.function_definitions:
             raise ValueError(
                 "script function definitions are disabled for "
@@ -433,6 +478,11 @@ class SiddhiService:
                 f"app '{app_ast.name}' is already deployed — undeploy it "
                 "first")
         rt = self.manager.create_siddhi_app_runtime(siddhi_ql)
+        ident["app"] = rt.name
+        try:
+            ident["plan_hash"] = rt.plan_hash()
+        except Exception:  # noqa: BLE001 — identity is best-effort
+            pass
         from .compile import warm_buckets_from_env
         warm = warm_buckets_from_env() if self.warm_async else ()
         if warm:

@@ -16,6 +16,7 @@ pytree transfer under the app barrier. BASIC-level metrics therefore
 cost nothing per chunk.
 """
 from .costmodel import CostProfiler, load_costs  # noqa: F401
+from .explain import ExplainReport, explain_diff  # noqa: F401
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry  # noqa: F401
 from .slo import FlightRecorder, SLOEngine, SLOObjective  # noqa: F401
 from .tracing import ChunkTracer, maybe_span  # noqa: F401

@@ -1,0 +1,643 @@
+"""Plan explain: every planner decision as one structured, deterministic,
+diffable document (docs/observability.md "Explain").
+
+PR 6/7/11 made the runtime's *effects* observable (counters, step cost,
+per-tenant latency); this module makes its *decisions* observable — the
+things that determine performance before a single event flows: which
+queries fused into one XLA program and why a hop broke a chain, which
+join kernel the planner picked and on what evidence, which window
+compaction variant is active, how state shards over a mesh, what
+event-time and SLO contracts are configured, and which AOT programs
+exist with what compile cost. The cost-aware DAG optimizer (ROADMAP
+item 5) is undebuggable without this read side; TiLT-style optimization
+over the temporal dataflow (PAPERS.md) presumes exactly this kind of
+inspectable plan IR.
+
+Report shape (``ExplainReport.as_dict()``)::
+
+    {
+      "explain_version": 1,
+      "app": "<name>",            # identity only — NOT hashed
+      "plan_hash": "<16 hex>",    # sha256 over {graph, decisions}
+      "graph":     {...},         # streams / nodes / edges (hashed)
+      "decisions": {...},         # planner choices + reasons (hashed)
+      "programs":  {...},         # AOT inventory + compile ms (live)
+      "live":      {...},         # per-edge traffic / cost share (live)
+    }
+
+Hash contract: ``plan_hash`` covers the ``graph`` and ``decisions``
+sections ONLY, serialized as canonical JSON (sorted keys, no
+whitespace). Two deploys of the same app text in the same environment
+hash identically; live stats, compile wall times and the app's display
+name never move the hash. ``explain_diff(a, b)`` walks exactly the
+hashed sections and returns decision-level changes.
+
+Assembly invariant (tested like the PR 6/7 overhead bounds): building a
+report allocates ZERO new jitted programs, changes no jit options
+(compile-cache keys stay stable), and performs no device reads — every
+field is host-side planner/runtime metadata. It is a view over state
+the runtime already holds.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Optional
+
+_MISSING = "<absent>"
+
+EXPLAIN_VERSION = 1
+
+
+def _canonical(obj) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      default=str)
+
+
+def compute_plan_hash(graph: dict, decisions: dict) -> str:
+    """sha256 (16 hex chars) over the canonical JSON of the two hashed
+    sections — the ONLY inputs, so live stats can never move it."""
+    blob = _canonical({"graph": graph, "decisions": decisions})
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# graph assembly (streams / nodes / edges from the live junction wiring)
+# ---------------------------------------------------------------------------
+
+
+def _window_names(ops) -> list:
+    from ..ops.windows import WindowOp
+    return [type(op).__name__ for op in ops if isinstance(op, WindowOp)]
+
+
+def _operator_names(ops) -> list:
+    return [type(op).__name__ for op in ops]
+
+
+def _handler_targets(q) -> list:
+    """Machine-readable output targets of one query's handlers plus any
+    terminal table write. Unknown handler types degrade to their class
+    name (never a crash — explain must work on extended runtimes)."""
+    from ..core.runtime import (InsertIntoStreamHandler,
+                                InsertIntoWindowHandler,
+                                WindowPublishHandler)
+    from ..ops.table import TableOutputOp
+    out = []
+    for h in getattr(q, "output_handlers", ()):
+        if isinstance(h, InsertIntoStreamHandler):
+            out.append(h.junction.stream_id)
+        elif isinstance(h, InsertIntoWindowHandler):
+            out.append("window:" + h.wq.name.replace("__window__", "", 1))
+        elif isinstance(h, WindowPublishHandler):
+            out.append(h.junction.stream_id)
+        elif type(h).__name__ == "StoreOutputHandler":
+            out.append("store:" + h.rt.table_id)
+        else:
+            out.append("handler:" + type(h).__name__)
+    ops = getattr(q, "operators", None)
+    if ops:
+        last = ops[-1]
+        if isinstance(last, TableOutputOp):
+            out.append("table:" + last.table.table_id)
+    return out
+
+
+def _node_entry(rt, qname: str, q) -> dict:
+    from ..core.runtime import (JoinQueryRuntime, PatternQueryRuntime,
+                                QueryRuntime)
+    if isinstance(q, JoinQueryRuntime):
+        sides = {}
+        for side, nm in (("L", "left"), ("R", "right")):
+            if side in q.side_tables:
+                sides[nm] = "table:" + q.side_tables[side].table_id
+            else:
+                sides[nm] = q.in_schemas[side].stream_id
+        return {"kind": "join", "inputs": sorted(set(sides.values())),
+                "sides": sides, "outputs": _handler_targets(q)}
+    if isinstance(q, PatternQueryRuntime):
+        slots = [{"ref": s.ref, "stream": s.stream_id}
+                 for s in q.engine.slots]
+        return {"kind": "pattern",
+                "inputs": sorted({s.stream_id for s in q.engine.slots}),
+                "slots": slots,
+                "within_ms": q.engine.within_ms,
+                "engine": type(q.engine).__name__,
+                "outputs": _handler_targets(q)}
+    if type(q).__name__ == "PartitionQueryPort":
+        block = q.block
+        plan = next(p for p in block.plans if p.name == qname)
+        return {"kind": "partition-query",
+                "partition": block.name,
+                "inputs": sorted(getattr(plan, "input_ids",
+                                         {plan.input_id})),
+                "outputs": sorted(set(_handler_targets(q))
+                                  | {plan.target})}
+    if isinstance(q, QueryRuntime):
+        kind = "window" if qname.startswith("__window__") else "query"
+        return {"kind": kind, "inputs": [q.in_schema.stream_id],
+                "outputs": _handler_targets(q)}
+    return {"kind": type(q).__name__, "inputs": [],
+            "outputs": _handler_targets(q)}
+
+
+def runtime_graph(rt) -> dict:
+    """The junction dataflow graph: streams (with @Async/@OnError
+    config), query/join/pattern/partition nodes with their insert-into
+    edges, tables, named windows and aggregations — the same topology
+    the PR 3 typecheck fixpoint runs over, read off the live wiring."""
+    streams = {}
+    for sid, j in sorted(rt.junctions.items()):
+        entry = {"attributes": [[a.name, a.type.name]
+                                for a in j.schema.attributes]}
+        if j.async_conf is not None:
+            entry["async"] = {"capacity": int(j.async_conf[0]),
+                              "batch_max": int(j.async_conf[1])}
+        if j.on_error_action != "LOG":
+            entry["on_error"] = j.on_error_action
+        streams[sid] = entry
+    nodes = {}
+    for qname, q in sorted(rt.queries.items()):
+        nodes[qname] = _node_entry(rt, qname, q)
+    for wid, wq in sorted(rt.named_windows.items()):
+        nodes["window:" + wid] = {
+            "kind": "named-window",
+            "inputs": [wq.in_schema.stream_id],
+            "window": _window_names(wq.operators),
+            "outputs": _handler_targets(wq)}
+    for aid in sorted(rt.aggregations):
+        ad = rt.ast.aggregation_definitions.get(aid)
+        nodes["aggregation:" + aid] = {
+            "kind": "aggregation",
+            "inputs": [ad.input.stream_id] if ad is not None else [],
+            "outputs": []}
+    tables = {}
+    for tid, t in sorted(rt.tables.items()):
+        tables[tid] = {"capacity": int(getattr(t, "cap", 0)),
+                       "primary_key": list(getattr(t, "pk", ()))}
+    for tid in sorted(rt.record_tables):
+        tables.setdefault(tid, {})["store"] = True
+    edges = []
+    for qname, node in sorted(nodes.items()):
+        for sid in node.get("inputs", ()):
+            edges.append({"from": sid, "to": qname})
+        for tgt in node.get("outputs", ()):
+            edges.append({"from": qname, "to": tgt})
+    return {"streams": streams, "nodes": nodes, "tables": tables,
+            "edges": edges}
+
+
+# ---------------------------------------------------------------------------
+# decisions (planner choices + machine-readable reasons)
+# ---------------------------------------------------------------------------
+
+
+def _fusion_decisions(rt) -> dict:
+    """Fusion segment membership and, for every plain query that did NOT
+    fuse forward, the machine-readable reason its hop broke the chain
+    (core/runtime.py _fusible_next_info)."""
+    from ..core.runtime import QueryRuntime
+    segments = []
+    member_of = {}
+    for q in rt.queries.values():
+        ch = getattr(q, "_fused_chain", None)
+        if ch is not None:
+            segments.append({"head": ch.head.name,
+                             "members": [m.name for m in ch.queries]})
+            for m in ch.queries:
+                member_of[m.name] = ch.name
+    queries = {}
+    for qname, q in rt.queries.items():
+        if type(q) is not QueryRuntime or qname.startswith("__window__"):
+            continue
+        entry = {"segment": member_of.get(qname)}
+        if qname not in member_of:
+            nxt, reason = rt._fusible_next_info(q)
+            entry["break"] = "fusible-but-unfused" if nxt is not None \
+                else reason
+        queries[qname] = entry
+    segments.sort(key=lambda s: s["head"])
+    return {"enabled": rt._fusion_enabled(), "segments": segments,
+            "queries": queries}
+
+
+def _query_decisions(rt) -> dict:
+    """Per-node compiled-shape choices: operator chain, window classes,
+    capacity caps (sort-heavy splitting), timer scheduling mode."""
+    from ..core.runtime import (JoinQueryRuntime, PatternQueryRuntime,
+                                QueryRuntime)
+    out = {}
+    for qname, q in rt.queries.items():
+        if isinstance(q, JoinQueryRuntime):
+            entry = {
+                "kind": "join",
+                "sides": {nm: _operator_names(q.side_ops[s])
+                          for s, nm in (("L", "left"), ("R", "right"))},
+                "selector": _operator_names(q.operators),
+                "capacity_cap": q.max_step_capacity,
+            }
+        elif isinstance(q, PatternQueryRuntime):
+            entry = {
+                "kind": "pattern",
+                "engine": type(q.engine).__name__,
+                "states": len(q.engine.slots),
+                "selector": _operator_names(q.operators),
+                "capacity_cap": q.max_step_capacity,
+            }
+        elif type(q).__name__ == "PartitionQueryPort":
+            continue  # covered by the partitions section
+        elif isinstance(q, QueryRuntime):
+            entry = {
+                "kind": "query",
+                "operators": _operator_names(q.operators),
+                "windows": _window_names(q.operators),
+                "capacity_cap": q.max_step_capacity,
+                "host_due_timers": bool(q._host_due_all),
+            }
+        else:
+            entry = {"kind": type(q).__name__}
+        out[qname] = entry
+    return out
+
+
+def _partition_decisions(rt) -> dict:
+    from ..parallel import sharding as _sh
+    out = {}
+    for name, block in sorted(rt.partitions.items()):
+        entry = {
+            "slots": int(block.K),
+            "key_streams": sorted(block.key_specs),
+            "key_kinds": {sid: spec[0]
+                          for sid, spec in sorted(block.key_specs.items())},
+            "queries": [p.name for p in block.plans],
+            "capacity_cap": block.max_step_capacity,
+        }
+        if block.mesh is not None:
+            axis = block.mesh.axis_names[0]
+            entry["mesh"] = {
+                "axis": axis,
+                "n_devices": int(block.mesh.shape[axis]),
+                "slots_per_device":
+                    int(block.K) // int(block.mesh.shape[axis]),
+                # PartitionSpec placement per state leaf, from the regex
+                # rule table (parallel/sharding.py) — pure path/shape
+                # metadata, zero device reads
+                "placement": _sh.describe_placement(
+                    {"slot_tbl": block.slot_tbl,
+                     "qstates": block.qstates},
+                    _sh.PARTITION_STATE_RULES, axis),
+            }
+        out[name] = entry
+    return out
+
+
+def _watermark_decisions(rt) -> dict:
+    out = {}
+    for sid, buf in sorted(rt._reorder.items()):
+        conf = buf.conf
+        entry = {"lateness_ms": int(conf.lateness_ms),
+                 "policy": conf.policy,
+                 "cap": int(conf.cap),
+                 "dedup": bool(conf.dedup)}
+        if conf.late_stream is not None:
+            entry["late_stream"] = conf.late_stream
+        out[sid] = entry
+    return out
+
+
+def _compaction_decision() -> dict:
+    from ..ops import windows as _w
+    return {"variant": "region" if _w._REGION_COMPACTION else "sort",
+            "env": "SIDDHI_TPU_WINDOW_COMPACTION"}
+
+
+def runtime_decisions(rt) -> dict:
+    """Every planner decision with its machine-readable reason — the
+    hashed heart of the report."""
+    # NOTE: rt._columnar is runtime-OBSERVED (flips on the first
+    # columnar ingest), not planned — it rides `live`, never the hash
+    decisions = {
+        "playback": bool(rt._playback),
+        "fusion": _fusion_decisions(rt),
+        "queries": _query_decisions(rt),
+        "window_compaction": _compaction_decision(),
+    }
+    if rt._join_kernels:
+        decisions["join_kernels"] = {
+            k: dict(v) for k, v in sorted(rt._join_kernels.items())}
+    wm = _watermark_decisions(rt)
+    if wm:
+        decisions["watermarks"] = wm
+    if rt.partitions:
+        decisions["partitions"] = _partition_decisions(rt)
+    if rt.slo is not None:
+        decisions["slo"] = rt.slo.objective.as_dict() \
+            if rt.slo.objective is not None else None
+    if rt.mesh is not None:
+        axis = rt.mesh.axis_names[0]
+        decisions["mesh"] = {"axis": axis,
+                             "n_devices": int(rt.mesh.shape[axis])}
+    return decisions
+
+
+# ---------------------------------------------------------------------------
+# live annotations (NEVER hashed)
+# ---------------------------------------------------------------------------
+
+
+def _runtime_live(rt) -> dict:
+    """Per-edge traffic and pressure, folded in from the host-side
+    registries the runtime already maintains: events/s (ingest
+    trackers), @Async queue depth, watermark lag / reorder depth, and
+    the persisted cost share per center (costs.json). No device
+    reads — live numbers are host counters by the obs/ design rule."""
+    streams = {}
+    for sid, j in sorted(rt.junctions.items()):
+        entry = {}
+        tput = getattr(j, "throughput", None)
+        if tput is not None:
+            entry["events"] = tput.count
+            eps = tput.events_per_sec()
+            if eps is not None:
+                entry["events_per_s"] = round(eps, 1)
+        if j.async_conf is not None and j._queue is not None:
+            entry["queue_depth"] = j._queue.qsize()
+        buf = rt._reorder.get(sid)
+        if buf is not None:
+            entry["watermark"] = buf.watermark
+            entry["watermark_lag_ms"] = buf.lag_ms
+            entry["reorder_depth"] = buf.depth
+        if entry:
+            streams[sid] = entry
+    live = {"running": bool(rt.running),
+            "columnar": bool(rt._columnar), "streams": streams}
+    cost_share = {}
+    try:
+        from .costmodel import load_costs
+        tbl = load_costs().get(rt.name) or {}
+        total = sum(v.get("ms_total", 0.0) for v in tbl.values())
+        if total > 0:
+            cost_share = {
+                k: round(100.0 * v.get("ms_total", 0.0) / total, 1)
+                for k, v in sorted(tbl.items())}
+    except Exception:  # noqa: BLE001 — the cost table is advisory
+        cost_share = {}
+    if cost_share:
+        live["cost_share_pct"] = cost_share
+    return live
+
+
+def _programs_section(compile_service) -> dict:
+    """AOT program inventory: every warmed step with its compile ms,
+    plus the persistent-cache hit/miss story (core/compile.py). Live
+    telemetry — compile wall time must never move the plan hash."""
+    summary = compile_service.summary(detail=True)
+    steps = summary.pop("steps", [])
+    summary["steps"] = [{"step": r["step"], "compile_ms": r["ms"],
+                         **({"sharded": True} if r.get("sharded")
+                            else {})}
+                        for r in sorted(steps, key=lambda r: r["step"])]
+    return summary
+
+
+# ---------------------------------------------------------------------------
+# the report object
+# ---------------------------------------------------------------------------
+
+
+class ExplainReport:
+    """One assembled explain document. ``as_dict()`` is JSON-ready;
+    ``plan_hash`` is stable across deploys of the same plan;
+    ``diff(other)`` returns decision-level changes."""
+
+    def __init__(self, report: dict):
+        self.report = report
+
+    @property
+    def plan_hash(self) -> str:
+        return self.report["plan_hash"]
+
+    def as_dict(self) -> dict:
+        return self.report
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.report, indent=indent, sort_keys=True,
+                          default=str)
+
+    def diff(self, other) -> dict:
+        return explain_diff(self.report, other)
+
+    def to_dot(self) -> str:
+        return to_dot(self.report)
+
+    def describe(self) -> str:
+        return render_text(self.report)
+
+    # -- assembly ---------------------------------------------------------
+
+    @classmethod
+    def from_runtime(cls, rt, live: bool = True) -> "ExplainReport":
+        """Assemble from a deployed SiddhiAppRuntime. Zero new jitted
+        programs, zero jit-option changes, zero device reads — a pure
+        host-side view (the tested invariant)."""
+        graph = runtime_graph(rt)
+        decisions = runtime_decisions(rt)
+        report = {
+            "explain_version": EXPLAIN_VERSION,
+            "app": rt.name,
+            "plan_hash": compute_plan_hash(graph, decisions),
+            "graph": graph,
+            "decisions": decisions,
+            "programs": _programs_section(rt.compile_service),
+        }
+        if live:
+            report["live"] = _runtime_live(rt)
+        return cls(report)
+
+    @classmethod
+    def from_pool(cls, pool, live: bool = True) -> "ExplainReport":
+        """Assemble from a TenantPool: the TEMPLATE explains once (its
+        plan_hash is shared by every pool of that template in the same
+        environment); slot-axis facts — current slot count, active
+        tenants, per-device placement — are live, never hashed (the
+        slot axis grows by doubling with churn)."""
+        from ..parallel import sharding as _sh
+        proto = pool.proto
+        graph = runtime_graph(proto)
+        decisions = {
+            "template": pool.template.key,
+            "queries": _query_decisions(proto),
+            "window_compaction": _compaction_decision(),
+            "pool": {
+                "order": list(pool._order),
+                "ingest_stream": pool.ingest_stream,
+                "terminal_streams": list(pool._terminal),
+                "batch_max": int(pool.batch_max),
+                "max_tenants": int(pool.max_tenants),
+                "state_quota_bytes": pool.state_quota_bytes,
+                "execution": "vmap-slot-axis",
+            },
+            "slo": pool.slo_engine.objective.as_dict()
+            if pool.slo_engine.objective is not None else None,
+        }
+        if pool.mesh is not None:
+            decisions["mesh"] = {
+                "axis": pool.mesh_axis,
+                "n_devices": int(pool.n_devices),
+                # rule-table placement per state leaf (slot axis shards;
+                # paths are stable across slot-axis growth)
+                "placement": _sh.describe_placement(
+                    {"states": pool._states, "emitted": pool._emitted},
+                    _sh.POOL_STATE_RULES, pool.mesh_axis),
+            }
+        report = {
+            "explain_version": EXPLAIN_VERSION,
+            "app": pool.name,
+            "pool": pool.name,
+            "template": pool.template.key,
+            "plan_hash": compute_plan_hash(graph, decisions),
+            "graph": graph,
+            "decisions": decisions,
+            "programs": _programs_section(proto.compile_service),
+        }
+        if live:
+            report["live"] = {
+                "slots": int(pool.slots),
+                "slots_per_device": int(pool.slots_per_device),
+                "active_tenants": len(pool._tenants),
+                "rounds": int(pool._rounds),
+                "grows": int(pool._grows),
+            }
+        return cls(report)
+
+
+# ---------------------------------------------------------------------------
+# diff
+# ---------------------------------------------------------------------------
+
+
+def _walk_diff(path: tuple, a, b, changes: list) -> None:
+    if isinstance(a, dict) and isinstance(b, dict):
+        for k in sorted(set(a) | set(b)):
+            _walk_diff(path + (str(k),), a.get(k, _MISSING),
+                       b.get(k, _MISSING), changes)
+        return
+    if a != b:
+        changes.append({"path": ".".join(path), "a": a, "b": b,
+                        "summary": f"{'.'.join(path)}: {a!r} -> {b!r}"})
+
+
+def explain_diff(a, b) -> dict:
+    """Decision-level diff of two reports (dicts or ExplainReports):
+    walks exactly the hashed sections (``decisions`` then ``graph``)
+    and returns ``{equal, plan_hash_a, plan_hash_b, changes: [{path,
+    a, b, summary}]}``. Lists compare wholesale — a reordered fusion
+    segment IS a plan change."""
+    ra = a.report if isinstance(a, ExplainReport) else a
+    rb = b.report if isinstance(b, ExplainReport) else b
+    changes: list = []
+    _walk_diff(("decisions",), ra.get("decisions", {}),
+               rb.get("decisions", {}), changes)
+    _walk_diff(("graph",), ra.get("graph", {}), rb.get("graph", {}),
+               changes)
+    return {"equal": not changes,
+            "plan_hash_a": ra.get("plan_hash"),
+            "plan_hash_b": rb.get("plan_hash"),
+            "changes": changes}
+
+
+# ---------------------------------------------------------------------------
+# renderers
+# ---------------------------------------------------------------------------
+
+_DOT_SHAPES = {"query": "box", "join": "diamond", "pattern": "hexagon",
+               "partition-query": "box3d", "named-window": "component",
+               "aggregation": "cylinder", "window": "component"}
+
+
+def _dot_id(name: str) -> str:
+    return '"' + name.replace('"', r"\"") + '"'
+
+
+def to_dot(report: dict) -> str:
+    """Graphviz digraph of the junction dataflow graph: streams as
+    ellipses, queries per kind, fused segments boxed in clusters."""
+    graph = report.get("graph", {})
+    decisions = report.get("decisions", {})
+    lines = ["digraph plan {", "  rankdir=LR;",
+             f'  label="{report.get("app", "")} '
+             f'plan={report.get("plan_hash", "")}";']
+    for sid in sorted(graph.get("streams", ())):
+        lines.append(f"  {_dot_id(sid)} [shape=ellipse];")
+    segments = decisions.get("fusion", {}).get("segments", [])
+    fused = {m for s in segments for m in s["members"]}
+    for i, seg in enumerate(segments):
+        lines.append(f"  subgraph cluster_fuse{i} {{")
+        lines.append('    label="fused segment"; style=dashed;')
+        for m in seg["members"]:
+            lines.append(f"    {_dot_id(m)} [shape=box];")
+        lines.append("  }")
+    for qname, node in sorted(graph.get("nodes", {}).items()):
+        if qname in fused:
+            continue
+        shape = _DOT_SHAPES.get(node.get("kind"), "box")
+        lines.append(f"  {_dot_id(qname)} [shape={shape}];")
+    for edge in graph.get("edges", ()):
+        lines.append(f"  {_dot_id(edge['from'])} -> "
+                     f"{_dot_id(edge['to'])};")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def render_text(report: dict) -> str:
+    """Human-readable explain: the decisions section as an indented
+    outline (the CLI's default output)."""
+    out = [f"app: {report.get('app')}",
+           f"plan_hash: {report.get('plan_hash')}"]
+    decisions = report.get("decisions", {})
+    fusion = decisions.get("fusion")
+    if fusion:
+        out.append("fusion:")
+        for seg in fusion.get("segments", []):
+            out.append("  segment: " + " -> ".join(seg["members"]))
+        for qn, e in sorted(fusion.get("queries", {}).items()):
+            if e.get("segment") is None:
+                out.append(f"  {qn}: unfused ({e.get('break')})")
+    jk = decisions.get("join_kernels")
+    if jk:
+        out.append("join kernels:")
+        for side, e in sorted(jk.items()):
+            out.append(f"  {side}: {e['kernel']} [{e.get('cause')}] "
+                       f"— {e.get('reason')}")
+    wm = decisions.get("watermarks")
+    if wm:
+        out.append("watermarks:")
+        for sid, e in sorted(wm.items()):
+            out.append(f"  {sid}: lateness={e['lateness_ms']}ms "
+                       f"policy={e['policy']} cap={e['cap']}")
+    if decisions.get("slo") is not None:
+        out.append(f"slo: {decisions['slo']}")
+    parts = decisions.get("partitions")
+    if parts:
+        out.append("partitions:")
+        for name, e in sorted(parts.items()):
+            mesh = e.get("mesh")
+            extra = (f" mesh={mesh['n_devices']}x@{mesh['axis']}"
+                     if mesh else "")
+            out.append(f"  {name}: slots={e['slots']} "
+                       f"queries={e['queries']}{extra}")
+    wc = decisions.get("window_compaction", {})
+    out.append(f"window compaction: {wc.get('variant')}")
+    progs = report.get("programs", {})
+    if progs.get("programs"):
+        out.append(f"programs: {progs['programs']} compiled in "
+                   f"{progs.get('compile_ms')} ms "
+                   f"(cache {progs.get('cache_hits')} hits / "
+                   f"{progs.get('cache_misses')} misses)")
+    live = report.get("live")
+    if live and live.get("streams"):
+        out.append("live edges:")
+        for sid, e in sorted(live["streams"].items()):
+            bits = [f"{k}={v}" for k, v in sorted(e.items())]
+            out.append(f"  {sid}: " + " ".join(bits))
+    return "\n".join(out) + "\n"

@@ -247,14 +247,36 @@ class FlightRecorder:
 
     CAP = 256
 
+    # artifact identity keys: EVERY dump's context carries them (None
+    # when unknown) so an artifact is always attributable to its app /
+    # pool / plan without guessing from the filename
+    IDENTITY_KEYS = ("app", "pool", "plan_hash")
+
     def __init__(self, name: str, cap: int = CAP,
-                 dirpath: Optional[str] = None):
+                 dirpath: Optional[str] = None,
+                 identity_fn: Optional[Callable[[], dict]] = None):
         self.name = name
         self.dirpath = dirpath
+        # identity_fn() -> {"app": ..., "pool": ..., "plan_hash": ...}
+        # evaluated at dump time (plan hashes can change on live graph
+        # edits); owners set it after construction when the identity is
+        # not known yet (SiddhiAppRuntime / TenantPool wiring)
+        self.identity_fn = identity_fn
         self._ring: deque = deque(maxlen=max(1, int(cap)))
         self._lock = threading.Lock()
         self._seq = 0
         self.dumps: list[str] = []
+
+    def _identity(self) -> dict:
+        ident = {k: None for k in self.IDENTITY_KEYS}
+        if self.identity_fn is not None:
+            try:
+                got = self.identity_fn() or {}
+                ident.update({k: got[k] for k in self.IDENTITY_KEYS
+                              if k in got})
+            except Exception:  # noqa: BLE001 — identity is best-effort
+                pass           # at dump time; the dump must still land
+        return ident
 
     def record(self, kind: str, **data) -> None:
         entry = {"t_wall_ms": int(time.time() * 1000), "kind": kind}
@@ -270,7 +292,11 @@ class FlightRecorder:
              path: Optional[str] = None) -> str:
         """Write the artifact; returns its path. Artifact schema
         (docs/observability.md): ``{name, reason, dumped_at_ms, spans:
-        [ring entries oldest-first], context: {...}}``."""
+        [ring entries oldest-first], context: {app, pool, plan_hash,
+        ...}}`` — the identity triple is ALWAYS present (None when
+        unknown) so every artifact is attributable to its app/pool and
+        the plan that produced it, no matter which path triggered the
+        dump (PAGE transition, deploy failure, chaos failure)."""
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -283,7 +309,8 @@ class FlightRecorder:
                 d, f"{slug}.{int(time.time() * 1000)}.{seq}.json")
         payload = {"name": self.name, "reason": reason,
                    "dumped_at_ms": int(time.time() * 1000),
-                   "spans": spans, "context": context or {}}
+                   "spans": spans,
+                   "context": {**self._identity(), **(context or {})}}
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(payload, f, indent=1, sort_keys=True, default=str)

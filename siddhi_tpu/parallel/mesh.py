@@ -283,6 +283,24 @@ class DataParallelRunner:
         scalar read — never a per-shard gather)."""
         return int(jax.device_get(jnp.sum(self._emitted)))
 
+    def explain(self) -> dict:
+        """Data-parallel placement decisions for the explain surface
+        (obs/explain.py): step family, mesh geometry, which streams
+        route by key (and on which column), and the rule-table
+        placement per state leaf. Host-side metadata only — no device
+        reads, no new programs."""
+        return {
+            "kind": self.kind,
+            "query": self.q.name,
+            "axis": self.axis,
+            "n_devices": self.n,
+            "route_cols": {sid: int(col) for sid, col
+                           in sorted(self.route_cols.items())},
+            "psum_boundary": "aggregate-emitted-count",
+            "placement": sharding.describe_placement(
+                self._state, sharding.DATA_PARALLEL_RULES, self.axis),
+        }
+
 
 # -- measured scaling arms (bench.py `multichip`, __graft_entry__) ----------
 

@@ -152,6 +152,22 @@ def match_partition_rules(rules, tree, axis: str):
     return jax.tree_util.tree_unflatten(treedef, specs)
 
 
+def describe_placement(tree, rules, axis: str) -> dict:
+    """Human/machine-readable placement per state leaf: ``{path:
+    "shard(<axis>)" | "replicate"}`` from the rule table — the mesh
+    section of the explain report (obs/explain.py). Pure path + shape
+    metadata: no device reads, no placement side effects; paths are
+    stable across slot-axis growth so the plan hash never moves on
+    churn."""
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in leaves:
+        spec = spec_for_path(_path_str(path), leaf, rules, axis)
+        out[_path_str(path)] = (f"shard({axis})" if len(spec) and
+                                spec[0] is not None else "replicate")
+    return out
+
+
 def check_divisible(n: int, mesh: Mesh, what: str) -> None:
     axis = mesh.axis_names[0]
     nd = int(mesh.shape[axis])

@@ -233,7 +233,13 @@ class TenantPool:
         if every is None:
             env = os.environ.get(_SLO_EVERY_ENV, "")
             every = int(env) if env else _POOL_DEFAULT_EVERY
-        self.flight = FlightRecorder(self.name, dirpath=flight_dir)
+        self.flight = FlightRecorder(
+            self.name, dirpath=flight_dir,
+            # every artifact carries {app, pool, plan_hash}: a PAGE
+            # dump is attributable to the pool AND the template plan
+            # that produced it (obs/slo.py identity contract)
+            identity_fn=lambda: {"app": self.name, "pool": self.name,
+                                 "plan_hash": self.plan_hash()})
         self.slo_engine = SLOEngine(
             self.name, objective=objective, every=every,
             recorder=self.flight, context_fn=self._flight_context)
@@ -1047,6 +1053,25 @@ class TenantPool:
         per-scope latency percentiles, attainment, burn rates, states,
         plus the pool's saturation signals."""
         return self.slo_engine.evaluate(saturation=self.saturation())
+
+    def explain(self, live: bool = True) -> dict:
+        """Plan explain for the pool (obs/explain.py): the TEMPLATE
+        explains once — its ``plan_hash`` covers the prototype's graph
+        and the pool's configured decisions (query order, batch_max,
+        admission caps, SLO objective, mesh placement rules) and is
+        shared by every pool of the same template in the same
+        environment. Slot-axis facts (current slots, active tenants,
+        rounds) ride the ``live`` section, never the hash — the slot
+        axis grows by doubling with churn."""
+        from ..obs.explain import ExplainReport
+        with self._lock:
+            return ExplainReport.from_pool(self, live=live).as_dict()
+
+    def plan_hash(self) -> str:
+        """Stable content hash of the template plan (decisions + graph
+        only) — stamped into flight-recorder artifacts."""
+        from ..obs.explain import ExplainReport
+        return ExplainReport.from_pool(self, live=False).plan_hash
 
     def _collect_sharded_locked(self) -> dict:
         """Mesh pools collect with ONE read PER DEVICE: each device's

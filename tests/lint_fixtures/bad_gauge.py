@@ -1,0 +1,21 @@
+"""Fixture: labeled gauge families registered with and without HELP
+strings (the bare-gauge-family rule)."""
+
+
+def publish(registry, tenant):
+    # BAD: family sample with no help= and no describe() — scrapes as
+    # an undocumented metric family
+    registry.labeled_gauge("siddhi.pool.tenant.emitted",
+                           {"tenant": tenant}).set(1)
+    # OK: help= keyword documents the family inline
+    registry.labeled_gauge("siddhi.pool.tenant.pending",
+                           {"tenant": tenant},
+                           help="rows queued for one tenant").set(2)
+    # OK: the family is describe()d in this module
+    registry.describe("siddhi.pool.tenant.errors",
+                      "events routed to one tenant's error partition")
+    registry.labeled_gauge("siddhi.pool.tenant.errors",
+                           {"tenant": tenant}).set(0)
+    # OK: suppressed inline
+    registry.labeled_gauge("siddhi.pool.tenant.quiet",  # lint: disable=bare-gauge-family
+                           {"tenant": tenant}).set(3)

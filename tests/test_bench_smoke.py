@@ -8,6 +8,7 @@ Runs at a tiny event scale on the CPU backend so the whole smoke stays
 inside the tier-1 budget; SIDDHI_BENCH_PLATFORM pins the backend because
 the axon sitecustomize overrides JAX_PLATFORMS (see tests/conftest.py).
 """
+import copy
 import json
 import os
 import subprocess
@@ -15,9 +16,17 @@ import sys
 
 BENCH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
                      "bench.py")
+TOOLS = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..",
+                     "tools")
+
+# one subprocess run per config per session: the bench_diff gate test
+# reuses the filter run instead of paying a second ~30s child
+_RUNS: dict = {}
 
 
 def _run_config(name: str) -> dict:
+    if name in _RUNS:
+        return copy.deepcopy(_RUNS[name])
     env = dict(os.environ)
     env.update(
         SIDDHI_BENCH_PLATFORM="cpu",
@@ -35,7 +44,20 @@ def _run_config(name: str) -> dict:
     assert lines, f"no JSON line in stdout:\n{proc.stdout[-2000:]}"
     parsed = json.loads(lines[-1])
     assert parsed is not None
+    _RUNS[name] = copy.deepcopy(parsed)
     return parsed
+
+
+def _assert_plan(d: dict):
+    """Every app-backed config's JSON line carries a parseable `plan`
+    block: {plan_hash, decisions} — BENCH_r*.json records WHAT was
+    measured, not just how fast (obs/explain.py; the bench_diff gate
+    reads the hash)."""
+    plan = d["plan"]
+    assert "error" not in plan, plan
+    assert isinstance(plan["plan_hash"], str) and len(plan["plan_hash"])
+    assert isinstance(plan["decisions"], dict)
+    assert "window_compaction" in plan["decisions"]
 
 
 def test_bench_filter_quick_parses():
@@ -51,6 +73,7 @@ def test_bench_filter_quick_parses():
     # dotted siddhi.* metrics (docs/observability.md)
     assert isinstance(d["metrics"], dict)
     assert any(k.startswith("siddhi.") for k in d["metrics"])
+    _assert_plan(d)
 
 
 def test_bench_chain3_quick_parses_fused_vs_unfused():
@@ -64,6 +87,10 @@ def test_bench_chain3_quick_parses_fused_vs_unfused():
     assert d["compile_ms"] > 0 and d["ttfr_ms"] > 0
     assert isinstance(d["metrics"], dict)
     assert any(k.startswith("siddhi.") for k in d["metrics"])
+    # the plan block must record the fused segment (what was measured)
+    _assert_plan(d)
+    segs = d["plan"]["decisions"]["fusion"]["segments"]
+    assert segs and segs[0]["members"] == ["q1", "q2", "q3"]
     # cost attribution of the fused run: ONE chain center, members named
     _assert_breakdown(d, top_kind="chain")
 
@@ -97,6 +124,7 @@ def test_bench_seq5_quick_parses_frontier_and_breakdown():
     assert d["unit"] == "events/s"
     assert d["value"] > 0
     assert d["p99_ms"] > 0 and d["p99_ms_1k"] > 0
+    _assert_plan(d)
     _assert_frontier(d)
     _assert_breakdown(d, top_kind="pattern")
 
@@ -117,6 +145,11 @@ def test_bench_join_quick_parses_frontier_and_breakdown():
     # both kernels measured: the auto pick (probe for this equi ON) and
     # the pinned grid comparison pass, each with a frontier
     assert d["join_kernel"] == "probe"
+    # plan block: the kernel decision rides the artifact with a cause
+    _assert_plan(d)
+    jk = d["plan"]["decisions"]["join_kernels"]
+    assert jk["q.left"]["kernel"] == "probe"
+    assert jk["q.left"]["cause"]
     assert d["grid_eps"] > 0
     assert d["probe_speedup_vs_grid"] > 0
     for row in d["frontier_grid"]:
@@ -166,6 +199,7 @@ def test_bench_tenants_quick_parses():
         assert entry["eps_pooled"] > 0
     # skewed-traffic SLO arm (obs/slo.py): measured p50/p99 attainment
     # vs the configured objective must parse with burn-rate state
+    _assert_plan(d)   # the pool's template plan block
     slo = d["slo"]
     assert slo["objective_p99_ms"] > 0
     assert slo["samples"] > 0, slo
@@ -174,3 +208,42 @@ def test_bench_tenants_quick_parses():
     assert slo["state"] in ("OK", "WARN", "PAGE")
     assert slo["hot_p99_ms"] > 0 and slo["cold_p99_ms_max"] > 0
     assert slo["skew"] > 1
+
+
+def test_bench_diff_gate(tmp_path):
+    """tools/bench_diff.py regression gate: a --quick run diffed
+    against itself exits 0; a doctored copy (halved events/s + flipped
+    plan_hash) exits 1 — and a plan-only change still exits 1 unless
+    --allow-plan-change acknowledges it."""
+    if str(TOOLS) not in sys.path:
+        sys.path.insert(0, str(TOOLS))
+    import bench_diff
+    d = _run_config("filter")   # memoized: shares the filter child
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps({"config": "filter", **d}) + "\n")
+
+    # identical artifacts: clean gate
+    assert bench_diff.main([str(a), str(a)]) == 0
+
+    # doctored: regression + plan change -> exit 1
+    bad = copy.deepcopy(d)
+    bad["value"] = d["value"] * 0.5
+    bad["plan"]["plan_hash"] = "0" * 16
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps({"config": "filter", **bad}) + "\n")
+    assert bench_diff.main([str(a), str(b)]) == 1
+
+    # plan-only change: exit 1 without the flag, 0 with it
+    planned = copy.deepcopy(d)
+    planned["plan"]["plan_hash"] = "f" * 16
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps({"config": "filter", **planned}) + "\n")
+    assert bench_diff.main([str(a), str(c)]) == 1
+    assert bench_diff.main([str(a), str(c),
+                            "--allow-plan-change"]) == 0
+
+    # the summary-object artifact shape parses too (BENCH_r*.json tail)
+    summary = tmp_path / "s.json"
+    summary.write_text(json.dumps(
+        {"metric": "x", "configs": {"filter": d}}) + "\n")
+    assert bench_diff.main([str(a), str(summary)]) == 0

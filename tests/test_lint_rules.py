@@ -175,3 +175,19 @@ def test_all_seeded_rules_registered():
     assert {"module-device-array", "host-sync-in-loop", "host-sync-in-jit",
             "traced-branch-in-jit", "recompile-hazard",
             "float64-literal"} <= rule_names()
+
+
+def test_bare_gauge_family_fires_without_help():
+    """labeled_gauge families without a HELP string fire; help= kwarg,
+    a describe() of the same family literal in the module, and
+    pragma'd sites stay clean — the explain/metrics surfaces must stay
+    self-documenting (docs/observability.md "label conventions")."""
+    fs = findings_for("bad_gauge.py")
+    assert lines_of(fs, "bare-gauge-family") == [8]
+    f = [x for x in fs if x.rule == "bare-gauge-family"][0]
+    assert f.severity == "warning"
+    assert "help" in f.message
+
+
+def test_bare_gauge_family_registered():
+    assert "bare-gauge-family" in rule_names()

@@ -113,6 +113,8 @@ class TestEngine:
         assert art["reason"] == "slo-breach"
         assert any(s["kind"] == "slo-state" for s in art["spans"])
         assert "tenant=hot" in art["context"]["paged_scopes"]
+        # identity keys present even for a bare engine (no runtime)
+        assert set(FlightRecorder.IDENTITY_KEYS) <= set(art["context"])
         # steady PAGE state: no new artifact per scrape
         rep2 = eng.evaluate(now_ms=t0 + 101)
         assert "flight_artifact" not in rep2
@@ -191,9 +193,31 @@ class TestFlightRecorder:
         art = json.load(open(path))
         assert art["name"] == "ring" and art["reason"] == "test-reason"
         assert len(art["spans"]) == 16
-        assert art["context"] == {"k": "v"}
+        # identity keys are UNIFORM on every artifact (None when no
+        # identity_fn is wired) — obs/explain.py plan attribution
+        assert art["context"] == {"k": "v", "app": None, "pool": None,
+                                  "plan_hash": None}
         assert art["dumped_at_ms"] > 0
         assert rec.dumps == [path]
+
+    def test_dump_identity_fn_stamps_app_pool_plan(self, tmp_path):
+        rec = FlightRecorder(
+            "ident", dirpath=str(tmp_path),
+            identity_fn=lambda: {"app": "a1", "pool": "p1",
+                                 "plan_hash": "cafe" * 4})
+        art = json.load(open(rec.dump("r")))
+        ctx = art["context"]
+        assert ctx["app"] == "a1" and ctx["pool"] == "p1"
+        assert ctx["plan_hash"] == "cafe" * 4
+
+    def test_dump_identity_fn_failure_still_dumps(self, tmp_path):
+        def boom():
+            raise RuntimeError("identity exploded")
+        rec = FlightRecorder("ident2", dirpath=str(tmp_path),
+                             identity_fn=boom)
+        art = json.load(open(rec.dump("r")))
+        assert art["context"]["app"] is None
+        assert art["context"]["plan_hash"] is None
 
 
 # ---------------------------------------------------------------------------
@@ -278,6 +302,11 @@ class TestPool:
         assert art["reason"] == "slo-breach"
         assert "tenant=t0" in art["context"]["paged_scopes"]
         assert art["context"]["runtime"]["pool"] == pool.name
+        # pool artifacts carry the FULL identity triple: app/pool name
+        # and the template plan hash (obs/explain.py attribution)
+        assert art["context"]["app"] == pool.name
+        assert art["context"]["pool"] == pool.name
+        assert art["context"]["plan_hash"] == pool.plan_hash()
         pool.shutdown()
 
     def test_stats_collection_one_device_get_with_slo_on(self,

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Bench regression gate: compare two BENCH_r*.json artifacts
+config-by-config and fail on throughput regressions or silent plan
+changes (docs/observability.md "Explain" — the diff workflow).
+
+    python tools/bench_diff.py BENCH_r05.json BENCH_r06.json
+    python tools/bench_diff.py A.json B.json --threshold 10
+    python tools/bench_diff.py A.json B.json --allow-plan-change
+
+Both artifact shapes parse: the JSON-lines stream bench.py prints (one
+``{"config": name, ...}`` line per finished config, summary line last)
+and a bare summary object with a ``configs`` map. Configs are matched
+BY NAME; configs present on only one side are reported but never gate.
+
+Gate (exit 1):
+
+- events/s regression beyond ``--threshold`` percent (default 15) on
+  any config whose ``value`` is comparable on both sides;
+- any ``plan.plan_hash`` change, unless ``--allow-plan-change`` — a
+  faster number measured against a DIFFERENT plan is not a comparison,
+  it is a confound (the plan block exists so BENCH artifacts record
+  what was measured, not just how fast).
+
+Exit status: 0 clean, 1 regression or unacknowledged plan change, 2
+usage/parse error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+DEFAULT_THRESHOLD_PCT = 15.0
+
+
+def load_configs(path: str) -> dict:
+    """BENCH artifact -> {config_name: entry}. Accepts the JSON-lines
+    stream (per-config lines + summary last) or one summary object."""
+    entries: dict = {}
+    summary = None
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    lines = [ln for ln in text.splitlines() if ln.strip().startswith("{")]
+    if not lines:
+        raise ValueError(f"{path}: no JSON object lines found")
+    for ln in lines:
+        try:
+            obj = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "configs" in obj:
+            summary = obj
+        elif isinstance(obj, dict) and "config" in obj:
+            name = obj["config"]
+            if name != "_meta":
+                entries[name] = obj
+    if summary is not None:
+        for name, entry in summary["configs"].items():
+            entries.setdefault(name, entry)
+    if not entries:
+        raise ValueError(f"{path}: no per-config entries found")
+    return entries
+
+
+def _plan_hash(entry: dict):
+    plan = entry.get("plan")
+    if isinstance(plan, dict):
+        return plan.get("plan_hash")
+    return None
+
+
+def _num(entry: dict, key: str):
+    v = entry.get(key)
+    return v if isinstance(v, (int, float)) else None
+
+
+def diff_configs(a: dict, b: dict, threshold_pct: float,
+                 allow_plan_change: bool) -> dict:
+    """The comparison table + verdicts. Each row: {config, eps_a,
+    eps_b, eps_delta_pct, p99_a, p99_b, plan_a, plan_b, flags}."""
+    rows = []
+    regressions = []
+    plan_changes = []
+    for name in sorted(set(a) | set(b)):
+        ea, eb = a.get(name), b.get(name)
+        if ea is None or eb is None:
+            rows.append({"config": name,
+                         "flags": ["only-in-b" if ea is None
+                                   else "only-in-a"]})
+            continue
+        row = {"config": name, "flags": []}
+        va, vb = _num(ea, "value"), _num(eb, "value")
+        row["eps_a"], row["eps_b"] = va, vb
+        if va and vb and ea.get("unit") == eb.get("unit"):
+            delta = (vb / va - 1.0) * 100.0
+            row["eps_delta_pct"] = round(delta, 1)
+            if delta < -threshold_pct:
+                row["flags"].append("regression")
+                regressions.append(name)
+        row["p99_a"] = _num(ea, "p99_ms")
+        row["p99_b"] = _num(eb, "p99_ms")
+        ha, hb = _plan_hash(ea), _plan_hash(eb)
+        row["plan_a"], row["plan_b"] = ha, hb
+        if ha is not None and hb is not None and ha != hb:
+            row["flags"].append("plan-change")
+            plan_changes.append(name)
+        rows.append(row)
+    failed = bool(regressions) or (bool(plan_changes)
+                                   and not allow_plan_change)
+    return {"rows": rows, "regressions": regressions,
+            "plan_changes": plan_changes,
+            "threshold_pct": threshold_pct, "failed": failed}
+
+
+def _fmt(v, width: int, nd: int = 0) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float):
+        return f"{v:.{nd}f}".rjust(width)
+    return str(v).rjust(width)
+
+
+def print_table(result: dict, out=sys.stdout) -> None:
+    hdr = (f"{'config':<14}{'eps_a':>14}{'eps_b':>14}{'delta%':>9}"
+           f"{'p99_a':>9}{'p99_b':>9}  plan")
+    out.write(hdr + "\n" + "-" * len(hdr) + "\n")
+    for row in result["rows"]:
+        if set(row["flags"]) & {"only-in-a", "only-in-b"}:
+            out.write(f"{row['config']:<14}{row['flags'][0]:>14}\n")
+            continue
+        ha, hb = row.get("plan_a"), row.get("plan_b")
+        plan = "-"
+        if ha is not None or hb is not None:
+            plan = "same" if ha == hb else f"{ha} -> {hb}"
+        flags = (" [" + ",".join(row["flags"]) + "]") if row["flags"] \
+            else ""
+        out.write(
+            f"{row['config']:<14}{_fmt(row.get('eps_a'), 14, 1)}"
+            f"{_fmt(row.get('eps_b'), 14, 1)}"
+            f"{_fmt(row.get('eps_delta_pct'), 9, 1)}"
+            f"{_fmt(row.get('p99_a'), 9, 2)}"
+            f"{_fmt(row.get('p99_b'), 9, 2)}  {plan}{flags}\n")
+    if result["regressions"]:
+        out.write(f"FAIL: throughput regression > "
+                  f"{result['threshold_pct']}% on: "
+                  f"{', '.join(result['regressions'])}\n")
+    if result["plan_changes"]:
+        out.write("plan_hash changed on: "
+                  f"{', '.join(result['plan_changes'])}\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_diff.py",
+        description="compare two BENCH_r*.json artifacts; exit 1 on "
+                    "throughput regression or silent plan change")
+    ap.add_argument("a", help="baseline BENCH artifact")
+    ap.add_argument("b", help="candidate BENCH artifact")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD_PCT, metavar="PCT",
+                    help="max tolerated events/s drop in percent "
+                         f"(default {DEFAULT_THRESHOLD_PCT:g})")
+    ap.add_argument("--allow-plan-change", action="store_true",
+                    help="plan_hash changes are reported but do not "
+                         "fail the gate")
+    ap.add_argument("--json", action="store_true",
+                    help="print the comparison as JSON instead of a "
+                         "table")
+    args = ap.parse_args(argv)
+    try:
+        a = load_configs(args.a)
+        b = load_configs(args.b)
+    except (OSError, ValueError) as e:
+        print(f"bench_diff: {e}", file=sys.stderr)
+        return 2
+    result = diff_configs(a, b, args.threshold, args.allow_plan_change)
+    if args.json:
+        print(json.dumps(result, indent=1, sort_keys=True))
+    else:
+        print_table(result)
+    return 1 if result["failed"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
