@@ -170,6 +170,7 @@ class PlanValidator:
         self.check_slo()
         self.check_watermarks()
         self.check_template_params()
+        self.check_shareable_prefixes()
         for sid, sd in self.app.stream_definitions.items():
             self.check_on_error_actions(sid, sd)
         qn = 0
@@ -182,6 +183,57 @@ class PlanValidator:
                 self.check_partition(el, f"partition{qn + 1}")
                 qn += len(el.queries)
         return self.issues
+
+    def check_shareable_prefixes(self) -> None:
+        """``shareable-prefix``: queries reading the same stream with an
+        identical leading filter prefix (canonical signature,
+        plan/canon.py — the SAME detector the optimizer's CSE pass
+        uses) are advisory-flagged when the plan optimizer is DISABLED
+        (``SIDDHI_TPU_OPT=0`` / ``SIDDHI_TPU_OPT_CSE=0``): the fan-out
+        would evaluate the shared work once per query instead of once
+        per chunk. With the optimizer on (the default) the prefix IS
+        shared and nothing fires."""
+        import os
+        if os.environ.get("SIDDHI_TPU_OPT", "1") != "0" and \
+                os.environ.get("SIDDHI_TPU_OPT_CSE", "1") != "0":
+            return
+        from ..plan.canon import canonical_expr
+        qn = 0
+        by_stream: dict[str, list] = {}
+        for el in self.app.execution_elements:
+            if not isinstance(el, A.Query):
+                qn += len(el.queries) if isinstance(el, A.Partition) \
+                    else 1
+                continue
+            qn += 1
+            name = el.name or f"query{qn}"
+            sin = el.input
+            if not isinstance(sin, A.SingleInputStream):
+                continue
+            sigs = []
+            for h in sin.handlers:
+                if not isinstance(h, A.Filter):
+                    break  # stateless-shareable prefix = leading filters
+                sigs.append(canonical_expr(h.expression))
+            if sigs:
+                by_stream.setdefault(sin.stream_id, []).append(
+                    (name, tuple(sigs)))
+        for sid in sorted(by_stream):
+            entries = by_stream[sid]
+            by_first: dict[str, list] = {}
+            for name, sigs in entries:
+                by_first.setdefault(sigs[0], []).append(name)
+            for sig in sorted(by_first):
+                names = by_first[sig]
+                if len(names) < 2:
+                    continue
+                self.add(
+                    "shareable-prefix", WARNING, ", ".join(names),
+                    f"queries on stream '{sid}' share an identical "
+                    "filter prefix that is evaluated once per query "
+                    "with the plan optimizer disabled — enable "
+                    "SIDDHI_TPU_OPT (CSE shares one evaluation per "
+                    "chunk, docs/performance.md)")
 
     def check_app_statistics(self) -> None:
         """Unknown ``@app:statistics`` reporter names / unparseable

@@ -268,8 +268,27 @@ class CompileService:
                     jcap = min(jcap, rc)
             if j.async_conf is not None:
                 jcap = min(jcap, j.async_conf[1])
-            caps = sorted({bucket_capacity(min(B, jcap)) for B in buckets})
+            # cost-evidence chunk caps (plan/optimizer.py) pin the
+            # dispatch shape — mirror the send_arrays negotiation so
+            # the warmed programs are the ones traffic will hit
+            fanout = getattr(j, "fanout", None)
+            if fanout is not None and fanout.preferred_cap:
+                jcap = min(jcap, fanout.preferred_cap)
             for r in receivers:
+                pc = getattr(r, "preferred_ingest_cap", None)
+                if pc:
+                    jcap = min(jcap, pc)
+            caps = sorted({bucket_capacity(min(B, jcap)) for B in buckets})
+            if fanout is not None:
+                # ONE fused fan-out program covers every grouped
+                # subscriber; members keep their timer-batch specs below
+                fcaps = sorted({min(c, fanout.max_step_capacity or c)
+                                for c in caps})
+                self._fanout_specs(add, fanout, j.schema, fcaps,
+                                   packed_ok, samples)
+            for r in receivers:
+                if fanout is not None and fanout.covers(r):
+                    continue  # grouped — dispatches via the fanout step
                 if isinstance(r, QueryRuntime):
                     if id(r) in fused_members:
                         continue  # fused segments dispatch via the head
@@ -342,6 +361,41 @@ class CompileService:
                     def build(enc=enc, cap=cap):
                         states, emitted = states_zero()
                         fn = q._packed_step_for(enc, cap)
+                        return fn, (states, tstates_zero(), emitted,
+                                    zero_packed_buffer(schema, enc, cap))
+                    add(f"{name}/packed/{cap}/{','.join(enc)}", build)
+
+    def _fanout_specs(self, add, group, schema, caps, packed_ok,
+                      samples):
+        """Row + packed steps for a fused fan-out group
+        (plan/optimizer.py FanoutGroup): one program per chunk shape
+        covering every grouped subscriber of the junction."""
+        app = self.app
+        name = f"fanout:{group.name}"
+
+        def tstates_zero():
+            return {t: _zeros_like_tree(app.tables[t].state)
+                    for t in group.table_deps}
+
+        def states_zero():
+            st, em = group._read_states()
+            return _zeros_like_tree(st), _zeros_like_tree(em)
+
+        for cap in caps:
+            def build(cap=cap):
+                states, emitted = states_zero()
+                fn = group._step_for()
+                return fn, (states, tstates_zero(), emitted,
+                            _zero_batch(schema, cap), _zero_now())
+            add(f"{name}/row/{cap}", build)
+        if packed_ok:
+            pk_caps = sorted({min(c, group.max_packed_capacity or c)
+                              for c in caps})
+            for enc in self._encodings(schema, samples):
+                for cap in pk_caps:
+                    def build(enc=enc, cap=cap):
+                        states, emitted = states_zero()
+                        fn = group._packed_step_for(enc, cap)
                         return fn, (states, tstates_zero(), emitted,
                                     zero_packed_buffer(schema, enc, cap))
                     add(f"{name}/packed/{cap}/{','.join(enc)}", build)

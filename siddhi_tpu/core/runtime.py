@@ -48,7 +48,8 @@ from ..ops.join import (JoinCombinedScope, JoinCross, JoinSideScope,
 from ..ops.nfa import MatchScope, NfaCompiler, NfaEngine
 from ..ops.nfa_parallel import ParallelNfaEngine, parallel_supported
 from ..ops.operators import FilterOp, Operator
-from ..ops.selector import ProjectOp, selector_needs_aggregation
+from ..ops.selector import (ProjectOp, output_attribute_name,
+                            selector_needs_aggregation)
 from ..ops.table import (TableFilterOp, TableOutputOp, TableRuntime,
                          expr_mentions_table)
 from ..ops.windows2 import (BatchWindowOp, CronWindowOp, DelayWindowOp,
@@ -284,10 +285,15 @@ class OutputHandler:
     def handle(self, timestamp: int, rows: list) -> None:
         raise NotImplementedError
 
-    def handle_device_batch(self, out, timestamp: int) -> bool:
+    def handle_device_batch(self, out, timestamp: int,
+                            current=None) -> bool:
         """Try to consume the DEVICE output batch without host row decode
         (device-to-device query chaining). Returns True when consumed —
-        the row path is then skipped for this handler."""
+        the row path is then skipped for this handler. ``current`` is a
+        zero-arg memoized supplier of the CURRENT-kind-rewritten batch:
+        the dispatching query builds it ONCE per emitted batch, so a
+        fan-out of N insert-into handlers pays one jitted rewrite
+        instead of N (docs/performance.md)."""
         return False
 
 
@@ -304,14 +310,19 @@ class InsertIntoStreamHandler(OutputHandler):
         self.junction = junction
         self.output_event_type = output_event_type
 
-    def handle_device_batch(self, out, timestamp: int) -> bool:
+    def handle_device_batch(self, out, timestamp: int,
+                            current=None) -> bool:
         receivers = self.junction.receivers
         if not receivers:
             return True  # nobody listening — drop without decode
         if all(hasattr(r, "process_batch") for r in receivers):
-            # kind rewrite runs as ONE jitted dispatch per hop (fused
-            # segments do it inside the chain trace instead)
-            self.junction.publish_batch(_rewrite_current(out), timestamp)
+            # kind rewrite runs as ONE jitted dispatch per emitted batch
+            # — shared across every handler of the emitting query via
+            # the memoized `current` supplier (fused segments do it
+            # inside the chain trace instead)
+            cur = current() if current is not None \
+                else _rewrite_current(out)
+            self.junction.publish_batch(cur, timestamp)
             return True
         return False
 
@@ -328,8 +339,9 @@ class InsertIntoWindowHandler(OutputHandler):
     def __init__(self, wq: "QueryRuntime"):
         self.wq = wq
 
-    def handle_device_batch(self, out, timestamp):
-        self.wq.process_batch(_rewrite_current(out), timestamp)
+    def handle_device_batch(self, out, timestamp, current=None):
+        cur = current() if current is not None else _rewrite_current(out)
+        self.wq.process_batch(cur, timestamp)
         return True
 
     def handle(self, timestamp, rows):
@@ -353,7 +365,7 @@ class WindowPublishHandler(OutputHandler):
             return out.mask(out.kind == EXPIRED)
         return out
 
-    def handle_device_batch(self, out, timestamp):
+    def handle_device_batch(self, out, timestamp, current=None):
         self.junction.publish_batch(self._filtered(out), timestamp)
         return True
 
@@ -482,6 +494,15 @@ class QueryRuntime(Receiver):
         # (SiddhiAppRuntime._build_fused_chains): batches entering this
         # query traverse the whole segment in one XLA program
         self._fused_chain: Optional["FusedChain"] = None
+        # set when this query is a member of a fan-out fusion group
+        # (plan/optimizer.py FanoutGroup) — the junction dispatches the
+        # group once per chunk; this reference is explain evidence and
+        # keeps direct sends/timers on the standalone step
+        self._fanout_group = None
+        # cost-evidence ingest chunk cap (plan/optimizer.py): consulted
+        # by the send_arrays capacity negotiation when this query heads
+        # a fused chain with measured per-capacity centers
+        self.preferred_ingest_cap: Optional[int] = None
         # DETAIL latency probe sampling counter (see _lat_sample)
         self._lat_counter = 0
 
@@ -769,6 +790,15 @@ class QueryRuntime(Receiver):
         # paths and no extra tunnel round-trips happen
         _host: list = []
         _decoded: list = []
+        _current: list = []
+
+        def current_once():
+            # CURRENT-kind rewrite shared across ALL handlers of this
+            # emission: one jitted dispatch per emitted batch, no matter
+            # how many insert-into junctions the output fans out to
+            if not _current:
+                _current.append(_rewrite_current(out))
+            return _current[0]
 
         def host_once():
             if not _host:
@@ -802,7 +832,8 @@ class QueryRuntime(Receiver):
                 self.rate_limiter.process(timestamp, rows)
             return
         row_handlers = [h for h in self.output_handlers
-                        if not h.handle_device_batch(out, timestamp)]
+                        if not h.handle_device_batch(
+                            out, timestamp, current=current_once)]
         decode = bool(row_handlers or self.callback_handler.callbacks)
         if decode and due is not None:
             if _host:
@@ -919,7 +950,8 @@ class FusedChain:
     path updates `q.states` under `q._lock`, and the fused step takes
     the member locks in segment order before running."""
 
-    def __init__(self, app: "SiddhiAppRuntime", queries: list):
+    def __init__(self, app: "SiddhiAppRuntime", queries: list,
+                 schedule: Optional[list] = None):
         self.app = app
         self.queries = list(queries)
         self.head = self.queries[0]
@@ -927,27 +959,58 @@ class FusedChain:
         self.name = "+".join(q.name for q in self.queries)
         self.table_deps = sorted({t for q in self.queries
                                   for t in q.table_deps})
+        # execution schedule (plan/optimizer.py): member ops + per-member
+        # emitted-count boundaries + hop rewrites. The optimizer's filter
+        # pushdown hands a reordered schedule; None keeps declaration
+        # order (bit-identical to the pre-schedule nested composition).
+        from ..plan.optimizer import natural_schedule
+        self.schedule = schedule or natural_schedule(self.queries)
         self._chain = self._make_chain()
         self._step: Optional[Callable] = None
         self._packed_steps: dict = {}
 
     def _make_chain(self):
-        bodies = [_chain_body(q.operators, q._has_timers)
-                  for q in self.queries]
+        queries = self.queries
+        schedule = self.schedule
 
         def chain(states, tstates, emitteds, batch, now):
-            out = batch
-            new_states, new_emitted, dues = [], [], []
-            for i, body in enumerate(bodies):
-                if i:
-                    out = _as_current(out)  # insert-into hop, in-trace
-                st, tstates, em, out, due = body(
-                    states[i], tstates, emitteds[i], out, now)
-                new_states.append(st)
-                new_emitted.append(em)
+            cur = batch
+            new_states = [list(st) for st in states]
+            new_emitted = list(emitteds)
+            for entry in schedule:
+                kind = entry[0]
+                if kind == "op":
+                    _, mi, oi = entry
+                    op = queries[mi].operators[oi]
+                    st = new_states[mi][oi]
+                    with op_scope(type(op).__name__):
+                        if op.needs_tables:
+                            st, cur, tstates = op.step_tables(
+                                st, cur, now, tstates)
+                        else:
+                            st, cur = op.step(st, cur, now)
+                    new_states[mi][oi] = st
+                elif kind == "count":
+                    mi = entry[1]
+                    new_emitted[mi] = emitteds[mi] + \
+                        cur.count().astype(jnp.int64)
+                else:  # insert-into hop, in-trace
+                    cur = _as_current(cur)
+            dues = []
+            for mi, q in enumerate(queries):
+                if q._has_timers:
+                    ds = [op.next_due(st) for op, st in
+                          zip(q.operators, new_states[mi])
+                          if isinstance(op, WindowOp)]
+                    ds = [d for d in ds if d is not None]
+                    due = ds[0]
+                    for d in ds[1:]:
+                        due = jnp.minimum(due, d)
+                else:
+                    due = jnp.asarray(POS_INF)
                 dues.append(due)
-            return (tuple(new_states), tstates, tuple(new_emitted), out,
-                    tuple(dues))
+            return (tuple(tuple(s) for s in new_states), tstates,
+                    tuple(new_emitted), cur, tuple(dues))
 
         return chain
 
@@ -1006,7 +1069,10 @@ class FusedChain:
         # member queries are named in args instead of per-hop spans —
         # and ONE cost center, for the same reason (obs/costmodel.py)
         cost = self.app.cost
-        probe = cost.probe("chain", self.name) if cost.enabled else None
+        # cap rides the probe: per-capacity centers (chain/<n>@<cap>)
+        # are the optimizer's chunk-size evidence (plan/optimizer.py)
+        probe = cost.probe("chain", self.name, cap=chunk.capacity) \
+            if cost.enabled else None
         with self.app.tracer.span("chain", self.name, rows=chunk.n,
                                   members=[q.name for q in self.queries]):
             lat = self.head._stats_mark(chunk.n)
@@ -1636,6 +1702,10 @@ class SiddhiAppRuntime:
         # planner's per-join-side kernel picks: {"<q>.left": {"kernel":
         # "grid"|"probe", "reason": ...}} — statistics()['compile']
         self._join_kernels: dict[str, dict] = {}
+        # plan-optimizer decision record (plan/optimizer.py build_plan,
+        # set at start()): rides ExplainReport.decisions['optimizer']
+        # so every transformation flip moves plan_hash
+        self._opt_decisions: Optional[dict] = None
         # per-stream bounded-lateness reorder buffers keyed by stream id
         # (resilience/ordering.py, wired by the planner from @watermark
         # annotations); non-empty => watermark mode: the virtual clock
@@ -1945,36 +2015,29 @@ class SiddhiAppRuntime:
             and self.debugger is None
 
     def _build_fused_chains(self) -> None:
-        """Walk the junction graph and compile each maximal fusible
-        linear segment into a FusedChain on its head query. Cleared and
-        re-derived whenever the graph changes (new subscriber, callback,
-        rate limiter, debugger). SIDDHI_TPU_FUSE=0 keeps today's
-        per-query dispatch; attaching a debugger does too (row
-        breakpoints need per-query delivery)."""
+        """Derive the executable plan over the junction graph
+        (plan/optimizer.py build_plan): maximal fusible linear segments
+        compile into FusedChains on their head queries, fan-out
+        junctions into FanoutGroups, with CSE prefix sharing, filter
+        pushdown and cost-driven selection per the SIDDHI_TPU_OPT*
+        switches. Cleared and re-derived whenever the graph changes
+        (new subscriber, callback, rate limiter, debugger).
+        SIDDHI_TPU_FUSE=0 keeps per-query dispatch; attaching a
+        debugger does too (row breakpoints need per-query delivery)."""
         for q in self.queries.values():
             if type(q) is QueryRuntime:
                 q._fused_chain = None
+                q._fanout_group = None
+                q.preferred_ingest_cap = None
+        for j in self.junctions.values():
+            j.fanout = None
+        self._opt_decisions = None
         if not self._fusion_enabled():
+            self._opt_decisions = {"enabled": False,
+                                   "cause": "fusion-disabled"}
             return
-        nxt = {}
-        for q in self.queries.values():
-            r = self._fusible_next(q)
-            if r is not None:
-                nxt[q.name] = r
-        targets = {r.name for r in nxt.values()}
-        for qn in nxt:
-            if qn in targets:  # mid-segment (or part of a pure cycle)
-                continue
-            seg = [self.queries[qn]]
-            seen = {qn}
-            while seg[-1].name in nxt:
-                r = nxt[seg[-1].name]
-                if r.name in seen:
-                    break
-                seg.append(r)
-                seen.add(r.name)
-            if len(seg) >= 2:
-                seg[0]._fused_chain = FusedChain(self, seg)
+        from ..plan.optimizer import build_plan
+        build_plan(self)
 
     def _rebuild_fused_chains(self) -> None:
         if self.running:
@@ -2187,8 +2250,10 @@ class SiddhiAppRuntime:
             report["compile"] = comp
         # sampled per-step cost attribution (obs/costmodel.py): the
         # step_ms histograms live natively in the registry; the ranked
-        # rollup rides the statistics() view like 'compile'
-        if self.cost.samples:
+        # rollup rides the statistics() view like 'compile'. Also shown
+        # when the optimizer's staleness guard dropped centers at load
+        # (stale evidence in costs.json — counted, never silent)
+        if self.cost.samples or (self.cost.stale_centers or 0) > 0:
             report["cost"] = self.cost.report()
         # SLO view (obs/slo.py): ingest->emit latency scopes, burn-rate
         # states and saturation signals; labeled p99/burn/state gauge
@@ -2349,8 +2414,34 @@ class SiddhiAppRuntime:
     def cost_save(self, path: Optional[str] = None) -> str:
         """Persist the measured cost table into
         ``<SIDDHI_TPU_CACHE_DIR>/costs.json`` (merge-on-write; the DAG
-        optimizer's planned input). Returns the path written."""
+        optimizer's planned input). Centers from renamed/deleted plan
+        units are pruned on save (``_cost_center_valid``) so the
+        optimizer never feeds on stale evidence. Returns the path
+        written."""
         return self.cost.save(path)
+
+    def _cost_center_valid(self, key: str) -> bool:
+        """Whether a persisted cost-center key names a unit of THIS
+        app's current plan — the save-time pruning predicate and the
+        ``load_costs_for`` staleness guard (obs/costmodel.py). Keys may
+        carry a per-capacity ``@<cap>`` suffix; unknown kinds are kept
+        (forward compatibility — costs are advisory)."""
+        base = key.split("@", 1)[0]
+        kind, _, name = base.partition("/")
+        if kind == "query":
+            return name in self.queries or any(
+                wq.name == name for wq in self.named_windows.values())
+        if kind == "chain":
+            parts = name.split("+")
+            return len(parts) > 1 and all(p in self.queries
+                                          for p in parts)
+        if kind == "fanout":
+            return name in self.junctions
+        if kind in ("join", "pattern"):
+            return name.split(".", 1)[0] in self.queries
+        if kind == "partition":
+            return name in self.partitions
+        return True
 
     def profile(self, path: str):
         """Context manager capturing a device profile of the enclosed
@@ -3424,9 +3515,16 @@ class Planner:
                                           self.functions)
                 if cond.type is not AttrType.BOOL:
                     raise CompileError(f"query '{name}': filter must be BOOL")
-                operators.append(FilterOp(
-                    cond, schema,
-                    tparams=collect_template_params(h.expression)))
+                fop = FilterOp(cond, schema,
+                               tparams=collect_template_params(
+                                   h.expression))
+                # plan-optimizer evidence (plan/canon.py): canonical
+                # signature for CSE prefix sharing, referenced-column
+                # set for pushdown legality
+                from ..plan.canon import canonical_expr, filter_ref_names
+                fop.plan_sig = "filter:" + canonical_expr(h.expression)
+                fop.ref_names = filter_ref_names(h.expression)
+                operators.append(fop)
             elif isinstance(h, A.WindowHandler):
                 if window_op is not None:
                     raise CompileError(
@@ -3478,10 +3576,30 @@ class Planner:
                              (src_window.operators[0].fifo_expiry
                               if src_window is not None else True))))
         else:
-            operators.append(ProjectOp(
+            pop = ProjectOp(
                 q.selector, schema, target, scope,
                 functions=self.functions,
-                current_on=current_on, expired_on=expired_on))
+                current_on=current_on, expired_on=expired_on)
+            # plan-optimizer evidence: projection signature (CSE) and
+            # the output names that pass through as identity variables
+            # (pushdown legality — a downstream filter may hoist across
+            # this projection only for columns it leaves untouched)
+            from ..plan.canon import selector_sig
+            pop.plan_sig = (f"project:{current_on}:{expired_on}:"
+                            + selector_sig(q.selector))
+            if q.selector.select_all:
+                idn = frozenset(schema.names)
+            else:
+                idn = frozenset(
+                    out_name
+                    for i, oa in enumerate(q.selector.attributes)
+                    if isinstance(oa.expression, A.Variable)
+                    and oa.expression.index is None
+                    and oa.expression.function_ref is None
+                    and (out_name := output_attribute_name(oa, i))
+                    == oa.expression.attribute)
+            pop.identity_names = idn
+            operators.append(pop)
         return operators
 
     def _plan_partition_pattern(self, q, name: str, key_specs: dict):

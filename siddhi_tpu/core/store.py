@@ -748,7 +748,7 @@ class StoreOutputHandler:
             self.set_fns.append((rt.schema.index_of(var.attribute),
                                  host_eval(expr, out_schema)))
 
-    def handle_device_batch(self, out, timestamp) -> bool:
+    def handle_device_batch(self, out, timestamp, current=None) -> bool:
         return False  # store IO needs decoded rows
 
     def handle(self, timestamp, rows) -> None:

@@ -79,6 +79,11 @@ class StreamJunction:
         # statistics are enabled — marked at the host boundary, so the
         # numbers are free (no device syncs)
         self.throughput = None
+        # fan-out fusion group (plan/optimizer.py FanoutGroup): when the
+        # optimizer fused this junction's plain-query subscribers into
+        # one program, the batch publish paths call it ONCE per chunk
+        # instead of once per receiver; re-derived with the fused chains
+        self.fanout = None
         self._lock = threading.Lock()
         # @Async state (None = synchronous junction)
         self.async_conf: Optional[tuple[int, int]] = None  # (buffer, batch)
@@ -308,11 +313,20 @@ class StreamJunction:
             return [Event(ts, vals, is_expired=(kind == EXPIRED))
                     for ts, kind, vals in rows]
 
+        fanout = self.fanout
         with maybe_span(self.app, "junction", self.stream_id,
                         capacity=int(batch.capacity)):
+            fanout_done = False
             for r in list(self.receivers):
                 try:
-                    if hasattr(r, "process_batch"):
+                    if fanout is not None and fanout.covers(r):
+                        # fused fan-out: ONE dispatch for every grouped
+                        # subscriber (plan/optimizer.py), fired when the
+                        # loop reaches the first member
+                        if not fanout_done:
+                            fanout_done = True
+                            fanout.process_batch(batch, last_ts)
+                    elif hasattr(r, "process_batch"):
                         r.process_batch(batch, last_ts)
                     else:
                         if decoded is None:
@@ -463,6 +477,16 @@ class InputHandler:
             # latency, big = throughput); no thread hop is added since
             # packed dispatch already pipelines device-side
             max_cap = min(max_cap, self.junction.async_conf[1])
+        # cost-evidence chunk caps (plan/optimizer.py): a fused group or
+        # chain head with measured per-capacity centers pins the chunk
+        # size the evidence says is fastest per event
+        fanout = self.junction.fanout
+        if fanout is not None and fanout.preferred_cap:
+            max_cap = min(max_cap, fanout.preferred_cap)
+        for r in self.junction.receivers:
+            pc = getattr(r, "preferred_ingest_cap", None)
+            if pc:
+                max_cap = min(max_cap, pc)
         slo = self.app.slo
         for start in range(0, n, max_cap):
             t = ts[start:start + max_cap]
@@ -490,7 +514,15 @@ class InputHandler:
                         chunk = PackedChunk.build(
                             self._encoder, t, c, bucket_capacity(len(t)),
                             now=self.app.current_time())
+                        fanout_done = False
                         for r in list(self.junction.receivers):
+                            if fanout is not None and fanout.covers(r):
+                                # fused fan-out: one program for every
+                                # grouped subscriber (plan/optimizer.py)
+                                if not fanout_done:
+                                    fanout_done = True
+                                    fanout.process_packed(chunk)
+                                continue
                             r.process_packed(chunk)
                     else:
                         batch = batch_from_columns(

@@ -71,18 +71,23 @@ def default_costs_path() -> str:
 class _Probe:
     """One sampled step timing: created right before the dispatch,
     ``done(rows=...)`` after the caller's sampled-branch
-    ``block_until_ready``."""
+    ``block_until_ready``. ``cap`` (the dispatched chunk capacity, when
+    the caller passes it) lands the sample in an additional
+    per-capacity center ``<kind>/<name>@<cap>`` — the plan optimizer's
+    chunk-size evidence (plan/optimizer.py)."""
 
-    __slots__ = ("profiler", "key", "t0")
+    __slots__ = ("profiler", "key", "t0", "cap")
 
-    def __init__(self, profiler: "CostProfiler", key: tuple):
+    def __init__(self, profiler: "CostProfiler", key: tuple,
+                 cap: Optional[int] = None):
         self.profiler = profiler
         self.key = key
+        self.cap = cap
         self.t0 = time.perf_counter()
 
     def done(self, rows: int = 0) -> None:
         dt_ms = (time.perf_counter() - self.t0) * 1000.0
-        self.profiler.record(self.key, dt_ms, rows)
+        self.profiler.record(self.key, dt_ms, rows, cap=self.cap)
 
 
 class _Center:
@@ -132,7 +137,14 @@ class CostProfiler:
         self._lock = threading.Lock()
         self._counters: dict[tuple, int] = {}
         self._centers: dict[tuple, _Center] = {}
+        # per-capacity sub-centers keyed (kind, name, cap): persisted as
+        # `<kind>/<name>@<cap>` (the optimizer's chunk-size evidence)
+        # but EXCLUDED from report() so shares still sum to ~100
+        self._cap_centers: dict[tuple, _Center] = {}
         self._queues: dict[str, collections.deque] = {}
+        # stale centers the optimizer's load dropped (absent from the
+        # current plan — load_costs_for); surfaced in statistics()
+        self.stale_centers: Optional[int] = None
 
     @property
     def samples(self) -> int:
@@ -147,6 +159,7 @@ class CostProfiler:
         with self._lock:
             self._counters.clear()
             self._centers.clear()
+            self._cap_centers.clear()
             self._queues.clear()
         if every is not None:
             self.every = max(1, int(every))
@@ -156,10 +169,12 @@ class CostProfiler:
         self.enabled = False
 
     # -- recording (hot path, only when enabled) -------------------------
-    def probe(self, kind: str, name: str) -> Optional[_Probe]:
+    def probe(self, kind: str, name: str,
+              cap: Optional[int] = None) -> Optional[_Probe]:
         """Return a timing probe on sampled chunks, else None. Callers
         gate on ``self.enabled`` first so the disabled path never gets
-        here."""
+        here. ``cap`` additionally attributes the sample to a
+        per-capacity center (see _Probe)."""
         if not self.enabled:
             return None
         key = (kind, name)
@@ -168,15 +183,23 @@ class CostProfiler:
             self._counters[key] = n + 1
         if n % self.every:
             return None
-        return _Probe(self, key)
+        return _Probe(self, key, cap=cap)
 
-    def record(self, key: tuple, dt_ms: float, rows: int) -> None:
+    def record(self, key: tuple, dt_ms: float, rows: int,
+               cap: Optional[int] = None) -> None:
         kind, name = key
         with self._lock:
             c = self._centers.get(key)
             if c is None:
                 c = self._centers[key] = _Center(kind, name)
             c.add(dt_ms, rows)
+            if cap is not None:
+                ck = (kind, name, int(cap))
+                cc = self._cap_centers.get(ck)
+                if cc is None:
+                    cc = self._cap_centers[ck] = _Center(
+                        kind, f"{name}@{int(cap)}")
+                cc.add(dt_ms, rows)
             # queue-depth samples ride along: backpressure building up
             # behind a step is the first-class bottleneck signal
             for sid, j in self.app.junctions.items():
@@ -194,6 +217,8 @@ class CostProfiler:
     def _metric_name(self, kind: str, name: str) -> str:
         if kind == "partition":
             return f"siddhi.{self.app.name}.partition.{name}.step_ms"
+        if kind == "fanout":
+            return f"siddhi.{self.app.name}.fanout.{name}.step_ms"
         return f"siddhi.{self.app.name}.query.{name}.step_ms"
 
     # -- rollup ----------------------------------------------------------
@@ -240,6 +265,8 @@ class CostProfiler:
                     if c.wall_ms else math.inf
             if c.kind == "chain":
                 row["members"] = c.name.split("+")
+            elif c.kind == "fanout":
+                row["junction"] = c.name
             steps.append(row)
         queues = self._queue_trends()
         report = {"profiling": {"enabled": self.enabled,
@@ -248,6 +275,10 @@ class CostProfiler:
                                                for c in centers)},
                   "total_ms": round(total_ms, 3),
                   "steps": steps}
+        if self.stale_centers is not None:
+            # centers the optimizer's staleness guard dropped at load
+            # (renamed/deleted plan units lingering in costs.json)
+            report["stale_centers"] = self.stale_centers
         if queues:
             report["queues"] = queues
         if steps:
@@ -281,6 +312,8 @@ class CostProfiler:
                 span = f"step/{c.name}"
             elif c.kind == "chain":
                 span = f"chain/{c.name}"
+            elif c.kind == "fanout":
+                span = f"fanout/{c.name}"
             elif c.kind == "partition":
                 span = f"partition/{c.name}"
             else:  # join/pattern: <q>.<side|sid|timer> -> step/<q>
@@ -300,9 +333,12 @@ class CostProfiler:
     # -- persistence ------------------------------------------------------
     def table(self) -> dict:
         """Flat ``{<kind>/<name>: costs}`` table (compile-spec key
-        style) for persistence / the future DAG optimizer."""
+        style) for persistence / the DAG optimizer. Per-capacity
+        sub-centers ride along as ``<kind>/<name>@<cap>`` keys — the
+        optimizer's chunk-size evidence."""
         with self._lock:
-            centers = list(self._centers.values())
+            centers = list(self._centers.values()) + \
+                list(self._cap_centers.values())
         out = {}
         for c in centers:
             entry = {"ms_total": round(c.wall_ms, 3),
@@ -317,8 +353,13 @@ class CostProfiler:
     def save(self, path: Optional[str] = None) -> str:
         """Merge this app's cost table into the persisted
         ``costs.json`` next to the compile cache (tmp+rename, same
-        atomicity contract as the filesystem error store). Returns the
-        path written."""
+        atomicity contract as the filesystem error store).
+
+        The merged table is PRUNED against the app's current plan
+        (``SiddhiAppRuntime._cost_center_valid``): centers from
+        renamed/deleted queries would otherwise linger forever and feed
+        the plan optimizer stale evidence. Other apps' entries are left
+        untouched. Returns the path written."""
         path = path or default_costs_path()
         table = self.table()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
@@ -330,6 +371,10 @@ class CostProfiler:
             existing = {}
         app_tbl = existing.setdefault(self.app.name, {})
         app_tbl.update(table)
+        valid = getattr(self.app, "_cost_center_valid", None)
+        if valid is not None:
+            existing[self.app.name] = {
+                k: v for k, v in app_tbl.items() if valid(k)}
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(existing, f, indent=1, sort_keys=True)
@@ -346,3 +391,15 @@ def load_costs(path: Optional[str] = None) -> dict:
             return json.load(f)
     except (OSError, ValueError):
         return {}
+
+
+def load_costs_for(app: str, valid_center,
+                   path: Optional[str] = None) -> tuple[dict, int]:
+    """One app's cost table through the staleness guard: centers whose
+    keys ``valid_center`` rejects (plan units that no longer exist —
+    renamed queries, dropped junctions) are ignored rather than fed to
+    the optimizer, and counted. Returns ``(table, stale_count)``; the
+    count is surfaced in ``statistics()['cost']['stale_centers']``."""
+    tbl = load_costs(path).get(app) or {}
+    kept = {k: v for k, v in tbl.items() if valid_center(k)}
+    return kept, len(tbl) - len(kept)

@@ -206,12 +206,23 @@ def _fusion_decisions(rt) -> dict:
                              "members": [m.name for m in ch.queries]})
             for m in ch.queries:
                 member_of[m.name] = ch.name
+    group_of = {}
+    for j in rt.junctions.values():
+        fo = getattr(j, "fanout", None)
+        if fo is not None:
+            for u in fo.units:
+                head = getattr(u, "head", u)
+                group_of[head.name] = fo.name
     queries = {}
     for qname, q in rt.queries.items():
         if type(q) is not QueryRuntime or qname.startswith("__window__"):
             continue
         entry = {"segment": member_of.get(qname)}
-        if qname not in member_of:
+        if qname in group_of:
+            # fused into a fan-out group on its input junction
+            # (plan/optimizer.py — details under decisions.optimizer)
+            entry["fanout_group"] = group_of[qname]
+        elif qname not in member_of:
             nxt, reason = rt._fusible_next_info(q)
             entry["break"] = "fusible-but-unfused" if nxt is not None \
                 else reason
@@ -305,6 +316,20 @@ def _watermark_decisions(rt) -> dict:
     return out
 
 
+def _optimizer_decisions(rt) -> dict:
+    """The plan optimizer's decision record (plan/optimizer.py
+    build_plan): transformation switches, per-junction fan-out fusion
+    with cause slugs, CSE share classes, pushdown moves and
+    cost-evidence chunk caps. HASHED — a flipped optimizer decision is
+    a plan change. Before start() (no derivation yet) only the switch
+    state is known."""
+    d = getattr(rt, "_opt_decisions", None)
+    if d is not None:
+        return d
+    from ..plan.optimizer import opt_enabled
+    return {"enabled": opt_enabled(), "derived": False}
+
+
 def _compaction_decision() -> dict:
     from ..ops import windows as _w
     return {"variant": "region" if _w._REGION_COMPACTION else "sort",
@@ -319,6 +344,7 @@ def runtime_decisions(rt) -> dict:
     decisions = {
         "playback": bool(rt._playback),
         "fusion": _fusion_decisions(rt),
+        "optimizer": _optimizer_decisions(rt),
         "queries": _query_decisions(rt),
         "window_compaction": _compaction_decision(),
     }
@@ -464,8 +490,14 @@ class ExplainReport:
         from ..parallel import sharding as _sh
         proto = pool.proto
         graph = runtime_graph(proto)
+        # the optimizer plans ONCE per template: decisions derive from
+        # the never-started prototype (pure — no artifacts installed;
+        # the pool's vmapped slot-axis dispatch is its own execution
+        # strategy, recorded under decisions.pool)
+        from ..plan.optimizer import describe_decisions
         decisions = {
             "template": pool.template.key,
+            "optimizer": describe_decisions(proto),
             "queries": _query_decisions(proto),
             "window_compaction": _compaction_decision(),
             "pool": {
@@ -603,6 +635,22 @@ def render_text(report: dict) -> str:
         for qn, e in sorted(fusion.get("queries", {}).items()):
             if e.get("segment") is None:
                 out.append(f"  {qn}: unfused ({e.get('break')})")
+    opt = decisions.get("optimizer")
+    if opt is not None:
+        out.append(f"optimizer: {'on' if opt.get('enabled') else 'off'}")
+        for sid, e in sorted((opt.get("fanout") or {}).items()):
+            state = "fused" if e.get("fused") else "UNFUSED"
+            out.append(f"  fanout {sid}: {state} [{e.get('cause')}] "
+                       f"members={e.get('members')}")
+            for cls in e.get("cse", ()):
+                out.append(f"    shared prefix x{cls['ops']}: "
+                           f"{cls['queries']}")
+        for seg, moves in sorted((opt.get("pushdown") or {}).items()):
+            for mv in moves:
+                out.append(f"  pushdown {seg}: {mv['filter_of']} filter "
+                           f"hoisted past {mv['hoisted_past']}")
+        for key, e in sorted((opt.get("chunk_caps") or {}).items()):
+            out.append(f"  chunk cap {key}: {e['cap']} [{e['cause']}]")
     jk = decisions.get("join_kernels")
     if jk:
         out.append("join kernels:")

@@ -218,6 +218,19 @@ class WindowOp(Operator):
     # expel by comparator or frequency set it False.
     fifo_expiry = True
 
+    @property
+    def filter_pushdown_safe(self) -> bool:
+        """Whether a row-local filter commutes with this window
+        BIT-EXACTLY (plan/optimizer.py pushdown legality). False by
+        default: count-based membership (length/lengthBatch/sort/
+        frequent) depends on WHICH rows arrive, so filtering before vs
+        after selects different retained sets. Pure time-sliding
+        windows override: membership is timestamp-only — but only while
+        expired emission is off, because an expired row's rewritten
+        observation timestamp reads the running event-time at the
+        triggering row, and pre-filter masking moves that row."""
+        return False
+
     def __init__(self, schema: StreamSchema, expired_enabled: bool = True):
         self.schema = schema
         self.expired_enabled = expired_enabled
@@ -322,6 +335,12 @@ class TimeWindowOp(WindowOp):
 
     def host_due_bound(self, ts_min: int) -> int:
         return ts_min + self.T
+
+    @property
+    def filter_pushdown_safe(self) -> bool:
+        # time-only membership: filter-then-window == window-then-filter
+        # bit-exactly when no EXPIRED rows are emitted (see base class)
+        return not self.expired_enabled
 
     def findable_buffer(self, state):
         return state["buf"]

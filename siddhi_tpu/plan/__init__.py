@@ -1,0 +1,12 @@
+"""Cost-aware DAG plan optimizer (docs/performance.md "Plan optimizer").
+
+``plan.canon`` canonicalizes AST expressions/selectors into stable
+signature strings (common-subexpression detection, the
+``shareable-prefix`` plan rule); ``plan.optimizer`` derives the
+executable plan over the junction graph at ``start()`` — linear fused
+chains, fan-out fusion groups, CSE prefix sharing, filter pushdown and
+cost-driven selection from the measured ``costs.json`` table.
+"""
+from .canon import canonical_expr, expr_sig, filter_ref_names  # noqa: F401
+from .optimizer import (FanoutGroup, build_plan,  # noqa: F401
+                        describe_decisions, opt_enabled)

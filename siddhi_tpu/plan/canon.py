@@ -1,0 +1,131 @@
+"""Canonical expression/selector signatures for the plan optimizer.
+
+``canonical_expr`` renders a SiddhiQL AST expression into a stable
+string such that two expressions with the SAME canonical string are
+guaranteed to evaluate to bit-identical results over the same input
+batch. That guarantee is what lets the optimizer share one evaluated
+filter/projection prefix across queries (common-subexpression sharing,
+plan/optimizer.py) and what the ``shareable-prefix`` plan rule
+(analysis/plan_rules.py) keys on.
+
+Normalizations applied — each is exact, never approximate:
+
+- commutative boolean chains (``and`` / ``or``) flatten and sort their
+  operand strings: three-valued SQL AND/OR are commutative and
+  associative, so ``a and b`` == ``b and a`` bit-exactly;
+- ``==`` / ``!=`` sort their two operand strings (IEEE comparison is
+  symmetric, NaN included);
+- ordered comparisons normalize direction to ``<`` / ``<=`` by swapping
+  operands (``a > b`` == ``b < a``);
+- commutative arithmetic (binary ``+`` / ``*``) sorts operand strings:
+  IEEE addition and multiplication are commutative (NOT associative —
+  chains are left-nested by the parser and are not re-associated).
+
+Everything else renders structurally. Unknown node types render with
+a unique marker so they can never collide (conservative: unshareable).
+"""
+from __future__ import annotations
+
+import hashlib
+
+from ..lang import ast as A
+
+_ORDERED_FLIP = {">": "<", ">=": "<="}
+
+
+def _flatten(e, cls):
+    """Flatten a left/right tree of one commutative boolean class."""
+    if isinstance(e, cls):
+        yield from _flatten(e.left, cls)
+        yield from _flatten(e.right, cls)
+    else:
+        yield e
+
+
+def canonical_expr(e) -> str:
+    """Stable canonical rendering (see module docstring). Total over
+    the expression AST: unknown nodes get an identity-unique marker."""
+    if e is None:
+        return "none"
+    if isinstance(e, A.Constant):
+        t = e.type.value if e.type is not None else "?"
+        return f"c[{t}]{e.value!r}"
+    if isinstance(e, A.Variable):
+        idx = "" if e.index is None else f"@{e.index}"
+        fr = "" if e.function_ref is None else f"#{e.function_ref}"
+        ref = e.stream_ref or ""
+        inner = "#" if e.is_inner else ("!" if e.is_fault else "")
+        return f"v[{inner}{ref}]{e.attribute}{idx}{fr}"
+    if isinstance(e, A.AttributeFunction):
+        ns = e.namespace or ""
+        args = "*" if e.star else \
+            ",".join(canonical_expr(p) for p in e.parameters)
+        return f"f:{ns}:{e.name.lower()}({args})"
+    if isinstance(e, A.MathOp):
+        left, right = canonical_expr(e.left), canonical_expr(e.right)
+        if e.op in ("+", "*") and right < left:
+            left, right = right, left
+        return f"({left}{e.op}{right})"
+    if isinstance(e, A.Compare):
+        left, right = canonical_expr(e.left), canonical_expr(e.right)
+        op = e.op
+        if op in ("==", "!=") and right < left:
+            left, right = right, left
+        elif op in _ORDERED_FLIP:
+            op = _ORDERED_FLIP[op]
+            left, right = right, left
+        return f"({left}{op}{right})"
+    if isinstance(e, (A.And, A.Or)):
+        cls = type(e)
+        word = "and" if cls is A.And else "or"
+        parts = sorted(canonical_expr(p) for p in _flatten(e, cls))
+        return "(" + f" {word} ".join(parts) + ")"
+    if isinstance(e, A.Not):
+        return f"not({canonical_expr(e.expr)})"
+    if isinstance(e, A.IsNull):
+        if e.expr is not None:
+            return f"isnull({canonical_expr(e.expr)})"
+        return (f"isnull[{e.stream_ref}@{e.stream_index}"
+                f"{'#' if e.is_inner else ''}]")
+    if isinstance(e, A.InTable):
+        return f"in[{e.table_id}]({canonical_expr(e.expr)})"
+    if isinstance(e, A.TemplateParam):
+        t = e.type.value if e.type is not None else "?"
+        return f"tp[{t}]{e.name}"
+    # conservative: unknown node types never collide, never share
+    return f"opaque:{type(e).__name__}:{id(e):x}"
+
+
+def expr_sig(e) -> str:
+    """Short stable hash of the canonical rendering (decision records,
+    explain output — full canonical strings can be long)."""
+    return hashlib.sha256(canonical_expr(e).encode()).hexdigest()[:12]
+
+
+def filter_ref_names(e) -> frozenset:
+    """Attribute names a filter condition reads — the pushdown legality
+    input (plan/optimizer.py): every referenced name must pass through
+    the crossed operators with identical values."""
+    return frozenset(v.attribute for v in A.walk_expressions(e)
+                     if isinstance(v, A.Variable))
+
+
+def selector_sig(selector: A.Selector) -> str:
+    """Canonical signature of a non-aggregating selector (projection):
+    output names + canonical expressions + having + gating are all part
+    of the identity — group-by/order/offset/limit included so two
+    projections share ONLY when every output-shaping clause matches."""
+    from ..ops.selector import output_attribute_name
+    if selector.select_all:
+        cols = "*"
+    else:
+        cols = ",".join(
+            f"{output_attribute_name(oa, i)}="
+            f"{canonical_expr(oa.expression)}"
+            for i, oa in enumerate(selector.attributes))
+    gb = ",".join(canonical_expr(g) for g in (selector.group_by or []))
+    order = ",".join(f"{canonical_expr(ob.variable)}:{ob.order}"
+                     for ob in (selector.order_by or []))
+    return (f"select({cols})having({canonical_expr(selector.having)})"
+            f"groupby({gb})order({order})"
+            f"lim({selector.limit!r},{selector.offset!r})")

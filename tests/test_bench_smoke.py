@@ -210,6 +210,70 @@ def test_bench_tenants_quick_parses():
     assert slo["skew"] > 1
 
 
+def test_bench_fanout_quick_parses():
+    """Plan-optimizer config (ROADMAP item 5): optimized vs
+    SIDDHI_TPU_OPT=0 events/s for the 1-stream -> 4-subscriber shape,
+    with the fan-out fusion decision + CSE share classes recorded in
+    the plan block. The speedup VALUE is not asserted: on a 1-core CPU
+    host the shared packed-buffer encode bounds the gap (the multichip
+    host_device_shim honesty pattern); >=2x is read off the TPU-tunnel
+    bench round where the per-dispatch floor dominates."""
+    d = _run_config("fanout")
+    assert d["unit"] == "events/s"
+    assert d["value"] > 0
+    assert d["optimized_eps"] > 0 and d["unoptimized_eps"] > 0
+    assert d["opt_speedup"] > 0
+    assert d["subscribers"] == 4
+    assert d["compile_ms"] > 0 and d["ttfr_ms"] > 0
+    _assert_plan(d)
+    # the plan block records WHAT the optimizer did: the fused group
+    # with its cause slug, and the shared-prefix classes
+    fan = d["plan"]["decisions"]["optimizer"]["fanout"]["S"]
+    assert fan["fused"] is True
+    assert fan["cause"] in ("fused-default", "cost-evidence-fused")
+    assert fan["members"] == ["q1", "q2", "q3", "q4"]
+    assert any(set(c["queries"]) >= {"q1", "q2"} for c in fan["cse"])
+    # cost attribution of the optimized run: ONE fanout center
+    _assert_breakdown(d, top_kind="fanout")
+    assert d["stage_breakdown"]["steps"][0]["step"] == "fanout/S"
+
+
+def test_bench_diff_gate_on_optimizer_flip(tmp_path):
+    """An OPTIMIZER decision flip (SIDDHI_TPU_OPT=0 plan vs the
+    measured optimized plan) is a plan change: tools/bench_diff.py
+    exits 1 without --allow-plan-change even when throughput is
+    unchanged — the same gate the kernel-flip case trips."""
+    if str(TOOLS) not in sys.path:
+        sys.path.insert(0, str(TOOLS))
+    import bench_diff
+    d = _run_config("fanout")      # memoized: shares the fanout child
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps({"config": "fanout", **d}) + "\n")
+
+    # derive the REAL unoptimized plan in-process (not a doctored hash)
+    sys.path.insert(0, os.path.dirname(BENCH))
+    import bench
+    os.environ["SIDDHI_TPU_OPT"] = "0"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        from siddhi_tpu import SiddhiManager
+        rt = SiddhiManager().create_siddhi_app_runtime(bench.FANOUT_APP)
+        rt.start()
+        plan0 = {"plan_hash": rt.explain(live=False)["plan_hash"],
+                 "decisions": rt.explain(live=False)["decisions"]}
+        rt.shutdown()
+    finally:
+        os.environ.pop("SIDDHI_TPU_OPT", None)
+    assert plan0["plan_hash"] != d["plan"]["plan_hash"], \
+        "optimizer flip must move the plan hash"
+    flipped = copy.deepcopy(d)
+    flipped["plan"] = plan0
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps({"config": "fanout", **flipped}) + "\n")
+    assert bench_diff.main([str(a), str(b)]) == 1
+    assert bench_diff.main([str(a), str(b), "--allow-plan-change"]) == 0
+
+
 def test_bench_diff_gate(tmp_path):
     """tools/bench_diff.py regression gate: a --quick run diffed
     against itself exits 0; a doctored copy (halved events/s + flipped

@@ -47,10 +47,25 @@ CHAIN_APP = """
 """
 
 
-def _start(ql):
-    rt = SiddhiManager().create_siddhi_app_runtime(ql)
-    rt.start()
-    return rt
+def _start(ql, fanout_fusion=True):
+    # fanout_fusion=False pins SIDDHI_TPU_OPT_FANOUT=0: CHAIN_APP fans
+    # S out to q1+q2, which the plan optimizer fuses into ONE
+    # `fanout/S` center by default — tests that specifically exercise
+    # PER-QUERY dispatch centers opt out (the fused center itself is
+    # covered in tests/test_optimizer.py)
+    prev = os.environ.get("SIDDHI_TPU_OPT_FANOUT")
+    if not fanout_fusion:
+        os.environ["SIDDHI_TPU_OPT_FANOUT"] = "0"
+    try:
+        rt = SiddhiManager().create_siddhi_app_runtime(ql)
+        rt.start()
+        return rt
+    finally:
+        if not fanout_fusion:
+            if prev is None:
+                os.environ.pop("SIDDHI_TPU_OPT_FANOUT", None)
+            else:
+                os.environ["SIDDHI_TPU_OPT_FANOUT"] = prev
 
 
 def _send_join_traffic(rt, n=1024, chunks=4, n_syms=64, seed=0):
@@ -143,7 +158,7 @@ class TestCostReport:
             assert s["p99_ms"] >= s["p95_ms"] >= s["p50_ms"] >= 0
 
     def test_fused_chain_is_one_center_with_members(self):
-        rt = _start(CHAIN_APP)
+        rt = _start(CHAIN_APP, fanout_fusion=False)
         # q1 -> M has one subscriber? CHAIN_APP's q2 reads S, so both
         # queries dispatch separately: use per-query centers here
         rt.cost_start(every=1)
@@ -216,7 +231,7 @@ class TestCostReport:
             assert s["samples"] == 2, s
 
     def test_registry_histograms_and_statistics_view(self):
-        rt = _start(CHAIN_APP)
+        rt = _start(CHAIN_APP, fanout_fusion=False)
         rt.cost_start(every=1)
         h = rt.get_input_handler("S")
         h.send_arrays(TS0 + np.arange(64, dtype=np.int64),
@@ -240,7 +255,7 @@ class TestCostPersistence:
     def test_save_merges_and_load_roundtrips(self, tmp_path):
         from siddhi_tpu.obs.costmodel import load_costs
         path = str(tmp_path / "costs.json")
-        rt = _start(CHAIN_APP)
+        rt = _start(CHAIN_APP, fanout_fusion=False)
         rt.cost_start(every=1)
         h = rt.get_input_handler("S")
         h.send_arrays(TS0 + np.arange(64, dtype=np.int64),
@@ -272,7 +287,7 @@ class TestCostPersistence:
 
 
 def test_trace_export_carries_cost_annotations(tmp_path):
-    rt = _start(CHAIN_APP)
+    rt = _start(CHAIN_APP, fanout_fusion=False)
     rt.cost_start(every=1)
     rt.trace_start()
     h = rt.get_input_handler("S")

@@ -4,6 +4,8 @@ message, instead of surfacing later as runtime shape errors; valid plans
 (including implicit insert-into streams, partitions with inner streams,
 patterns, joins) must pass untouched.
 """
+import pathlib
+
 import pytest
 
 from siddhi_tpu.analysis.plan_rules import validate_app
@@ -400,3 +402,51 @@ def test_unknown_placeholder_type_is_a_parse_error():
         parse("define stream S (p double);\n"
               "from S[p > ${x:decimal}] select p insert into Out;",
               template=True)
+
+
+# -- shareable-prefix (plan/optimizer.py CSE advisory) ----------------------
+
+SHARE_FIXTURE = (pathlib.Path(__file__).parent / "lint_fixtures" /
+                 "shareable_prefix.siddhi")
+
+
+def test_shareable_prefix_flags_when_optimizer_disabled(monkeypatch):
+    """Identical leading filter prefixes on one stream are an advisory
+    WARNING exactly when the optimizer that would share them is off
+    (SIDDHI_TPU_OPT=0) — the same canonical-signature detector the CSE
+    pass uses (plan/canon.py)."""
+    monkeypatch.setenv("SIDDHI_TPU_OPT", "0")
+    app = parse(SHARE_FIXTURE.read_text())
+    issues = [i for i in validate_app(app) if i.code == "shareable-prefix"]
+    assert len(issues) == 1
+    assert issues[0].severity == "warning"
+    assert "q1" in issues[0].where and "q2" in issues[0].where
+    assert "q3" not in issues[0].where      # different filter: clean
+    assert "SIDDHI_TPU_OPT" in issues[0].message
+
+
+def test_shareable_prefix_respects_cse_switch(monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_OPT_CSE", "0")
+    app = parse(SHARE_FIXTURE.read_text())
+    assert any(i.code == "shareable-prefix" for i in validate_app(app))
+
+
+def test_shareable_prefix_silent_when_optimizer_enabled(monkeypatch):
+    monkeypatch.delenv("SIDDHI_TPU_OPT", raising=False)
+    monkeypatch.delenv("SIDDHI_TPU_OPT_CSE", raising=False)
+    app = parse(SHARE_FIXTURE.read_text())
+    assert not any(i.code == "shareable-prefix"
+                   for i in validate_app(app))
+
+
+def test_shareable_prefix_canonicalizes_commutativity(monkeypatch):
+    """`v > 3 and p > 0.5` and `p > 0.5 and v > 3` canonicalize equal
+    (three-valued AND is commutative) — the rule flags them as one
+    shareable prefix."""
+    monkeypatch.setenv("SIDDHI_TPU_OPT", "0")
+    app = parse("""
+        define stream S (v int, p double);
+        from S[v > 3 and p > 0.5] select v insert into A;
+        from S[p > 0.5 and 3 < v] select p insert into B;
+    """)
+    assert any(i.code == "shareable-prefix" for i in validate_app(app))
