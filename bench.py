@@ -691,6 +691,91 @@ def _run_tenant_slo(n_tenants: int, rows: int, batch_max: int,
     }
 
 
+def _run_tenant_fairness(rows: int, batch_max: int, skew: int = 8):
+    """Skewed-traffic FAIRNESS arm (docs/serving.md "QoS dials"): one
+    hot tenant at ``skew``x, measured three ways — fair traffic (no
+    hot), skew with QoS OFF (the pre-QoS fixed round), and skew with
+    QoS ON (hot rate-limited, tenants split into high/normal/low
+    priority classes). Reports the starved (cold normal-class)
+    tenant's p99 under each arm, the 2x-of-fair bound, the per-class
+    drain order, and the hot tenant's throttled_429s + Retry-After —
+    the ROADMAP item 2 fairness acceptance, recorded per round."""
+    from siddhi_tpu.serving import AdmissionError, TemplateRegistry
+    rows = min(rows, 512)
+
+    def run(hot: bool, qos: bool):
+        reg = TemplateRegistry(SiddhiManager())
+        tenant_qos = {
+            "hi": {"priority": "high"}, "cold": {},
+            "lo": {"priority": "low"},
+        } if qos else {"hi": None, "cold": None, "lo": None}
+        pool = reg.pool(TENANT_TEMPLATE, warm=False, slots=4,
+                        max_tenants=4, batch_max=batch_max,
+                        name=f"fair_{int(hot)}{int(qos)}",
+                        slo={"p99_ms": 1000.0, "target": 0.99,
+                             "every": 1})
+        for tid, q in tenant_qos.items():
+            pool.add_tenant(tid, _tenant_bindings(1), qos=q)
+        if hot:
+            hot_q = {"rate_eps": float(rows),
+                     "burst": float(rows * skew)} if qos else None
+            pool.add_tenant("hot", _tenant_bindings(0), qos=hot_q)
+        ts, cols = _tenant_data(rows)
+        throttled, retry_after = 0, None
+        if hot:
+            hot_ts, hot_cols = _tenant_data(rows * skew, seed=13)
+            pool.send("hot", hot_ts, hot_cols)
+            if qos:
+                try:    # the re-flood: over the bucket rate -> 429
+                    pool.send("hot", hot_ts, hot_cols)
+                except AdmissionError as exc:
+                    throttled += 1
+                    retry_after = exc.saturation.get("retry_after_ms")
+        for tid in ("hi", "cold", "lo"):
+            pool.send(tid, ts, cols)
+        drained_at = {}
+        rounds = 0
+        while pool.pump():
+            rounds += 1
+            pending = pool.statistics()["tenants"]
+            for tid in ("hi", "cold", "lo"):
+                if tid not in drained_at and \
+                        pending[tid]["pending"] == 0:
+                    drained_at[tid] = rounds
+        rep = pool.slo_report()
+        starved = rep["scopes"].get("tenant=cold", {}).get("p99_ms")
+        pool.shutdown()
+        return starved, drained_at, throttled, retry_after
+
+    p99_fair, _d0, _t0, _r0 = run(hot=False, qos=False)
+    p99_noqos, _d1, _t1, _r1 = run(hot=True, qos=False)
+    p99_qos, drained, throttled, retry_after = run(hot=True, qos=True)
+    # same-round ties (enough batch budget for both classes) break by
+    # class rank — the report answers "who drained first"
+    rank = {"hi": 0, "cold": 1, "lo": 2}
+    order = sorted(drained, key=lambda t: (drained[t], rank[t]))
+    bounded = None
+    if p99_fair is not None and p99_qos is not None:
+        # the acceptance bound, with a CPU-noise floor: a sub-ms p99
+        # pair must not flap the bench on scheduler jitter
+        bounded = p99_qos <= max(2.0 * p99_fair, p99_fair + 50.0)
+    return {
+        "skew": skew,
+        "rows_per_cold_tenant": rows,
+        "starved_p99_ms_fair": p99_fair,
+        "starved_p99_ms_noqos": p99_noqos,
+        "starved_p99_ms_qos": p99_qos,
+        "p99_bounded": bounded,
+        "throttled_429s": throttled,
+        "retry_after_ms": retry_after,
+        "class_drain_order": [
+            {"hi": "high", "cold": "normal", "lo": "low"}[t]
+            for t in order],
+        "drain_rounds": {t: drained.get(t) for t in
+                         ("hi", "cold", "lo")},
+    }
+
+
 def bench_tenants():
     """Multi-tenant serving acceptance (ROADMAP item 2): N tenants of
     ONE filter+window template as a vmapped TenantPool vs N separate
@@ -698,7 +783,10 @@ def bench_tenants():
     pool's one-program-set compile story; the headline value is the
     pooled aggregate events/s at the largest N. The ``slo`` block is
     the skewed-traffic SLO arm: p50/p99 attainment vs the configured
-    objective with one hot tenant (docs/observability.md)."""
+    objective with one hot tenant (docs/observability.md). The
+    ``fairness`` block is the QoS acceptance: hot tenant at 8x with
+    and without QoS — starved-tenant p99 vs the 2x-of-fair bound,
+    per-class drain order, throttled_429s (docs/serving.md)."""
     n_list = [int(x) for x in
               _env("SIDDHI_BENCH_TENANTS", "64,256,1024").split(",")
               if x.strip()]
@@ -729,6 +817,7 @@ def bench_tenants():
             "rounds": pooled["rounds"],
         }
     slo_arm = _run_tenant_slo(min(n_list), rows, batch_max)
+    fairness = _run_tenant_fairness(rows, batch_max)
     n_max = max(n_list)
     head = per_n[n_max]
     return {
@@ -744,6 +833,7 @@ def bench_tenants():
         "tenants": {str(n): per_n[n] for n in n_list},
         "plan": plan,
         "slo": slo_arm,
+        "fairness": fairness,
     }
 
 

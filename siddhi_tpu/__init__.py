@@ -56,6 +56,7 @@ from .resilience import (  # noqa: E402
     FaultInjector,
     FileSystemErrorStore,
     InMemoryErrorStore,
+    PoolCheckpointSupervisor,
 )
 from .serving import (  # noqa: E402
     AdmissionError,
@@ -79,6 +80,7 @@ __all__ = [
     "InMemoryErrorStore",
     "InMemoryPersistenceStore",
     "PersistenceStore",
+    "PoolCheckpointSupervisor",
     "QueryCallback",
     "SiddhiManager",
     "StreamCallback",

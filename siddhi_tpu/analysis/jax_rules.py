@@ -23,6 +23,7 @@ Every rule reports ``file:line`` anchors and can be silenced with
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator
 
 from .findings import ERROR, WARNING, Finding
@@ -441,6 +442,93 @@ def cross_shard_transfer_hazard(ctx: ModuleContext) -> Iterator[Finding]:
             "on a mesh this gathers a shard across devices per "
             "iteration; hoist ONE pytree device_get out of the loop or "
             "read per-device addressable_shards")
+
+
+# ---------------------------------------------------------------------
+# rule: unbounded-retry
+# ---------------------------------------------------------------------
+
+# exception type names that mark a handler as a transport-retry path
+# (the reconnect loops in core/io.py); a generic `except Exception`
+# keep-serving loop is NOT a retry loop and stays out of scope
+_RETRY_EXC_RE = re.compile(r"Connection|Unavailable|Timeout|Retry",
+                           re.I)
+
+
+def _is_retry_handler(handler: ast.ExceptHandler) -> bool:
+    types = []
+    t = handler.type
+    if isinstance(t, ast.Tuple):
+        types = list(t.elts)
+    elif t is not None:
+        types = [t]
+    for x in types:
+        name = x.attr if isinstance(x, ast.Attribute) else \
+            x.id if isinstance(x, ast.Name) else ""
+        if name and _RETRY_EXC_RE.search(name):
+            return True
+    return False
+
+
+def _has_backoff_call(node: ast.AST) -> bool:
+    """A sleep/backoff inside the loop body: time.sleep(...), any
+    .sleep(...) method, or a BackoffRetryCounter-style .next_wait_s()."""
+    for sub in ast.walk(node):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Attribute) and f.attr in ("sleep",
+                                                       "next_wait_s"):
+            return True
+        if isinstance(f, ast.Name) and f.id == "sleep":
+            return True
+    return False
+
+
+@register(
+    "unbounded-retry", WARNING,
+    "a while-True reconnect/retry loop with neither an attempt cap nor "
+    "a backoff sleep hammers a dead transport and, fleet-wide, "
+    "synchronizes into a retry storm; bound the attempts or back off "
+    "with jitter (core/io.py BackoffRetryCounter)")
+def unbounded_retry(ctx: ModuleContext) -> Iterator[Finding]:
+    """Flags ``while True`` loops whose except handler catches a
+    transport-flavored exception (Connection*/…Unavailable/Timeout/
+    Retry) and then loops straight back around: no ``raise``/``break``/
+    ``return`` anywhere in the handler (the attempt-cap exit) AND no
+    sleep/backoff call anywhere in the loop body. The sanctioned shapes
+    — ``attempt >= max_tries: raise`` plus
+    ``time.sleep(backoff.next_wait_s())`` (core/io.py) — pass on both
+    counts; a loop whose test is a real condition (``while attempt <
+    n``) is bounded by construction and out of scope."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.While):
+            continue
+        test = node.test
+        if not (isinstance(test, ast.Constant) and test.value):
+            continue   # a conditional loop bounds itself
+        if _has_backoff_call(node):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Try):
+                continue
+            for handler in sub.handlers:
+                if not _is_retry_handler(handler):
+                    continue
+                bounded = any(isinstance(x, (ast.Raise, ast.Break,
+                                             ast.Return))
+                              for h in [handler]
+                              for x in ast.walk(h))
+                if bounded:
+                    continue
+                yield _finding(
+                    "unbounded-retry", WARNING, ctx, handler,
+                    "retry/reconnect loop without an attempt cap or a "
+                    "backoff call — the handler swallows "
+                    f"'{_src(handler.type) if handler.type else 'all'}' "
+                    "and loops straight back; raise after a bounded "
+                    "number of attempts or sleep a jittered backoff "
+                    "(BackoffRetryCounter.next_wait_s)")
 
 
 # ---------------------------------------------------------------------

@@ -16,6 +16,7 @@ ingestion. Custom transports register through the extension SPI as
 from __future__ import annotations
 
 import logging
+import random
 import threading
 import time
 from typing import Any, Callable, Optional
@@ -55,9 +56,35 @@ def _on_error_opts(options: dict, valid: tuple, default_attempts: int,
     return action, attempts, base, cap
 
 
+_backoff_rng_lock = threading.Lock()
+_backoff_rng = random.Random()
+
+
+def set_backoff_rng(rng) -> "random.Random":
+    """Install the RNG the backoff jitter draws from; returns the
+    previous one. FaultInjector seeds this on entry (and restores it on
+    exit) so chaos runs reproduce their exact retry schedule from the
+    seed; outside a chaos harness the default unseeded Random gives
+    every process its own jitter stream."""
+    global _backoff_rng
+    with _backoff_rng_lock:
+        prev = _backoff_rng
+        _backoff_rng = rng if rng is not None else random.Random()
+        return prev
+
+
 class BackoffRetryCounter:
-    """Exponential backoff: 5ms, 10ms, ..., capped at 1s (the reference
-    steps seconds; scaled down so tests run fast)."""
+    """Exponential backoff with FULL JITTER: each wait is uniform in
+    (0, min(base * 2^n, cap)] instead of the deterministic ceiling
+    (the reference steps fixed seconds; scaled down so tests run fast).
+
+    The jitter is the point, not a nicety: when a shared transport dies,
+    every sink/source hits its backoff schedule at the same instant — a
+    deterministic schedule re-synchronizes ALL of them into one retry
+    storm at each boundary, while full jitter spreads the reconnects
+    uniformly across the window (tests/test_resilience.py asserts the
+    spread). Deterministic under FaultInjector via ``set_backoff_rng``.
+    """
 
     def __init__(self, base_ms: int = 5, cap_ms: int = 1000):
         self.base_ms = base_ms
@@ -65,9 +92,13 @@ class BackoffRetryCounter:
         self._n = 0
 
     def next_wait_s(self) -> float:
-        w = min(self.base_ms * (2 ** self._n), self.cap_ms) / 1000.0
+        ceiling = min(self.base_ms * (2 ** self._n), self.cap_ms)
         self._n += 1
-        return w
+        with _backoff_rng_lock:
+            u = _backoff_rng.random()
+        # (0, ceiling]: never a zero sleep — a 0 wait would busy-spin
+        # the reconnect loop against a dead transport
+        return ceiling * (1.0 - u) / 1000.0
 
     def reset(self) -> None:
         self._n = 0

@@ -158,9 +158,39 @@ class SiddhiService:
                         return self._send(200, service.tenant_ingest(
                             parts[4], parts[5], self._json_body()))
                     except AdmissionError as e:
-                        # per-tenant backlog backpressure -> 429 with
-                        # the saturation cause + Retry-After estimate
+                        # per-tenant backlog backpressure OR the QoS
+                        # rate limiter -> 429 with the saturation cause
+                        # + Retry-After estimate (cause `rate-limited`
+                        # carries the token bucket's own accrual time)
                         return self._send_429(e)
+                    except KeyError as e:
+                        return self._send(404, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 — to client
+                        return self._send(400, {"error": str(e)})
+                if self.path.startswith("/siddhi/tenant/replay/"):
+                    # re-deliver a pool's error-store backlog through
+                    # the owning slots, original-timestamp order
+                    # (docs/resilience.md "Pool recovery")
+                    parts = self.path.split("/")
+                    if len(parts) not in (5, 6):
+                        return self._send(404, {"error": "not found"})
+                    try:
+                        return self._send(200, service.tenant_replay(
+                            parts[4],
+                            parts[5] if len(parts) == 6 else None))
+                    except KeyError as e:
+                        return self._send(404, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 — to client
+                        return self._send(400, {"error": str(e)})
+                if self.path.startswith("/siddhi/tenant/recover/"):
+                    # crash recovery hook: newest restorable revision
+                    # onto the pool + error-backlog replay
+                    parts = self.path.split("/")
+                    if len(parts) != 5:
+                        return self._send(404, {"error": "not found"})
+                    try:
+                        return self._send(200,
+                                          service.tenant_recover(parts[4]))
                     except KeyError as e:
                         return self._send(404, {"error": str(e)})
                     except Exception as e:  # noqa: BLE001 — to client
@@ -351,13 +381,16 @@ class SiddhiService:
         pool_conf = dict(body.get("pool") or {})
         pool_kwargs = {k: pool_conf[k] for k in
                        ("slots", "max_tenants", "state_quota_bytes",
-                        "batch_max", "pending_cap", "slo")
+                        "batch_max", "pending_cap", "slo", "qos")
                        if k in pool_conf}
         pool = self.templates.pool(template,
                                    shared=body.get("shared"),
                                    **pool_kwargs)
         pool.start()   # fair-batching drain worker (idempotent)
-        slot = pool.add_tenant(str(tenant), body.get("bindings"))
+        # body `qos`: per-tenant dials (weight / priority / rate_eps /
+        # burst) merged over the pool defaults (docs/serving.md)
+        slot = pool.add_tenant(str(tenant), body.get("bindings"),
+                               qos=body.get("qos"))
         return {"status": "deployed", "app": pool.name,
                 "tenant": str(tenant), "slot": slot,
                 "template": pool.template.key, "ready": pool.ready,
@@ -407,6 +440,31 @@ class SiddhiService:
             cols.append(np.asarray(vals, dtype=np_dtype(t)))
         pool.send(tenant, np.asarray(ts, dtype=np.int64), cols)
         return {"accepted": len(rows)}
+
+    def tenant_replay(self, pool_name: str,
+                      tenant: Optional[str] = None) -> dict:
+        """``POST /siddhi/tenant/replay/<pool>[/<tid>]``: drain the
+        pool's (or one tenant's) error-store partitions and re-deliver
+        through the owning slots in original-timestamp order
+        (TenantPool.replay_errors; the PR 9 replay contract)."""
+        pool = self._pool(pool_name)
+        replayed = pool.replay_errors(tenant)
+        return {"status": "replayed", "pool": pool_name,
+                "replayed": replayed,
+                "total": sum(replayed.values())}
+
+    def tenant_recover(self, pool_name: str) -> dict:
+        """``POST /siddhi/tenant/recover/<pool>``: restore the newest
+        restorable whole-pool revision from the persistence store, then
+        replay the error backlog (resilience/supervisor.py
+        PoolCheckpointSupervisor.recover)."""
+        from ..resilience.supervisor import PoolCheckpointSupervisor
+        pool = self._pool(pool_name)
+        sup = pool._checkpoint_supervisor or \
+            PoolCheckpointSupervisor(pool)
+        restored, replayed = sup.recover()
+        return {"status": "recovered", "pool": pool_name,
+                "restored": restored, "replayed": replayed}
 
     def tenant_stats(self, pool_name: str,
                      tenant: str = None) -> dict:

@@ -511,6 +511,11 @@ class ExplainReport:
             },
             "slo": pool.slo_engine.objective.as_dict()
             if pool.slo_engine.objective is not None else None,
+            # QoS dials are plan (they shape scheduling for every
+            # tenant); per-tenant weights/breaker states are live facts
+            # and never hashed (serving/qos.py)
+            "qos": pool._qos.describe() if pool._qos is not None
+            else None,
         }
         if pool.mesh is not None:
             decisions["mesh"] = {

@@ -18,10 +18,12 @@ from .errorstore import (ErroredEvent, ErrorStore, FileSystemErrorStore,
 from .faults import FaultInjector
 from .ordering import (LATE_POLICIES, ReorderBuffer, WatermarkConfig,
                        parse_lateness_ms)
-from .supervisor import CheckpointSupervisor
+from .supervisor import (CheckpointSupervisor,
+                         PoolCheckpointSupervisor)
 
 __all__ = [
     "CheckpointSupervisor",
+    "PoolCheckpointSupervisor",
     "ErroredEvent",
     "ErrorStore",
     "FaultInjector",

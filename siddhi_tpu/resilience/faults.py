@@ -45,9 +45,17 @@ class FaultInjector:
 
     # -- lifecycle --------------------------------------------------------
     def __enter__(self) -> "FaultInjector":
+        # seed the io backoff jitter: every retry schedule inside the
+        # context reproduces exactly from the injector's seed (the full-
+        # jitter backoff is otherwise process-random; core/io.py)
+        from ..core.io import set_backoff_rng
+        self._prev_backoff_rng = set_backoff_rng(
+            random.Random(self.seed * 0x9E3779B1 + 0x5EED))
         return self
 
     def __exit__(self, *exc) -> bool:
+        from ..core.io import set_backoff_rng
+        set_backoff_rng(self._prev_backoff_rng)
         self.restore_all()
         return False
 

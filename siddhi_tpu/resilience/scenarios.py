@@ -274,6 +274,334 @@ def run_disorder_equivalence(seed: int = 0, n: int = 512,
     }
 
 
+# ---------------------------------------------------------------------
+# tenant-pool scenarios (serving/pool.py + serving/qos.py +
+# PoolCheckpointSupervisor; run via tools/chaos.py --pool)
+# ---------------------------------------------------------------------
+
+POOL_TPL = """
+define stream In (v double, k long);
+@info(name='q')
+from In[v > ${lo:double}]
+select v, k
+insert into Out;
+"""
+
+
+def _pool_chunk(n: int, seed: int, base: int):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    ts = base + np.arange(n, dtype=np.int64)
+    return ts, [rng.uniform(1.0, 10.0, n),
+                np.arange(n, dtype=np.int64)]
+
+
+def run_pool_hot_tenant_flood(seed: int = 0, batch_max: int = 16,
+                              cold_rows: int = 64,
+                              skew: int = 8) -> dict:
+    """Hot-tenant flood with the QoS fairness invariant.
+
+    One hot tenant floods ``skew``x the cold tenants' traffic into a
+    QoS pool (serving/qos.py): its rate limit rejects the over-rate
+    tail with a 429 carrying the bucket's own Retry-After, and the DRR
+    scheduler keeps every backlogged cold tenant at its weighted fair
+    share per round — cold tenant c1 (weight 1.0) must drain in exactly
+    ceil(rows / batch_max) rounds no matter how deep the hot backlog
+    is, and c2 (weight 0.5) must take half of c1's rows per round while
+    both are backlogged. A fair-traffic twin run (no flood) gives the
+    p99 baseline; the flooded run's cold p99 must stay within 2x of it
+    (the ROADMAP item 2 starved-tenant bound; CPU noise floor 50 ms).
+    """
+    import math
+
+    from ..serving import AdmissionError, Template, TenantPool
+    from .. import SiddhiManager
+
+    def build(with_hot: bool):
+        pool = TenantPool(
+            Template(POOL_TPL), manager=SiddhiManager(),
+            name=f"chaospool{_fresh_topic('flood')[-3:].replace('.', '')}"
+                 f"{'h' if with_hot else 'f'}",
+            slots=4, max_tenants=4, batch_max=batch_max,
+            slo={"p99_ms": 10_000.0, "target": 0.99, "every": 1})
+        pool.add_tenant("c1", {"lo": 0.0}, qos={"weight": 1.0})
+        pool.add_tenant("c2", {"lo": 0.0}, qos={"weight": 0.5})
+        if with_hot:
+            # burst admits ONE flood chunk; the re-flood is over-rate
+            pool.add_tenant("hot", {"lo": 0.0},
+                            qos={"rate_eps": 10.0,
+                                 "burst": float(cold_rows * skew)})
+        return pool
+
+    faults = [{"fault": "hot_tenant_flood", "seed": seed,
+               "skew": skew, "rows": cold_rows * skew}]
+
+    def drive(pool, with_hot: bool):
+        base = 1_000_000
+        if with_hot:
+            ts, cols = _pool_chunk(cold_rows * skew, seed + 1, base)
+            pool.send("hot", ts, cols)
+        for tid, s in (("c1", seed + 2), ("c2", seed + 3)):
+            ts, cols = _pool_chunk(cold_rows, s, base)
+            pool.send(tid, ts, cols)
+        throttled = 0
+        retry_after = None
+        if with_hot:
+            try:   # the 8x re-flood: over the bucket rate -> 429
+                ts, cols = _pool_chunk(cold_rows * skew, seed + 4,
+                                       base + 1_000_000)
+                pool.send("hot", ts, cols)
+            except AdmissionError as exc:
+                throttled = 1
+                retry_after = exc.saturation.get("retry_after_ms")
+        # drain through fair rounds, recording per-round takes
+        takes_per_round = []
+        while True:
+            before = dict(pool._pending_rows)
+            if pool.pump() == 0:
+                break
+            after = pool._pending_rows
+            takes_per_round.append(
+                {tid: before.get(tid, 0) - after.get(tid, 0)
+                 for tid in before})
+        rep = pool.slo_report()
+        cold_p99 = [e.get("p99_ms") for k, e in rep["scopes"].items()
+                    if k in ("tenant=c1", "tenant=c2")
+                    and e.get("p99_ms") is not None]
+        stats = pool.statistics()
+        pool.shutdown()
+        return (takes_per_round, max(cold_p99) if cold_p99 else None,
+                throttled, retry_after, stats)
+
+    _t_fair, p99_fair, _th0, _ra0, _s0 = drive(build(False), False)
+    takes, p99_flood, throttled, retry_after, stats = \
+        drive(build(True), True)
+
+    c1_rounds = sum(1 for t in takes if t.get("c1", 0) > 0)
+    expected_rounds = math.ceil(cold_rows / batch_max)
+    # while BOTH colds are backlogged, DRR holds the 2:1 weight ratio
+    ratio_ok = all(
+        t["c1"] == 2 * t["c2"]
+        for t in takes if t.get("c1", 0) > 0 and t.get("c2", 0) > 0)
+    hot_progress = sum(t.get("hot", 0) for t in takes)
+    p99_bounded = (p99_fair is None or p99_flood is None
+                   or p99_flood <= max(2.0 * p99_fair, p99_fair + 50.0))
+    return {
+        "throttled_429s": throttled,
+        "retry_after_ms": retry_after,
+        "cold_drain_rounds": c1_rounds,
+        "cold_drain_rounds_expected": expected_rounds,
+        "weights_held": ratio_ok,
+        "hot_rows_dispatched": hot_progress,
+        "cold_p99_fair_ms": p99_fair,
+        "cold_p99_flood_ms": p99_flood,
+        "p99_bounded": p99_bounded,
+        "qos": stats["qos"]["throttled_429s"],
+        "faults": faults,
+    }
+
+
+def run_pool_breaker_trip_recover(seed: int = 0,
+                                  threshold: int = 3) -> dict:
+    """Per-tenant circuit breaker: trip OPEN, short-circuit, half-open
+    probe, recover, replay — zero loss.
+
+    Tenant a's callback fails every delivery until healed; after
+    ``threshold`` consecutive failures the breaker trips OPEN and the
+    following rounds short-circuit a's rows to its error partition
+    WITHOUT invoking the callback (the invocation counter freezes).
+    After the cooldown the HALF_OPEN probe runs against the healed
+    callback, the breaker closes, and ``replay_errors`` re-delivers the
+    stored backlog in original-timestamp order. Tenant b is never
+    disturbed. Zero loss: every row emitted for a is eventually
+    delivered exactly from the store or live."""
+    import time as _time
+
+    from ..serving import Template, TenantPool
+    from .. import SiddhiManager
+
+    reset_ms = 150
+    pool = TenantPool(
+        Template(POOL_TPL), manager=SiddhiManager(),
+        slots=2, max_tenants=2, batch_max=16,
+        qos={"breaker_failures": threshold,
+             "breaker_reset_ms": reset_ms})
+    calls = {"n": 0}
+    healed = {"on": False}
+    got_a, got_b = [], []
+
+    def flaky(events):
+        calls["n"] += 1
+        if not healed["on"]:
+            raise RuntimeError(f"injected callback failure "
+                               f"(call {calls['n']}, seed={seed})")
+        got_a.extend(events)
+
+    pool.add_tenant("a", {"lo": 0.0})
+    pool.add_tenant("b", {"lo": 0.0})
+    pool.add_callback("a", flaky)
+    pool.add_callback("b", got_b.extend)
+    faults = [{"fault": "break_callback", "seed": seed,
+               "times": None, "tenant": "a"}]
+
+    states = []
+
+    def observe():
+        st = pool.statistics()
+        states.append(st["tenants"]["a"]["qos"]["breaker"])
+        return st
+
+    sent_a = 0
+    # phase 1: trip — `threshold` failing rounds flip CLOSED -> OPEN
+    for r in range(threshold):
+        ts, cols = _pool_chunk(4, seed + r, 1_000_000 + 1000 * r)
+        pool.send("a", ts, cols)
+        pool.send("b", ts, cols)
+        sent_a += 4
+        pool.flush()
+    observe()
+    calls_at_trip = calls["n"]
+    # phase 2: short-circuit — inside the cooldown the callback must
+    # NOT run; rows land straight in the error partition
+    for r in range(2):
+        ts, cols = _pool_chunk(4, seed + 10 + r,
+                               2_000_000 + 1000 * r)
+        pool.send("a", ts, cols)
+        sent_a += 4
+        pool.flush()
+    observe()
+    calls_after_short = calls["n"]
+    # phase 3: heal + cooldown elapse -> HALF_OPEN probe succeeds
+    healed["on"] = True
+    _time.sleep(reset_ms / 1000.0 + 0.05)
+    ts, cols = _pool_chunk(4, seed + 20, 3_000_000)
+    pool.send("a", ts, cols)
+    sent_a += 4
+    pool.flush()
+    st = observe()
+    # phase 4: replay the stored backlog in original-timestamp order
+    live = len(got_a)                  # the probe round's delivery
+    replayed = pool.replay_errors("a").get("a", 0)
+    final = pool.statistics()
+    pool.shutdown()
+    # the replayed suffix of a's deliveries must be nondecreasing in
+    # ORIGINAL timestamp (the PR 9 contract) even though the store
+    # accumulated across failing rounds AND short-circuited rounds
+    replay_seq = [e.timestamp for e in got_a[live:]]
+    delivered = len(got_a)
+    return {
+        "states": states,
+        "tripped": states[0] == "OPEN",
+        "short_circuited_without_calls":
+            calls_after_short == calls_at_trip
+            and final["qos"]["short_circuited"] >= 8,
+        "closed_after_probe": st["tenants"]["a"]["qos"]["breaker"]
+        == "CLOSED",
+        "replayed": replayed,
+        "sent": sent_a,
+        "delivered": delivered,
+        "lost": sent_a - delivered,
+        "replay_in_ts_order": bool(replay_seq)
+        and replay_seq == sorted(replay_seq),
+        "b_undisturbed": len(got_b) == threshold * 4,
+        "trips": final["qos"]["tenants"]["a"]["breaker"]["trips"],
+        "faults": faults,
+    }
+
+
+def run_pool_kill_mid_round(seed: int = 0) -> dict:
+    """Kill-pool-mid-round, then crash-consistent recovery.
+
+    A supervised pool (checkpoint every 2 rounds) serves three tenants;
+    tenant c's callback is dead, so its output accumulates in its error
+    partition. The process "crashes" right after an un-checkpointed
+    round (the pool object is abandoned mid-flight, no shutdown). A
+    FRESH pool of the same template on the same manager recovers:
+    newest revision restored, surviving tenants' per-tenant snapshots
+    BIT-IDENTICAL to the pre-crash checkpoint, c's error backlog
+    replayed through the healed callback in original-timestamp order,
+    and the recovery age visible in statistics()['recovery']."""
+    import jax
+    import numpy as np
+
+    from ..core.persistence import deserialize
+    from ..serving import Template, TenantPool
+    from .supervisor import PoolCheckpointSupervisor
+    from .. import InMemoryPersistenceStore, SiddhiManager
+    from .errorstore import InMemoryErrorStore
+
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(InMemoryPersistenceStore())
+    mgr.set_error_store(InMemoryErrorStore())
+    tpl = Template(POOL_TPL)
+
+    pool1 = TenantPool(tpl, manager=mgr, name="chaoskill",
+                       slots=4, max_tenants=4, batch_max=16)
+    for tid in ("a", "b", "c"):
+        pool1.add_tenant(tid, {"lo": 0.0})
+
+    def dead(_events):
+        raise RuntimeError("tenant-c sink down (injected)")
+
+    pool1.add_callback("c", dead)
+    faults = [{"fault": "break_callback", "seed": seed, "tenant": "c"},
+              {"fault": "kill_pool_mid_round", "seed": seed}]
+    sup1 = PoolCheckpointSupervisor(pool1, interval_rounds=2)
+
+    for r in range(4):   # checkpoints land after rounds 2 and 4
+        for i, tid in enumerate(("a", "b", "c")):
+            ts, cols = _pool_chunk(8, seed + r * 10 + i,
+                                   1_000_000 + r * 1000)
+            pool1.send(tid, ts, cols)
+        pool1.pump()
+    checkpoint_rev = sup1.last_revision
+    pre_crash = {tid: deserialize(pool1.snapshot_tenant(tid))
+                 for tid in ("a", "b")}
+    backlog = mgr.error_store.size(pool1.tenant_partition("c"))
+
+    # round 5 runs but is never checkpointed; the crash lands mid-round
+    for tid in ("a", "b", "c"):
+        ts, cols = _pool_chunk(8, seed + 90, 9_000_000)
+        pool1.send(tid, ts, cols)
+    pool1.pump()
+    # CRASH: pool1 is abandoned (no shutdown, no persist)
+
+    pool2 = TenantPool(tpl, manager=mgr, name="chaoskill",
+                       slots=4, max_tenants=4, batch_max=16)
+    sup2 = PoolCheckpointSupervisor(pool2)
+    restored, _ = sup2.recover(replay_errors=False)
+    got_c = []
+    pool2.add_callback("c", got_c.extend)     # healed after restart
+    replayed = pool2.replay_errors().get("c", 0)
+    stats = pool2.statistics()
+
+    identical = True
+    for tid in ("a", "b"):
+        post = deserialize(pool2.snapshot_tenant(tid))
+        f_pre, _ = jax.tree_util.tree_flatten(pre_crash[tid]["queries"])
+        f_post, _ = jax.tree_util.tree_flatten(post["queries"])
+        for x, y in zip(f_pre, f_post):
+            if not np.array_equal(np.asarray(x), np.asarray(y)):
+                identical = False
+    ts_seq = [e.timestamp for e in got_c]
+    pool2.shutdown()
+    return {
+        "checkpoint": checkpoint_rev,
+        "restored": restored,
+        "recovered_to_checkpoint": restored == checkpoint_rev,
+        "survivors_bit_identical": identical,
+        "stored_backlog": backlog,
+        "replayed": replayed,
+        "replay_in_ts_order": bool(ts_seq) and ts_seq == sorted(ts_seq),
+        "recovery_age_ms": stats.get("recovery", {}).get(
+            "recovery_age_ms"),
+        "restored_revision_visible": stats.get("recovery", {}).get(
+            "restored_revision") == restored,
+        "tenants_restored": sorted(stats["tenants"]),
+        "faults": faults,
+    }
+
+
 def run_soak(seed: int = 0, rounds: int = 5) -> list[dict]:
     """Repeat the outage scenario with per-round derived seeds and a
     seeded probabilistic drop-rate — the long-running chaos soak."""

@@ -108,3 +108,101 @@ class CheckpointSupervisor:
                 if self.error_store is not None else self.app._error_store()
             replayed = replay(self.app, estore)
         return restored, replayed
+
+
+class PoolCheckpointSupervisor:
+    """Supervises one TenantPool (serving/pool.py): periodic whole-pool
+    persists at fair-round boundaries, and crash recovery onto a FRESH
+    pool of the same template (docs/resilience.md "Pool recovery").
+
+    Pools have no scheduler thread of their own — the pool calls
+    ``on_round`` at the end of every pump() round (under the pool lock,
+    so the snapshot is consistent at the round boundary: states
+    updated, delivery not necessarily run; the per-tenant error-store
+    partitions cover the delivery tail, at-least-once). Deterministic
+    by construction: chaos tests can place a crash exactly between two
+    ``interval_rounds`` checkpoints.
+
+    Usage::
+
+        sup = PoolCheckpointSupervisor(pool, interval_rounds=4)
+        ...                                   # crash happens
+        pool2 = TenantPool(template, manager=mgr, ...)   # same manager
+        rev, replayed = PoolCheckpointSupervisor(pool2).recover()
+    """
+
+    def __init__(self, pool, interval_rounds: Optional[int] = None,
+                 interval_ms: Optional[int] = None):
+        import time
+        self.pool = pool
+        self.interval_rounds = interval_rounds
+        self.interval_ms = interval_ms
+        self.last_revision: Optional[str] = None
+        self.checkpoints = 0
+        self.failures = 0
+        self.last_checkpoint_wall: Optional[float] = None
+        self._t0 = time.time()
+        self._stopped = False
+        pool._checkpoint_supervisor = self
+
+    def on_round(self, rounds: int) -> None:
+        """Round-boundary hook (called by TenantPool.pump under the
+        pool lock — persist() re-enters the RLock safely)."""
+        if self._stopped:
+            return
+        due = bool(self.interval_rounds) and \
+            rounds % self.interval_rounds == 0
+        if not due and self.interval_ms:
+            import time
+            last = self.last_checkpoint_wall or self._t0
+            due = (time.time() - last) * 1000.0 >= self.interval_ms
+        if due:
+            self.checkpoint()
+
+    def checkpoint(self) -> Optional[str]:
+        try:
+            self.last_revision = self.pool.persist()
+            self.checkpoints += 1
+            import time
+            self.last_checkpoint_wall = time.time()
+            return self.last_revision
+        except Exception:  # noqa: BLE001 — a failed persist must not
+            # kill the serving loop; the next interval tries again
+            self.failures += 1
+            log.error("pool '%s': scheduled persist failed",
+                      self.pool.name, exc_info=True)
+            return None
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    # -- recovery ---------------------------------------------------------
+    def recover(self, replay_errors: bool = True
+                ) -> tuple[Optional[str], int]:
+        """Restore the newest restorable revision onto the pool
+        (corrupted revisions are skipped, falling back to the previous
+        one — the CheckpointSupervisor contract), then replay every
+        tenant's error-store partition in original-timestamp order (the
+        PR 9 replay contract, via TenantPool.replay_errors).
+
+        Returns (restored_revision_or_None, events_replayed)."""
+        store = self.pool.proto._persistence_store()
+        restored = None
+        for rev in reversed(store.list_revisions(self.pool.name)):
+            try:
+                self.pool.restore_revision(rev)
+                restored = rev
+                break
+            except Exception as exc:  # noqa: BLE001 — corrupt revision
+                log.warning("pool '%s': revision %s is not restorable "
+                            "(%s); falling back to the previous one",
+                            self.pool.name, rev, exc)
+        if restored is not None:
+            self.last_revision = restored
+        replayed = 0
+        if replay_errors:
+            replayed = sum(self.pool.replay_errors().values())
+            rec = getattr(self.pool, "_recovery", None)
+            if rec is not None:
+                rec["replayed"] = replayed
+        return restored, replayed

@@ -9,6 +9,8 @@ pre-staged re-configurable processing units (PAPERS.md).
 """
 from .template import Template, TemplateRegistry
 from .pool import AdmissionError, TenantPool
+from .qos import CircuitBreaker, PoolQoS, TokenBucket
 
 __all__ = ["Template", "TemplateRegistry", "TenantPool",
-           "AdmissionError"]
+           "AdmissionError", "PoolQoS", "TokenBucket",
+           "CircuitBreaker"]

@@ -51,7 +51,8 @@ from ..analysis.plan_rules import check_template_bindings
 from ..core.event import EXPIRED, EventBatch, rows_from_batch
 from ..core.runtime import (BATCH_BUCKETS, InsertIntoStreamHandler,
                             QueryRuntime, SiddhiAppRuntime, _as_current,
-                            _chain_body, _donate, bucket_capacity)
+                            _chain_body, _donate, _fresh_device,
+                            bucket_capacity)
 from ..core.stream import Event
 from ..core.types import AttrType, GLOBAL_STRINGS, np_dtype
 from ..lang import ast as A
@@ -59,6 +60,9 @@ from ..obs.slo import (EVERY_ENV as _SLO_EVERY_ENV, FlightRecorder,
                        SLOEngine, config_from_annotation as _slo_from_ann,
                        objective_from_dials)
 from ..ops.expr import CompileError
+from .qos import PoolQoS
+
+QOS_ENV = "SIDDHI_TPU_QOS"   # "0" kills the whole QoS layer
 
 log = logging.getLogger("siddhi_tpu.serving")
 
@@ -113,6 +117,7 @@ class TenantPool:
                  batch_max: Optional[int] = None,
                  pending_cap: int = _DEFAULT_PENDING_CAP,
                  slo: Optional[dict] = None,
+                 qos: Optional[dict] = None,
                  mesh=None):
         """``mesh``: optional ``jax.sharding.Mesh`` — the tenant slot
         axis then shards over its first axis (1/n of the slots per
@@ -131,6 +136,11 @@ class TenantPool:
         # dispatches vmapped variants of its operator chains and its
         # CompileService carries the pool's one-program-set telemetry
         self.proto = SiddhiAppRuntime(app_ast, manager=None)
+        # route the prototype's store lookups through the pool's
+        # manager: tenant error partitions and pool checkpoints must
+        # live in the SHARED stores so a fresh pool built after a crash
+        # (resilience/supervisor.py PoolCheckpointSupervisor) finds them
+        self.proto.manager = self.manager
         self._plan_topology()
         self._check_poolable()
 
@@ -155,6 +165,34 @@ class TenantPool:
                 batch_max = min(batch_max, q.max_step_capacity)
         self.batch_max = bucket_capacity(int(batch_max))
         self.pending_cap = int(pending_cap)
+
+        # -- QoS (serving/qos.py; docs/serving.md "QoS dials") ------------
+        # Dials merge constructor `qos={...}` over `@app:cap(...)`
+        # elements (the deployment's word wins, like slo=). With the
+        # SIDDHI_TPU_QOS=0 kill the layer is None and every call site
+        # below runs the exact pre-QoS path; with no dials configured
+        # the DRR plan is bit-identical to the fixed batch_max round.
+        qos_dials: dict = {}
+        if cap_ann is not None:
+            for el, key in (("rate.eps", "rate_eps"),
+                            ("rate.burst", "rate_burst"),
+                            ("breaker.failures", "breaker_failures"),
+                            ("breaker.reset.ms", "breaker_reset_ms"),
+                            ("qos.max.defer", "max_defer")):
+                v = cap_ann.element(el)
+                if v is not None:
+                    qos_dials[key] = float(v) if "rate" in el else int(v)
+        qos_dials.update({k: v for k, v in dict(qos or {}).items()
+                          if v is not None})
+        if os.environ.get(QOS_ENV, "1") == "0":
+            self._qos: Optional[PoolQoS] = None
+        else:
+            try:
+                self._qos = PoolQoS(
+                    qos_dials,
+                    on_transition=self._on_breaker_transition)
+            except ValueError as e:
+                raise CompileError(f"pool '{self.name}' qos: {e}")
 
         # -- mesh (slot-axis sharding over devices) -----------------------
         self.mesh = mesh
@@ -192,6 +230,8 @@ class TenantPool:
             for leaf in jax.tree_util.tree_leaves(self._states[qn]))
 
         self._tenants: dict[str, int] = {}
+        self._bindings: dict[str, dict] = {}      # tid -> bound values
+        self._tenant_qos_raw: dict[str, dict] = {}  # tid -> qos dials
         self._free = list(range(self.slots - 1, -1, -1))
         self._pending: dict[str, deque] = {}
         self._pending_rows: dict[str, int] = {}
@@ -248,6 +288,10 @@ class TenantPool:
         self._rejection_times: deque = deque(maxlen=512)
         self._last_pump_wall: Optional[float] = None
         self._round_ms_ema: Optional[float] = None
+        # crash recovery bookkeeping (resilience/supervisor.py): the
+        # supervisor registers itself here; restore() fills _recovery
+        self._checkpoint_supervisor = None
+        self._recovery: Optional[dict] = None
 
     # -- planning ---------------------------------------------------------
 
@@ -512,11 +556,14 @@ class TenantPool:
             }
 
     def add_tenant(self, tenant_id: str,
-                   bindings: Optional[dict] = None) -> int:
+                   bindings: Optional[dict] = None,
+                   qos: Optional[dict] = None) -> int:
         """Admit a tenant into a slot: validate bindings
         (template-binding rule), reset the slot's state slice, write the
-        stacked parameter values. Steady-state adds compile NOTHING —
-        only a growth doubling does."""
+        stacked parameter values. ``qos`` carries per-tenant dials
+        (weight / priority / rate_eps / burst) merged over the pool
+        defaults (docs/serving.md "QoS dials"). Steady-state adds
+        compile NOTHING — only a growth doubling does."""
         with self._lock:
             if tenant_id in self._tenants:
                 raise ValueError(
@@ -529,6 +576,8 @@ class TenantPool:
                              max_tenants=self.max_tenants)
             vals = check_template_bindings(self.proto.ast,
                                            dict(bindings or {}))
+            if self._qos is not None:
+                self._qos.add_tenant(tenant_id, qos)
             if not self._free:
                 self._grow()
             slot = self._pick_slot()
@@ -539,6 +588,8 @@ class TenantPool:
                     self._states[qn], init)
                 self._emitted[qn] = self._emitted[qn].at[slot].set(0)
             self._tenants[tenant_id] = slot
+            self._bindings[tenant_id] = dict(bindings or {})
+            self._tenant_qos_raw[tenant_id] = dict(qos or {})
             self._pending[tenant_id] = deque()
             self._pending_rows[tenant_id] = 0
             self._error_counts[tenant_id] = 0
@@ -556,6 +607,10 @@ class TenantPool:
             self._pending_rows.pop(tenant_id, None)
             self._callbacks.pop(tenant_id, None)
             self._error_counts.pop(tenant_id, None)
+            self._bindings.pop(tenant_id, None)
+            self._tenant_qos_raw.pop(tenant_id, None)
+            if self._qos is not None:
+                self._qos.remove_tenant(tenant_id)
             return True
 
     def _grow(self) -> None:
@@ -626,6 +681,19 @@ class TenantPool:
         t_arr = time.perf_counter()
         with self._lock:
             self._slot(tenant_id)
+            if self._qos is not None:
+                # token-bucket rate limit (serving/qos.py): over-rate
+                # ingest is rejected BEFORE it queues, with the
+                # bucket's own accrual time as the Retry-After hint
+                ok, retry_ms = self._qos.check_rate(tenant_id, n)
+                if not ok:
+                    self._reject(
+                        "rate-limited",
+                        f"tenant '{tenant_id}' over its ingest rate "
+                        f"limit ({n} rows rejected; retry in "
+                        f"{retry_ms} ms)",
+                        tenant=tenant_id, rows=n,
+                        retry_after_ms=retry_ms)
             if self._pending_rows[tenant_id] + n > self.pending_cap:
                 self._reject(
                     "ingest-backlog",
@@ -685,8 +753,20 @@ class TenantPool:
             stamps: dict[str, float] = {}
             taken = 0
             last_ts = self._now
+            # per-tenant take limits: the DRR/priority plan when QoS is
+            # live (serving/qos.py — all-default dials produce exactly
+            # batch_max per backlogged tenant), the fixed fair share
+            # otherwise
+            limits = None
+            if self._qos is not None:
+                limits = self._qos.plan_round(dict(self._pending_rows),
+                                              self.batch_max)
             for tid, slot in self._tenants.items():
-                got = self._take(tid, self.batch_max)
+                limit = self.batch_max if limits is None \
+                    else limits.get(tid, 0)
+                if limit <= 0:
+                    continue
+                got = self._take(tid, limit)
                 if got is None:
                     continue
                 ts_a, cols_a, t_arr = got
@@ -711,6 +791,11 @@ class TenantPool:
             terminal, qtimes = self._dispatch(batch, self._now,
                                               sample=sampled)
             self._rounds += 1
+            if self._checkpoint_supervisor is not None:
+                # periodic whole-pool checkpoint at the round boundary
+                # (state updated, delivery not yet run — the error-store
+                # replay covers the delivery tail, at-least-once)
+                self._checkpoint_supervisor.on_round(self._rounds)
             if sampled and qtimes:
                 self._slo_attribute(stamps, qtimes, taken)
             dur_ms = (time.perf_counter() - t_round0) * 1000.0
@@ -864,15 +949,67 @@ class TenantPool:
                        for tid, cbs in self._callbacks.items()
                        if tid in self._tenants]
         for tid, slot, cbs in targets:
-            for sid, out in host.items():
-                events = self._decode_slot(sid, out, slot)
-                if not events:
-                    continue
-                for cb in cbs:
-                    try:
-                        cb(events)
-                    except Exception as exc:  # noqa: BLE001 — isolate
-                        self._tenant_error(tid, sid, events, exc)
+            per_sid = [(sid, evs) for sid, evs in
+                       ((sid, self._decode_slot(sid, out, slot))
+                        for sid, out in host.items()) if evs]
+            if not per_sid:
+                continue
+            self._deliver_tenant(tid, cbs, per_sid)
+
+    def _deliver_tenant(self, tid: str, cbs: list,
+                        per_sid: list) -> None:
+        """Deliver one tenant's decoded rows through its circuit
+        breaker (serving/qos.py): OPEN short-circuits every stream's
+        events to the tenant's error-store partition WITHOUT running
+        the callback, HALF_OPEN lets exactly one probe delivery
+        through, and the delivery outcome feeds the state machine.
+        Shared by the round delivery path and replay_errors."""
+        gate = "closed"
+        if self._qos is not None:
+            with self._lock:
+                # gate() on an elapsed cooldown IS the HALF_OPEN
+                # transition, so it runs only when rows are in hand
+                gate = self._qos.breaker_gate(tid)
+        if gate == "open":
+            for sid, events in per_sid:
+                self._short_circuit(tid, sid, events)
+            return
+        failed = False
+        for sid, events in per_sid:
+            for cb in cbs:
+                try:
+                    cb(events)
+                except Exception as exc:  # noqa: BLE001 — isolate
+                    failed = True
+                    self._tenant_error(tid, sid, events, exc)
+        if self._qos is not None:
+            with self._lock:
+                self._qos.on_delivery(tid, ok=not failed)
+
+    def _short_circuit(self, tid: str, sid: str, events: list) -> None:
+        """OPEN-breaker path: the events survive in the tenant's error
+        partition (replayable) but its failing callback never runs."""
+        from ..resilience.errorstore import ErroredEvent
+        with self._lock:
+            self._qos.count_short_circuit(tid, len(events))
+            self._error_counts[tid] = \
+                self._error_counts.get(tid, 0) + len(events)
+        try:
+            self.proto._error_store().store(
+                self.tenant_partition(tid),
+                ErroredEvent.from_events(
+                    sid, events, "circuit-open: delivery short-circuited",
+                    now=self._now))
+        except Exception:  # noqa: BLE001 — isolation must not cascade
+            log.exception("pool '%s': error-store write failed for "
+                          "short-circuited tenant '%s'", self.name, tid)
+
+    def _on_breaker_transition(self, tid: str, prev: str,
+                               state: str) -> None:
+        self.flight.record("breaker-transition", tenant=tid,
+                           prev=prev, state=state)
+        log.warning("pool '%s': tenant '%s' circuit breaker %s -> %s",
+                    self.name, tid, prev, state)
 
     def _decode_slot(self, sid: str, host_out, slot: int) -> list:
         types = self.proto.junctions[sid].schema.types
@@ -1043,6 +1180,201 @@ class TenantPool:
                 self._emitted[qn] = self._emitted[qn].at[slot].set(
                     jnp.asarray(snap["emitted"]))
 
+    # -- whole-pool checkpoint / crash recovery ---------------------------
+    # (resilience/supervisor.py PoolCheckpointSupervisor drives these;
+    # docs/resilience.md "Pool recovery")
+
+    def snapshot(self) -> bytes:
+        """Whole-pool state in ONE device_get: every query's stacked
+        (slots, ...) state pytree + emitted counters (the slot-sliced
+        per-tenant machinery reads the same arrays one index at a
+        time), plus the slot map, tenant bindings, and QoS dials needed
+        to rebuild admission bookkeeping on a fresh pool."""
+        from ..core.persistence import dump_strings, serialize
+        with self._lock:
+            payload = {
+                "kind": "tenant-pool",
+                "pool": self.name,
+                "template": self.template.key,
+                "shared": dict(self.shared),
+                "slots": self.slots,
+                "now": self._now,
+                "rounds": self._rounds,
+                "tenants": {
+                    tid: {"slot": slot,
+                          "bindings": dict(self._bindings.get(tid, {})),
+                          "qos": dict(self._tenant_qos_raw.get(tid, {}))}
+                    for tid, slot in self._tenants.items()},
+                "queries": jax.device_get({
+                    qn: {"states": self._states[qn],
+                         "emitted": self._emitted[qn]}
+                    for qn in self._order}),
+                "strings": dump_strings(),
+            }
+            return serialize(payload)
+
+    def persist(self) -> str:
+        """Checkpoint to the manager's persistence store (the
+        filesystem backend writes tmp + rename, so a crash mid-persist
+        never leaves a torn revision); returns the revision id."""
+        from ..core.persistence import new_revision
+        store = self.proto._persistence_store()
+        rev = new_revision(self.name)
+        store.save(self.name, rev, self.snapshot())
+        return rev
+
+    def restore(self, data: bytes) -> None:
+        """Write a whole-pool snapshot onto THIS pool (typically a
+        fresh one built from the same template after a crash). Stacked
+        states land as fresh device buffers (``jnp.asarray`` of the
+        host snapshot — the donation-safe `_fresh_device` contract) and
+        on a mesh the placement is re-derived through the
+        parallel/sharding.py rule tables, never copied from the dead
+        process. QoS profiles are rebuilt from the snapshot's dials;
+        circuit breakers restart CLOSED (a still-dead sink re-trips
+        within `breaker.failures` rounds)."""
+        from ..core.persistence import deserialize, load_strings
+        payload = deserialize(data)
+        if payload.get("kind") != "tenant-pool":
+            raise ValueError("snapshot is not a tenant-pool snapshot")
+        if payload.get("template") != self.template.key:
+            raise ValueError(
+                f"snapshot is for template {payload.get('template')!r}, "
+                f"pool '{self.name}' runs {self.template.key!r}")
+        if dict(payload.get("shared") or {}) != self.shared:
+            raise ValueError(
+                "snapshot was taken with different shared structural "
+                "bindings — that is a different compiled program set")
+        with self._lock:
+            load_strings(payload["strings"])
+            slots = int(payload["slots"])
+            if self.mesh is not None:
+                self._sharding.check_divisible(
+                    slots, self.mesh, f"pool '{self.name}' restored slots")
+            if slots != self.slots:
+                # restored width != fresh-pool width: programs compile
+                # at the snapshot's slot count (same class of event as
+                # a growth doubling)
+                self._vsteps.clear()
+                self._warmed = False
+            self.slots = slots
+            # _fresh_device, not jnp.asarray: device_put may alias a
+            # numpy buffer ZERO-COPY, and these arrays feed DONATED
+            # step arguments on the next round (the restore
+            # double-free class, core/runtime.py)
+            self._states = {
+                qn: _fresh_device(payload["queries"][qn]["states"])
+                for qn in self._order}
+            self._emitted = {
+                qn: _fresh_device(payload["queries"][qn]["emitted"])
+                for qn in self._order}
+            if self.mesh is not None:
+                self._place_state()   # rule-table placement, re-derived
+            self._now = max(self._now, int(payload.get("now", self._now)))
+            self._rounds = int(payload.get("rounds", 0))
+            self._tenants = {}
+            self._bindings = {}
+            self._tenant_qos_raw = {}
+            self._pending = {}
+            self._pending_rows = {}
+            self._error_counts = {}
+            if self._qos is not None:
+                self._qos = PoolQoS(
+                    {k: v for k, v in (
+                        ("rate_eps", self._qos.default_rate),
+                        ("rate_burst", self._qos.default_burst),
+                        ("weight", self._qos.default_weight),
+                        ("priority", self._qos.default_priority),
+                        ("breaker_failures", self._qos.breaker_failures),
+                        ("breaker_reset_ms", self._qos.breaker_reset_ms),
+                        ("max_defer", self._qos.max_defer))
+                        if v is not None},
+                    on_transition=self._on_breaker_transition)
+            used = set()
+            for tid, entry in payload["tenants"].items():
+                slot = int(entry["slot"])
+                used.add(slot)
+                self._tenants[tid] = slot
+                self._bindings[tid] = dict(entry.get("bindings") or {})
+                self._tenant_qos_raw[tid] = dict(entry.get("qos") or {})
+                self._pending[tid] = deque()
+                self._pending_rows[tid] = 0
+                self._error_counts[tid] = 0
+                if self._qos is not None:
+                    self._qos.add_tenant(tid, self._tenant_qos_raw[tid])
+            self._free = [s for s in range(self.slots - 1, -1, -1)
+                          if s not in used]
+            self._recovery = {
+                "restored_wall": time.time(),
+                "revision": None,       # restore_revision fills it
+                "tenants": len(self._tenants),
+                "replayed": 0,
+            }
+
+    def restore_revision(self, revision: str) -> None:
+        store = self.proto._persistence_store()
+        data = store.load(self.name, revision)
+        if data is None:
+            raise KeyError(f"no revision '{revision}' for pool "
+                           f"'{self.name}'")
+        self.restore(data)
+        with self._lock:
+            self._recovery["revision"] = revision
+
+    def replay_errors(self, tenant_id: Optional[str] = None) -> dict:
+        """Drain per-tenant ``<pool>/tenant/<id>`` error partitions and
+        re-deliver through the owning slot's callbacks in
+        ORIGINAL-TIMESTAMP order (the PR 9 replay contract: the store
+        interleaves rounds out of event-time order, and a replay in
+        store order would re-introduce the disorder). Consecutive
+        same-origin runs re-deliver as one batch; deliveries go through
+        the tenant's circuit breaker, so replaying against a still-OPEN
+        breaker lands the events straight back in the partition
+        (at-least-once, nothing lost). A tenant with no callbacks keeps
+        its backlog. Returns {tenant: events_replayed}."""
+        store = self.proto._error_store()
+        with self._lock:
+            tids = list(self._tenants) if tenant_id is None \
+                else [tenant_id]
+            if tenant_id is not None:
+                self._slot(tenant_id)
+            cbs_of = {tid: list(self._callbacks.get(tid, ()))
+                      for tid in tids}
+        replayed: dict[str, int] = {}
+        for tid in tids:
+            part = self.tenant_partition(tid)
+            records = store.drain(part)
+            if not records:
+                continue
+            if not cbs_of[tid]:
+                for rec in records:     # nowhere to deliver — keep
+                    store.store(part, rec)
+                continue
+            entries = []
+            seq = 0
+            for rec in records:
+                for e in rec.to_events():
+                    entries.append((e.timestamp, seq, rec.origin, e))
+                    seq += 1
+            entries.sort(key=lambda t: (t[0], t[1]))
+            n = 0
+            batch_origin, batch = None, []
+            for _ts, _s, origin, e in entries:
+                if origin != batch_origin and batch:
+                    self._deliver_tenant(tid, cbs_of[tid],
+                                         [(batch_origin, batch)])
+                    batch = []
+                batch_origin = origin
+                batch.append(e)
+                n += 1
+            if batch:
+                self._deliver_tenant(tid, cbs_of[tid],
+                                     [(batch_origin, batch)])
+            replayed[tid] = n
+            log.info("pool '%s': replayed %d event(s) for tenant '%s' "
+                     "in original-timestamp order", self.name, n, tid)
+        return replayed
+
     # -- observability ----------------------------------------------------
 
     def statistics(self) -> dict:
@@ -1122,6 +1454,37 @@ class TenantPool:
                 "state_bytes_per_tenant": self.state_bytes_per_tenant,
             }
             saturation = self._saturation_locked()
+            qos_rep = None
+            if self._qos is not None:
+                qos_rep = self._qos.report()
+                qos_rep["throttled_429s"] = \
+                    self._rejections.get("rate-limited", 0)
+            sup = self._checkpoint_supervisor
+            recovery = None
+            if sup is not None or self._recovery is not None:
+                # recovery age is the operator's "how stale could a
+                # crash make me" number (docs/resilience.md)
+                wall = time.time()
+                recovery = {}
+                if sup is not None:
+                    recovery.update({
+                        "checkpoints": sup.checkpoints,
+                        "checkpoint_failures": sup.failures,
+                        "last_revision": sup.last_revision,
+                        "checkpoint_age_ms":
+                            round((wall - sup.last_checkpoint_wall)
+                                  * 1000.0, 1)
+                            if sup.last_checkpoint_wall else None,
+                    })
+                if self._recovery is not None:
+                    recovery.update({
+                        "restored_revision": self._recovery.get("revision"),
+                        "restored_tenants": self._recovery.get("tenants"),
+                        "replayed": self._recovery.get("replayed"),
+                        "recovery_age_ms":
+                            round((wall - self._recovery["restored_wall"])
+                                  * 1000.0, 1),
+                    })
             mesh_info = None
             if self.mesh is not None:
                 loads = self._device_loads_locked()
@@ -1157,6 +1520,12 @@ class TenantPool:
             entry = {"slot": slot, "emitted": per_q,
                      "pending": pending.get(tid, 0),
                      "errors": errors.get(tid, 0)}
+            if qos_rep is not None and tid in qos_rep["tenants"]:
+                q = qos_rep["tenants"][tid]
+                entry["qos"] = {
+                    "weight": q["weight"], "priority": q["priority"],
+                    "breaker": q.get("breaker", {}).get("state"),
+                }
             report["tenants"][tid] = entry
             base = f"{p}.tenant.{tid}"
             for key, value in (("emitted", sum(per_q.values())),
@@ -1213,6 +1582,46 @@ class TenantPool:
                 f"{p}.saturation.rejections", {"cause": cause},
                 dotted=f"{p}.saturation.rejections.{cause}",
                 help="admission rejections by saturation cause").set(n)
+        # QoS: DRR credits + breaker state per tenant as labeled gauge
+        # families (the cardinality-safe shape), plus the scheduler /
+        # breaker / throttle counters (docs/serving.md "QoS dials")
+        report["qos"] = qos_rep if qos_rep is not None \
+            else {"enabled": False}
+        if qos_rep is not None:
+            cred_fam = f"{p}.qos.credits"
+            brk_fam = f"{p}.qos.breaker_state"
+            keep_cred: set = set()
+            keep_brk: set = set()
+            state_num = {"CLOSED": 0, "HALF_OPEN": 1, "OPEN": 2}
+            for tid, q in qos_rep["tenants"].items():
+                dotted = f"{p}.qos.tenant.{tid}.credits"
+                self.metrics.labeled_gauge(
+                    cred_fam, {"tenant": tid}, dotted=dotted,
+                    help="unspent DRR scheduler credits for one tenant"
+                ).set(q["credits"])
+                keep_cred.add(dotted)
+                br = q.get("breaker")
+                if br is not None:
+                    dotted = f"{p}.qos.tenant.{tid}.breaker_state"
+                    self.metrics.labeled_gauge(
+                        brk_fam, {"tenant": tid}, dotted=dotted,
+                        help="circuit-breaker state for one tenant "
+                             "(0 closed, 1 half-open, 2 open)"
+                    ).set(state_num.get(br["state"], -1))
+                    keep_brk.add(dotted)
+            self.metrics.prune_family(cred_fam, keep_cred)
+            self.metrics.prune_family(brk_fam, keep_brk)
+            flat[f"{p}.qos.throttled_429s"] = qos_rep["throttled_429s"]
+            flat[f"{p}.qos.short_circuited"] = \
+                qos_rep["short_circuited"]
+        if recovery is not None:
+            report["recovery"] = recovery
+            for k in ("checkpoints", "checkpoint_failures",
+                      "checkpoint_age_ms", "recovery_age_ms",
+                      "replayed"):
+                v = recovery.get(k)
+                if isinstance(v, (int, float)):
+                    flat[f"{p}.recovery.{k}"] = v
         comp = dict(self.proto.compile_service.summary())
         # ONE compiled program set per template, shared by every tenant
         # — the multi-tenant acceptance invariant (bench.py `tenants`)

@@ -1,0 +1,63 @@
+"""Fixture: unbounded-retry — while-True reconnect loops with neither
+an attempt cap nor a backoff call (lines matter to the tests)."""
+import time
+
+
+def bad_reconnect(sock):
+    while True:
+        try:
+            sock.connect()
+            return
+        except ConnectionError:          # line 11: no cap, no backoff
+            continue
+
+
+def bad_swallow_timeout(chan):
+    while True:
+        try:
+            return chan.recv()
+        except TimeoutError:             # line 19: silent spin
+            pass
+
+
+def fine_bounded_attempts(sock):
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            sock.connect()
+            return
+        except ConnectionError:
+            if attempt >= 5:
+                raise                    # attempt cap: bounded
+            time.sleep(0.01)
+
+
+def fine_jittered_backoff(sock, backoff):
+    while True:
+        try:
+            sock.connect()
+            return
+        except ConnectionError:
+            time.sleep(backoff.next_wait_s())   # backoff call
+
+
+def fine_conditional_loop(sock, max_tries):
+    tries = 0
+    while tries < max_tries:             # bounded by construction
+        tries += 1
+        try:
+            sock.connect()
+            return
+        except ConnectionError:
+            pass
+
+
+def fine_generic_keep_serving(pump, log):
+    # a drain loop that logs-and-continues on ANY exception is not a
+    # transport retry loop — out of scope for the rule
+    while True:
+        try:
+            pump()
+        except Exception:
+            log.exception("round failed")

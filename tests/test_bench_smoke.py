@@ -208,6 +208,20 @@ def test_bench_tenants_quick_parses():
     assert slo["state"] in ("OK", "WARN", "PAGE")
     assert slo["hot_p99_ms"] > 0 and slo["cold_p99_ms_max"] > 0
     assert slo["skew"] > 1
+    # QoS fairness arm (docs/serving.md "QoS dials"): hot tenant at 8x
+    # with and without QoS — the hot tenant must be throttled with a
+    # Retry-After, the starved tenant's p99 must hold the 2x-of-fair
+    # bound, and the priority classes must drain high -> normal -> low
+    fair = d["fairness"]
+    assert fair["skew"] > 1
+    assert fair["throttled_429s"] > 0
+    assert fair["retry_after_ms"] and fair["retry_after_ms"] > 0
+    assert fair["starved_p99_ms_fair"] > 0
+    assert fair["starved_p99_ms_qos"] > 0
+    assert fair["p99_bounded"] is True, fair
+    assert fair["class_drain_order"][0] == "high"
+    assert fair["class_drain_order"][-1] == "low"
+    assert all(fair["drain_rounds"][t] for t in ("hi", "cold", "lo"))
 
 
 def test_bench_fanout_quick_parses():

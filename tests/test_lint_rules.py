@@ -191,3 +191,28 @@ def test_bare_gauge_family_fires_without_help():
 
 def test_bare_gauge_family_registered():
     assert "bare-gauge-family" in rule_names()
+
+
+def test_unbounded_retry_fires_on_capless_backoffless_loops():
+    """while-True reconnect loops whose transport-exception handler
+    loops straight back (no raise/break/return, no sleep/backoff call)
+    fire; the attempt-cap + jittered-backoff shapes of core/io.py, a
+    conditional (self-bounding) loop, and a generic keep-serving drain
+    loop all stay clean."""
+    fs = findings_for("bad_retry.py")
+    assert lines_of(fs, "unbounded-retry") == [11, 19]
+    f = [x for x in fs if x.rule == "unbounded-retry"][0]
+    assert f.severity == "warning"
+    assert "backoff" in f.message
+    # the blessed patterns (>= line 23) produce nothing
+    assert all(x.line < 23 for x in fs)
+
+
+def test_unbounded_retry_registered_and_repo_clean():
+    assert "unbounded-retry" in rule_names()
+    # the repo's own reconnect loops are bounded AND back off
+    # (core/io.py connect_with_retry / _publish_with_retry)
+    import pathlib
+    src = pathlib.Path(__file__).parents[1] / "siddhi_tpu" / "core" / "io.py"
+    fs = lint_file(str(src), rel_path="siddhi_tpu/core/io.py")
+    assert [x for x in fs if x.rule == "unbounded-retry"] == []

@@ -1,0 +1,765 @@
+"""Tenant QoS & graceful degradation (serving/qos.py, docs/serving.md
+"QoS dials"): token-bucket rate limits with Retry-After 429s, deficit-
+round-robin weighted fairness, priority-class drain order with a
+bounded starvation window, per-tenant circuit breakers, whole-pool
+crash-consistent checkpoints + recovery (PoolCheckpointSupervisor),
+error replay through the owning slot, the SIDDHI_TPU_QOS=0 kill matrix,
+and the zero-recompile guard over all of it.
+"""
+import functools
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+from siddhi_tpu import (PoolCheckpointSupervisor, SiddhiManager,
+                        InMemoryPersistenceStore)
+from siddhi_tpu.core.service import SiddhiService
+from siddhi_tpu.resilience.errorstore import (ErroredEvent,
+                                              InMemoryErrorStore)
+from siddhi_tpu.serving import (AdmissionError, CircuitBreaker,
+                                PoolQoS, Template, TenantPool,
+                                TokenBucket)
+
+TPL = """
+define stream In (v double, k long);
+@info(name='q')
+from In[v > ${lo:double}]
+select v, k
+insert into Out;
+"""
+
+WINDOW_TPL = """
+define stream In (v double, k long);
+@info(name='q')
+from In[v > ${lo:double}]#window.lengthBatch(4)
+select v, k
+insert into Out;
+"""
+
+
+def _chunk(n=8, seed=3, base=1_000_000):
+    rng = np.random.default_rng(seed)
+    ts = base + np.arange(n, dtype=np.int64)
+    return ts, [rng.uniform(1.0, 10.0, n),
+                np.arange(n, dtype=np.int64)]
+
+
+def _mk_pool(text=TPL, mgr=None, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("max_tenants", 8)
+    kw.setdefault("batch_max", 16)
+    return TenantPool(Template(text), manager=mgr or SiddhiManager(),
+                      **kw)
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# ---- TokenBucket -------------------------------------------------------
+
+
+def test_token_bucket_refill_and_retry_after():
+    clk = _Clock()
+    b = TokenBucket(rate=100.0, burst=50.0, clock=clk)
+    ok, _ = b.try_take(50)
+    assert ok
+    ok, retry = b.try_take(10)
+    assert not ok
+    # 10 tokens at 100/s = 100 ms
+    assert retry == 100
+    clk.t += 0.1
+    ok, _ = b.try_take(10)
+    assert ok
+
+
+def test_token_bucket_oversized_chunk_admits_at_full():
+    """A chunk bigger than burst is admitted when the bucket is full
+    (debt goes negative) — coarse chunking throttles to the average
+    rate instead of deadlocking."""
+    clk = _Clock()
+    b = TokenBucket(rate=10.0, burst=8.0, clock=clk)
+    ok, _ = b.try_take(64)
+    assert ok                      # full bucket: oversized chunk passes
+    ok, retry = b.try_take(64)
+    assert not ok and retry > 0    # debt: rejected until refilled
+    clk.t += 10.0
+    ok, _ = b.try_take(64)
+    assert ok
+
+
+# ---- CircuitBreaker ----------------------------------------------------
+
+
+def test_breaker_state_machine():
+    clk = _Clock()
+    seen = []
+    br = CircuitBreaker(threshold=2, reset_ms=1000, clock=clk,
+                        on_transition=lambda a, b: seen.append((a, b)))
+    assert br.gate() == "closed"
+    br.record_failure()
+    assert br.state == "CLOSED"
+    br.record_failure()            # threshold consecutive -> OPEN
+    assert br.state == "OPEN" and br.trips == 1
+    assert br.gate() == "open"     # inside the cooldown
+    clk.t += 1.5
+    assert br.gate() == "probe"    # cooldown elapsed -> HALF_OPEN
+    assert br.gate() == "open"     # only ONE probe per cooldown
+    br.record_failure()            # probe failed -> OPEN again
+    assert br.state == "OPEN" and br.trips == 2
+    clk.t += 3.0
+    assert br.gate() == "probe"
+    br.record_success()            # probe succeeded -> CLOSED
+    assert br.state == "CLOSED"
+    assert ("CLOSED", "OPEN") in seen and ("HALF_OPEN", "CLOSED") in seen
+
+
+def test_breaker_success_resets_consecutive_count():
+    br = CircuitBreaker(threshold=3, reset_ms=10, clock=_Clock())
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.state == "CLOSED"    # never 3 CONSECUTIVE failures
+
+
+# ---- PoolQoS.plan_round (DRR semantics) --------------------------------
+
+
+def test_plan_round_defaults_match_legacy_fixed_round():
+    q = PoolQoS({})
+    for t in ("a", "b", "c"):
+        q.add_tenant(t, None)
+    takes = q.plan_round({"a": 100, "b": 5, "c": 0}, batch_max=16)
+    assert takes == {"a": 16, "b": 5}
+
+
+def test_plan_round_weights_hold_ratio_over_rounds():
+    q = PoolQoS({})
+    q.add_tenant("w1", {"weight": 1.0})
+    q.add_tenant("w_half", {"weight": 0.5})
+    pending = {"w1": 1000, "w_half": 1000}
+    total = {"w1": 0, "w_half": 0}
+    for _ in range(10):
+        takes = q.plan_round(dict(pending), batch_max=16)
+        for t, n in takes.items():
+            pending[t] -= n
+            total[t] += n
+    # DRR: rows dispatched converge to the weight ratio exactly
+    assert total["w1"] == 2 * total["w_half"]
+
+
+def test_plan_round_deficit_resets_when_queue_drains():
+    q = PoolQoS({})
+    q.add_tenant("a", {"weight": 1.0})
+    q.plan_round({"a": 3}, batch_max=16)      # drained: credits reset
+    assert q.credits()["a"] == 0.0
+    takes = q.plan_round({"a": 100}, batch_max=16)
+    assert takes["a"] == 16                   # no banked burst
+
+
+def test_plan_round_priority_defers_bounded():
+    q = PoolQoS({"max_defer": 2})
+    q.add_tenant("hi", {"priority": "high"})
+    q.add_tenant("lo", {"priority": "low"})
+    pending = {"hi": 100, "lo": 10}
+    lo_takes = []
+    for _ in range(3):
+        takes = q.plan_round(dict(pending), batch_max=16)
+        for t, n in takes.items():
+            pending[t] -= n
+        lo_takes.append(takes.get("lo", 0))
+    # deferred while high drains, but never more than max_defer rounds
+    assert lo_takes == [0, 0, 10]             # starvation bound
+    assert pending["lo"] == 0
+    assert q.deferrals == {"low": 2}
+
+
+def test_qos_dial_validation():
+    q = PoolQoS({})
+    with pytest.raises(ValueError, match="unknown qos dial"):
+        q.add_tenant("a", {"wieght": 2})
+    with pytest.raises(ValueError, match="weight must be > 0"):
+        q.add_tenant("a", {"weight": 0})
+    with pytest.raises(ValueError, match="priority"):
+        q.add_tenant("a", {"priority": "urgent"})
+
+
+# ---- pool rate limiting ------------------------------------------------
+
+
+def test_pool_rate_limit_429_with_retry_after():
+    pool = _mk_pool()
+    pool.add_tenant("a", {"lo": 0.0},
+                    qos={"rate_eps": 10.0, "burst": 8.0})
+    ts, cols = _chunk(8)
+    pool.send("a", ts, cols)                   # burst admits once
+    with pytest.raises(AdmissionError) as ei:
+        pool.send("a", ts, cols)
+    sat = ei.value.saturation
+    assert sat["cause"] == "rate-limited"
+    assert sat["retry_after_ms"] > 0
+    assert sat["tenant"] == "a"
+    st = pool.statistics()
+    assert st["qos"]["throttled_429s"] == 1
+    assert pool.saturation()["rejections"] == {"rate-limited": 1}
+    flat, _ = pool._collect_observability()
+    assert flat[f"siddhi.{pool.name}.qos.throttled_429s"] == 1
+
+
+def test_cap_annotation_rate_dials():
+    pool = _mk_pool("@app:cap(rate.eps='10', rate.burst='8')\n" + TPL)
+    pool.add_tenant("a", {"lo": 0.0})
+    ts, cols = _chunk(8)
+    pool.send("a", ts, cols)
+    with pytest.raises(AdmissionError, match="rate limit"):
+        pool.send("a", ts, cols)
+
+
+# ---- weighted fairness + priorities, end to end ------------------------
+
+
+def test_drr_weights_hold_under_skew():
+    pool = _mk_pool(batch_max=16)
+    pool.add_tenant("full", {"lo": 0.0}, qos={"weight": 1.0})
+    pool.add_tenant("half", {"lo": 0.0}, qos={"weight": 0.5})
+    n = 16 * 6
+    for tid in ("full", "half"):
+        ts, cols = _chunk(n, seed=1)
+        pool.send(tid, ts, cols)
+    takes = []
+    while True:
+        before = dict(pool._pending_rows)
+        if pool.pump() == 0:
+            break
+        takes.append({t: before[t] - pool._pending_rows[t]
+                      for t in before})
+    both = [t for t in takes if t["full"] > 0 and t["half"] > 0]
+    assert both and all(t["full"] == 2 * t["half"] for t in both)
+    # everything drains eventually — weights shift shares, not totals
+    assert pool.statistics()["tenants"]["half"]["pending"] == 0
+
+
+def test_priority_classes_drain_first_under_backlog():
+    pool = _mk_pool(batch_max=16)
+    pool.add_tenant("hi", {"lo": 0.0}, qos={"priority": "high"})
+    pool.add_tenant("lo", {"lo": 0.0}, qos={"priority": "low"})
+    ts, cols = _chunk(16 * 3, seed=2)
+    pool.send("hi", ts, cols)
+    ts2, cols2 = _chunk(8, seed=3)
+    pool.send("lo", ts2, cols2)
+    pool.pump()
+    st = pool.statistics()["tenants"]
+    assert st["hi"]["pending"] == 16 * 2
+    assert st["lo"]["pending"] == 8        # deferred: high drains first
+    pool.flush()
+    st = pool.statistics()["tenants"]
+    assert st["lo"]["pending"] == 0
+    assert pool.statistics()["qos"]["deferrals"]["low"] >= 1
+
+
+# ---- circuit breaker, end to end ---------------------------------------
+
+
+def _flaky(calls, healed):
+    def cb(events):
+        calls.append(len(events))
+        if not healed["on"]:
+            raise RuntimeError("sink down")
+    return cb
+
+
+def test_breaker_trips_short_circuits_and_recovers():
+    pool = _mk_pool(qos={"breaker_failures": 2,
+                         "breaker_reset_ms": 120})
+    pool.add_tenant("a", {"lo": 0.0})
+    pool.add_tenant("b", {"lo": 0.0})
+    calls, healed = [], {"on": False}
+    pool.add_callback("a", _flaky(calls, healed))
+    got_b = []
+    pool.add_callback("b", got_b.extend)
+
+    for r in range(2):     # two failing rounds -> OPEN
+        ts, cols = _chunk(4, seed=r, base=1_000_000 + r * 100)
+        pool.send("a", ts, cols)
+        pool.send("b", ts, cols)
+        pool.flush()
+    st = pool.statistics()
+    assert st["tenants"]["a"]["qos"]["breaker"] == "OPEN"
+    assert len(got_b) == 8                 # b never disturbed
+
+    n_calls = len(calls)
+    ts, cols = _chunk(4, seed=9, base=2_000_000)
+    pool.send("a", ts, cols)
+    pool.flush()                           # inside cooldown
+    assert len(calls) == n_calls           # short-circuited: no call
+    st = pool.statistics()
+    assert st["qos"]["short_circuited"] == 4
+    assert st["tenants"]["a"]["errors"] == 12   # 8 failed + 4 bypassed
+
+    healed["on"] = True
+    time.sleep(0.15)                       # cooldown elapses
+    ts, cols = _chunk(4, seed=10, base=3_000_000)
+    pool.send("a", ts, cols)
+    pool.flush()                           # HALF_OPEN probe succeeds
+    st = pool.statistics()
+    assert st["tenants"]["a"]["qos"]["breaker"] == "CLOSED"
+    assert st["qos"]["tenants"]["a"]["breaker"]["trips"] == 1
+    # transitions land in the flight recorder
+    kinds = [e for e in pool.flight._ring
+             if e["kind"] == "breaker-transition"]
+    assert [(e["prev"], e["state"]) for e in kinds] == [
+        ("CLOSED", "OPEN"), ("OPEN", "HALF_OPEN"),
+        ("HALF_OPEN", "CLOSED")]
+    # the stored backlog replays through the breaker-aware path
+    replayed = pool.replay_errors("a")
+    assert replayed == {"a": 12}
+    # two failing rounds, the probe, then the whole backlog as ONE
+    # consecutive same-origin replay batch
+    assert calls == [4, 4, 4, 12]
+
+
+def test_breaker_gauge_families_have_states():
+    pool = _mk_pool(qos={"breaker_failures": 1, "breaker_reset_ms": 60_000})
+    pool.add_tenant("a", {"lo": 0.0})
+    boom = _flaky([], {"on": False})
+    pool.add_callback("a", boom)
+    ts, cols = _chunk(4)
+    pool.send("a", ts, cols)
+    pool.flush()
+    flat = pool.metrics.collect()
+    assert flat[f"siddhi.{pool.name}.qos.tenant.a.breaker_state"] == 2
+    assert f"siddhi.{pool.name}.qos.tenant.a.credits" in flat
+    text = pool.metrics.prometheus_text()
+    assert "qos_breaker_state" in text and 'tenant="a"' in text
+
+
+# ---- replay routing ----------------------------------------------------
+
+
+def test_replay_errors_routes_in_timestamp_order():
+    pool = _mk_pool()
+    pool.add_tenant("a", {"lo": 0.0})
+    got = []
+    pool.add_callback("a", got.extend)
+    store = pool.proto._error_store()
+    part = pool.tenant_partition("a")
+    from siddhi_tpu.core.stream import Event
+    # records stored OUT of event-time order (late capture interleave)
+    store.store(part, ErroredEvent.from_events(
+        "Out", [Event(2000, (2.0, 2)), Event(2001, (2.5, 3))], "x"))
+    store.store(part, ErroredEvent.from_events(
+        "Out", [Event(1000, (1.0, 1))], "x"))
+    replayed = pool.replay_errors()
+    assert replayed == {"a": 3}
+    assert [e.timestamp for e in got] == [1000, 2000, 2001]
+    assert store.peek(part) == []
+
+
+def test_replay_errors_without_callback_keeps_backlog():
+    pool = _mk_pool()
+    pool.add_tenant("a", {"lo": 0.0})
+    store = pool.proto._error_store()
+    part = pool.tenant_partition("a")
+    from siddhi_tpu.core.stream import Event
+    store.store(part, ErroredEvent.from_events(
+        "Out", [Event(1000, (1.0, 1))], "x"))
+    assert pool.replay_errors() == {}
+    assert len(store.peek(part)) == 1      # kept, not dropped
+    with pytest.raises(KeyError):
+        pool.replay_errors("ghost")
+
+
+# ---- whole-pool snapshot / recovery ------------------------------------
+
+
+def test_pool_snapshot_restore_bit_identical_on_fresh_pool():
+    mgr = SiddhiManager()
+    pool = _mk_pool(WINDOW_TPL, mgr=mgr)
+    pool.add_tenant("a", {"lo": 0.0}, qos={"weight": 2.0})
+    pool.add_tenant("b", {"lo": 0.0})
+    ts, cols = _chunk(6)
+    pool.send("a", ts, cols)
+    pool.send("b", ts, cols)
+    pool.flush()
+    data = pool.snapshot()
+    per_tenant = {t: pool.snapshot_tenant(t) for t in ("a", "b")}
+
+    fresh = _mk_pool(WINDOW_TPL, mgr=mgr)
+    fresh.restore(data)
+    assert sorted(fresh._tenants) == ["a", "b"]
+    assert fresh._tenants == pool._tenants      # slot map preserved
+    from siddhi_tpu.core.persistence import deserialize
+    for tid in ("a", "b"):
+        p1 = deserialize(per_tenant[tid])
+        p2 = deserialize(fresh.snapshot_tenant(tid))
+        f1, _ = jax.tree_util.tree_flatten(p1["queries"])
+        f2, _ = jax.tree_util.tree_flatten(p2["queries"])
+        for x, y in zip(f1, f2):
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # QoS profiles rebuilt from the snapshot's dials
+    assert fresh._qos.profile("a").weight == 2.0
+    # restored pool keeps serving
+    got = []
+    fresh.add_callback("a", got.extend)
+    ts2, cols2 = _chunk(2, seed=9, base=2_000_000)
+    fresh.send("a", ts2, cols2)
+    fresh.flush()
+    assert pool.statistics()["tenants"]["a"]["emitted"]["q"] >= 4
+
+
+def test_pool_restore_rejects_mismatches():
+    mgr = SiddhiManager()
+    pool = _mk_pool(mgr=mgr)
+    pool.add_tenant("a", {"lo": 0.0})
+    data = pool.snapshot()
+    other = _mk_pool(WINDOW_TPL, mgr=mgr)
+    with pytest.raises(ValueError, match="template"):
+        other.restore(data)
+    with pytest.raises(Exception):   # torn bytes: unpickler rejects
+        pool.restore(b"garbage")
+
+
+def test_supervisor_periodic_checkpoints_and_stats():
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(InMemoryPersistenceStore())
+    pool = _mk_pool(mgr=mgr)
+    pool.add_tenant("a", {"lo": 0.0})
+    sup = PoolCheckpointSupervisor(pool, interval_rounds=2)
+    for r in range(5):
+        ts, cols = _chunk(4, seed=r, base=1_000_000 + r * 100)
+        pool.send("a", ts, cols)
+        pool.pump()
+    assert sup.checkpoints == 2            # rounds 2 and 4
+    revs = mgr.persistence_store.list_revisions(pool.name)
+    assert len(revs) == 2
+    rec = pool.statistics()["recovery"]
+    assert rec["checkpoints"] == 2
+    assert rec["checkpoint_age_ms"] >= 0
+    assert rec["last_revision"] == revs[-1]
+
+
+def test_supervisor_recover_falls_back_past_corrupt_revision():
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(InMemoryPersistenceStore())
+    pool = _mk_pool(mgr=mgr)
+    pool.add_tenant("a", {"lo": 0.0})
+    ts, cols = _chunk(4)
+    pool.send("a", ts, cols)
+    pool.flush()
+    good = pool.persist()
+    from siddhi_tpu.core.persistence import new_revision
+    bad = new_revision(pool.name)
+    mgr.persistence_store.save(pool.name, bad, b"torn bytes")
+
+    fresh = _mk_pool(mgr=mgr)
+    sup = PoolCheckpointSupervisor(fresh)
+    restored, replayed = sup.recover()
+    assert restored == good                # skipped the torn newest
+    assert sorted(fresh._tenants) == ["a"]
+    rec = fresh.statistics()["recovery"]
+    assert rec["restored_revision"] == good
+    assert rec["recovery_age_ms"] >= 0
+
+
+# ---- SIDDHI_TPU_QOS=0 kill matrix --------------------------------------
+
+
+def test_qos_env_kill_restores_legacy_semantics(monkeypatch):
+    monkeypatch.setenv("SIDDHI_TPU_QOS", "0")
+    pool = _mk_pool(qos={"breaker_failures": 1, "breaker_reset_ms": 9,
+                         "rate_eps": 1.0, "rate_burst": 1.0})
+    assert pool._qos is None
+    pool.add_tenant("a", {"lo": 0.0},
+                    qos={"weight": 0.25, "priority": "low",
+                         "rate_eps": 1.0})
+    # no rate limit: repeated floods are accepted (pre-QoS behavior)
+    for i in range(3):
+        ts, cols = _chunk(16, seed=i, base=1_000_000 + i * 100)
+        pool.send("a", ts, cols)
+    calls, healed = [], {"on": False}
+    pool.add_callback("a", _flaky(calls, healed))
+    pool.flush()
+    st = pool.statistics()
+    # no breaker: the callback ran every round, events stored each time
+    assert len(calls) == 3
+    assert st["qos"] == {"enabled": False}
+    assert "recovery" not in st
+    assert st["tenants"]["a"]["errors"] == 48
+    assert "qos" not in st["tenants"]["a"]
+
+
+def test_qos_on_with_default_dials_matches_legacy_takes():
+    """QoS layer live but unconfigured: the DRR plan must reproduce the
+    fixed batch_max-per-tenant round exactly (the degrade-to-today
+    contract)."""
+    a = _mk_pool()
+    b = _mk_pool()
+    ts, cols = _chunk(16 * 3, seed=5)
+    for pool in (a, b):
+        pool.add_tenant("t1", {"lo": 0.0})
+        pool.add_tenant("t2", {"lo": 0.0})
+        pool.send("t1", ts, cols)
+        pool.send("t2", ts[:8], [c[:8] for c in cols])
+    # a runs with QoS live (default), b's plan is forced off
+    b._qos = None
+    takes_a, takes_b = [], []
+    for pool, takes in ((a, takes_a), (b, takes_b)):
+        while True:
+            before = dict(pool._pending_rows)
+            if pool.pump() == 0:
+                break
+            takes.append({t: before[t] - pool._pending_rows[t]
+                          for t in before})
+    assert takes_a == takes_b
+
+
+# ---- zero recompiles ---------------------------------------------------
+
+
+def test_qos_scheduling_and_breaker_trips_zero_recompiles(monkeypatch):
+    """The whole QoS layer is host-side policy: DRR skew, priority
+    deferral, breaker trips, short-circuits, and replay must add ZERO
+    new traces through any jit once the pool is warm (the counting-jit
+    guard of the fusion/serving suites)."""
+    real_jit = jax.jit
+    traces = [0]
+
+    def counting_jit(f, *a, **kw):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            traces[0] += 1
+            return f(*args, **kwargs)
+        return real_jit(wrapped, *a, **kw)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+
+    pool = _mk_pool(qos={"breaker_failures": 1, "breaker_reset_ms": 5})
+    pool.add_tenant("hot", {"lo": 0.0}, qos={"weight": 1.0,
+                                             "rate_eps": 1e9})
+    pool.add_tenant("half", {"lo": 0.0}, qos={"weight": 0.5})
+    pool.add_tenant("low", {"lo": 0.0}, qos={"priority": "low"})
+    calls, healed = [], {"on": False}
+    pool.add_callback("half", _flaky(calls, healed))
+    ts, cols = _chunk(16, seed=1)
+    for tid in ("hot", "half", "low"):
+        pool.send(tid, ts, cols)
+    pool.flush()
+    warm = traces[0]
+    assert warm > 0
+    # QoS-heavy activity on warm caps: skewed backlogs, deferrals,
+    # breaker trip + short-circuit + heal + replay
+    for i in range(3):
+        big_ts, big_cols = _chunk(16 * 4, seed=10 + i,
+                                  base=2_000_000 + i * 10_000)
+        pool.send("hot", big_ts, big_cols)
+        pool.send("half", ts + 50_000 * (i + 1), cols)
+        pool.send("low", ts + 50_000 * (i + 1), cols)
+        pool.flush()
+    healed["on"] = True
+    time.sleep(0.01)
+    pool.send("half", ts + 900_000, cols)
+    pool.flush()
+    pool.replay_errors("half")
+    assert traces[0] == warm, "QoS/breaker activity must not retrace"
+
+
+# ---- explain -----------------------------------------------------------
+
+
+def test_explain_carries_qos_decisions_and_hash_stability():
+    a = _mk_pool(qos={"breaker_failures": 3})
+    b = _mk_pool(qos={"breaker_failures": 3})
+    plain = _mk_pool()
+    ea = a.explain()
+    assert ea["decisions"]["qos"]["scheduler"] == "deficit-round-robin"
+    assert ea["decisions"]["qos"]["breaker_failures"] == 3
+    assert a.plan_hash() == b.plan_hash()
+    assert a.plan_hash() != plain.plan_hash()   # dials are plan
+
+
+# ---- service front door ------------------------------------------------
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+def test_service_qos_429_replay_recover_e2e():
+    svc = SiddhiService()
+    svc.manager.set_persistence_store(InMemoryPersistenceStore())
+    svc.manager.set_error_store(InMemoryErrorStore())
+    svc.start()
+    try:
+        code, body, _h = _post(svc.port, "/siddhi/tenant/deploy", {
+            "template": TPL, "tenant": "t1",
+            "bindings": {"lo": 0.0},
+            "qos": {"rate_eps": 10.0, "burst": 4.0},
+            "pool": {"slots": 2, "max_tenants": 2, "batch_max": 16},
+        })
+        assert code == 200, body
+        pool_name = body["app"]
+        rows = [[5.0, i] for i in range(4)]
+        code, body, _h = _post(
+            svc.port, f"/siddhi/tenant/ingest/{pool_name}/t1",
+            {"ts": [1000, 1001, 1002, 1003], "rows": rows})
+        assert code == 200 and body["accepted"] == 4
+        # over-rate: 429 with cause + a real Retry-After header
+        code, body, headers = _post(
+            svc.port, f"/siddhi/tenant/ingest/{pool_name}/t1",
+            {"ts": [2000, 2001, 2002, 2003], "rows": rows})
+        assert code == 429
+        assert body["saturation"]["cause"] == "rate-limited"
+        assert int(headers["Retry-After"]) >= 1
+        # replay endpoint: no callbacks -> backlog kept, total 0
+        code, body, _h = _post(
+            svc.port, f"/siddhi/tenant/replay/{pool_name}", {})
+        assert code == 200 and body["total"] == 0
+        code, body, _h = _post(
+            svc.port, f"/siddhi/tenant/replay/{pool_name}/t1", {})
+        assert code == 200
+        # recover endpoint: checkpoint through the pool, then restore
+        pool = svc._pool(pool_name)
+        pool.flush()
+        rev = pool.persist()
+        code, body, _h = _post(
+            svc.port, f"/siddhi/tenant/recover/{pool_name}", {})
+        assert code == 200 and body["restored"] == rev
+        code, body, _h = _post(
+            svc.port, "/siddhi/tenant/recover/nope", {})
+        assert code == 404
+    finally:
+        svc.stop()
+
+
+# ---- threaded soak -----------------------------------------------------
+
+
+@pytest.mark.slow
+def test_threaded_soak_ingest_vs_checkpoint_vs_breaker():
+    """Concurrent ingest + checkpoints + breaker trips on one pool:
+    after the dust settles and the flaky tenant's backlog replays, no
+    row is lost or duplicated, and the per-tenant emitted counters
+    match a serial replay of the same seeded traffic."""
+    seed = 1234
+    n_chunks, chunk_rows = 12, 8
+
+    def traffic(tid_idx):
+        return [_chunk(chunk_rows, seed=seed + tid_idx * 100 + c,
+                       base=1_000_000 + c * 1000)
+                for c in range(n_chunks)]
+
+    def run_concurrent():
+        mgr = SiddhiManager()
+        mgr.set_persistence_store(InMemoryPersistenceStore())
+        mgr.set_error_store(InMemoryErrorStore())
+        pool = _mk_pool(mgr=mgr, qos={"breaker_failures": 2,
+                                      "breaker_reset_ms": 20})
+        tids = ["t0", "t1", "t2"]
+        for t in tids:
+            pool.add_tenant(t, {"lo": 0.0})
+        got = {t: [] for t in tids}
+        healed = {"on": False}
+
+        def cb(t):
+            def fn(events):
+                if t == "t1" and not healed["on"]:
+                    raise RuntimeError("flaky")
+                got[t].extend(events)
+            return fn
+
+        for t in tids:
+            pool.add_callback(t, cb(t))
+        pool.start()
+        sup = PoolCheckpointSupervisor(pool, interval_rounds=3)
+
+        def ingest(i, t):
+            for ts, cols in traffic(i):
+                while True:
+                    try:
+                        pool.send(t, ts, cols)
+                        break
+                    except AdmissionError:
+                        time.sleep(0.002)
+
+        threads = [threading.Thread(target=ingest, args=(i, t))
+                   for i, t in enumerate(tids)]
+        stop = threading.Event()
+
+        def checkpointer():
+            while not stop.is_set():
+                pool.persist()
+                time.sleep(0.005)
+
+        ck = threading.Thread(target=checkpointer)
+        for th in threads:
+            th.start()
+        ck.start()
+        for th in threads:
+            th.join()
+        pool.flush()
+        healed["on"] = True
+        time.sleep(0.05)
+        pool.flush()
+        # drain the flaky tenant's stored backlog until stable
+        for _ in range(4):
+            if not pool.replay_errors("t1").get("t1"):
+                break
+        stop.set()
+        ck.join()
+        stats = pool.statistics()
+        pool.shutdown()
+        # every checkpoint taken mid-flight must be restorable
+        mgrstore = mgr.persistence_store
+        last = mgrstore.get_last_revision(pool.name)
+        fresh = _mk_pool(mgr=mgr, qos={"breaker_failures": 2,
+                                       "breaker_reset_ms": 20})
+        fresh.restore_revision(last)
+        return got, stats
+
+    got, stats = run_concurrent()
+    # serial replay of the same traffic (no faults, no threads)
+    serial = _mk_pool()
+    for i, t in enumerate(("t0", "t1", "t2")):
+        serial.add_tenant(t, {"lo": 0.0})
+    for i, t in enumerate(("t0", "t1", "t2")):
+        for ts, cols in [_chunk(chunk_rows,
+                                seed=seed + i * 100 + c,
+                                base=1_000_000 + c * 1000)
+                         for c in range(n_chunks)]:
+            serial.send(t, ts, cols)
+    serial.flush()
+    sstats = serial.statistics()
+    for t in ("t0", "t1", "t2"):
+        assert stats["tenants"][t]["emitted"] == \
+            sstats["tenants"][t]["emitted"], t
+    # delivery: healthy tenants got every row exactly once; the flaky
+    # tenant's rows all arrived (breaker + replay), none duplicated
+    # (the callback raises BEFORE extending)
+    for i, t in enumerate(("t0", "t1", "t2")):
+        sent = sorted(
+            int(x) for c in range(n_chunks)
+            for x in _chunk(chunk_rows, seed=seed + i * 100 + c,
+                            base=1_000_000 + c * 1000)[0])
+        delivered = sorted(e.timestamp for e in got[t])
+        assert delivered == sent, t

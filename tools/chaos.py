@@ -12,9 +12,12 @@ Usage (from anywhere):
     python tools/chaos.py                  # fast suite, seed 0
     python tools/chaos.py --seed 42        # different fault schedule
     python tools/chaos.py --soak 25        # + 25 soak rounds (slow)
+    python tools/chaos.py --pool           # tenant-pool QoS/recovery
+                                           # scenarios (serving/)
 
 Exits nonzero when any scenario loses an event or fails to fall back to
-a good checkpoint.
+a good checkpoint. Failed scenarios dump a flight-recorder artifact and
+print its path.
 """
 import argparse
 import os
@@ -33,12 +36,16 @@ def run(argv=None) -> int:
                     help="fault-schedule seed (default 0)")
     ap.add_argument("--soak", type=int, default=0, metavar="ROUNDS",
                     help="also run ROUNDS probabilistic soak rounds")
+    ap.add_argument("--pool", action="store_true",
+                    help="run the tenant-pool scenarios (QoS fairness, "
+                         "breaker trip/recover, kill-pool-mid-round)")
     args = ap.parse_args(argv)
 
     from siddhi_tpu.resilience.scenarios import (
         failure_artifact, run_corrupt_snapshot_fallback,
-        run_disorder_equivalence, run_sink_outage_crash_recovery,
-        run_soak)
+        run_disorder_equivalence, run_pool_breaker_trip_recover,
+        run_pool_hot_tenant_flood, run_pool_kill_mid_round,
+        run_sink_outage_crash_recovery, run_soak)
 
     failures = 0
 
@@ -74,6 +81,41 @@ def run(argv=None) -> int:
            f"window={res['window_disorder']}/{res['window_ordered']} "
            f"dups_detected={res['duplicates_detected']} "
            f"injected={res['injected']}", res)
+
+    if args.pool:
+        res = run_pool_hot_tenant_flood(seed=args.seed)
+        report("pool-hot-tenant-flood",
+               res["throttled_429s"] > 0
+               and res["retry_after_ms"] is not None
+               and res["weights_held"]
+               and res["cold_drain_rounds"]
+               == res["cold_drain_rounds_expected"]
+               and res["p99_bounded"],
+               f"429s={res['throttled_429s']} "
+               f"retry_after={res['retry_after_ms']}ms "
+               f"cold_rounds={res['cold_drain_rounds']}/"
+               f"{res['cold_drain_rounds_expected']} "
+               f"p99={res['cold_p99_flood_ms']}ms "
+               f"vs fair {res['cold_p99_fair_ms']}ms", res)
+
+        res = run_pool_breaker_trip_recover(seed=args.seed)
+        report("pool-breaker-trip-recover",
+               res["tripped"] and res["short_circuited_without_calls"]
+               and res["closed_after_probe"] and res["lost"] == 0
+               and res["replay_in_ts_order"] and res["b_undisturbed"],
+               f"states={'/'.join(res['states'])} trips={res['trips']} "
+               f"replayed={res['replayed']} lost={res['lost']}", res)
+
+        res = run_pool_kill_mid_round(seed=args.seed)
+        report("pool-kill-mid-round",
+               res["recovered_to_checkpoint"]
+               and res["survivors_bit_identical"]
+               and res["replay_in_ts_order"]
+               and res["restored_revision_visible"],
+               f"restored={res['restored']} "
+               f"replayed={res['replayed']} "
+               f"bit_identical={res['survivors_bit_identical']} "
+               f"age={res['recovery_age_ms']}ms", res)
 
     if args.soak:
         for i, r in enumerate(run_soak(seed=args.seed,
