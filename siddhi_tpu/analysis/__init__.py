@@ -5,7 +5,11 @@ Three independent analyzers live here:
 - the **TPU-hygiene linter** (`lint_paths` / `tools/lint.py`): pure
   Python-AST rules enforcing the JAX dispatch/tracing invariants the
   runtime depends on (see docs/tpu_hygiene.md) — no target code is ever
-  imported;
+  imported. On top of the per-module rules, `lint_project` runs the
+  whole-repo **semantic passes**: an approximate call graph with a
+  thread-entry map (`callgraph`), lock-discipline + lock-order-cycle
+  checks (`concurrency`), use-after-donate dataflow (`donation`), and
+  a stale-suppression audit;
 - the **query-plan validator** (`plan_rules.validate_app` /
   `check_app`): structural checks over `lang/ast.py` SiddhiApp plans
   (undefined streams, window/aggregator arity, dead states), invoked by
@@ -20,13 +24,27 @@ Three independent analyzers live here:
 """
 from .findings import ERROR, WARNING, Finding
 from .linter import ModuleContext, lint_file, lint_paths, lint_source
-from .registry import all_rules, get_rule, rule_names
+from .registry import (all_rules, get_rule, register_meta, rule_names)
 from .schema import Schema, aggregator_result_type
 from . import jax_rules  # noqa: F401  (registers the TPU/JAX rules)
+from .callgraph import ProjectContext, build_project, lint_project
+from . import concurrency  # noqa: F401  (registers the project rules)
+from . import donation  # noqa: F401  (registers use-after-donate)
+
+# driver-synthesized finding ids — no check function, but SARIF output
+# and --list-rules still need their metadata
+register_meta(
+    "parse-error", ERROR,
+    "the source failed to parse; nothing else can be checked")
+register_meta(
+    "stale-pragma", WARNING,
+    "a `# lint: disable=` pragma or baseline entry no longer suppresses "
+    "anything — prune it so dead suppressions cannot mask future bugs")
 
 __all__ = [
     "ERROR", "WARNING", "Finding", "ModuleContext",
     "lint_file", "lint_paths", "lint_source",
     "all_rules", "get_rule", "rule_names",
     "Schema", "aggregator_result_type",
+    "ProjectContext", "build_project", "lint_project",
 ]

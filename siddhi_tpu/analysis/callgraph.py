@@ -1,0 +1,622 @@
+"""Whole-repo approximate call graph + thread-entry map.
+
+The semantic passes (concurrency.py lock-discipline / lock-order,
+donation.py) need to know two things the per-module AST rules cannot
+see: *who calls whom across modules*, and *which functions run on a
+thread other than the caller's* (drain workers, checkpoint supervisors,
+scheduler callbacks, metric reporters, HTTP handlers, AOT warmers).
+
+``ProjectContext`` parses every target module once (reusing the
+linter's ``ModuleContext``) and builds:
+
+- a function index keyed by qualified name
+  (``siddhi_tpu/core/stats.py`` -> ``siddhi_tpu.core.stats`` ->
+  ``siddhi_tpu.core.stats.LatencyTracker.mark_out``);
+- an **approximate** call graph. Resolution is deliberately
+  conservative — precision over recall, because findings built on it
+  gate CI: ``self.m()`` / ``cls.m()`` resolve through the class (and
+  name-matched project bases), bare names resolve to module/nested
+  functions and imports (relative imports included), and attribute
+  calls resolve only when the receiver's type is knowable from a
+  constructor assignment (``self.x = ClassName(...)``), a parameter
+  annotation (``def f(t: "Tracker")``), or a local ``v = ClassName()``;
+- a **thread-entry map**: functions handed to ``threading.Thread
+  (target=...)``, ``executor.submit``, ``atexit.register``, scheduler
+  ``notify_at`` callbacks, metrics ``register_collector`` /
+  ``set_fn`` collectors (they run on reporter/scrape threads),
+  ``do_*`` methods of ``BaseHTTPRequestHandler`` subclasses
+  (``ThreadingHTTPServer`` spawns a thread per request), plus anything
+  carrying a ``# thread-entry`` comment on its ``def`` line;
+- the transitive closure ``reachable``: every function reachable from a
+  thread entry over the call graph — the set on which lock-free reads
+  of guarded state become findings.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Optional
+
+from .findings import ERROR, WARNING, Finding
+from .linter import ModuleContext, iter_python_files
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+# attribute-call names whose callable argument runs on another thread
+# (argument index -> reason). Kept small and explicit: this is the
+# "registry of known entry points" — extend it when a new callback
+# surface appears, don't guess.
+CALLBACK_REGISTRARS = {
+    "submit": (0, "executor.submit target"),
+    "register_collector": (0, "metrics collector (reporter/scrape thread)"),
+    "set_fn": (0, "gauge callable (evaluated at collection time)"),
+    "add_done_callback": (0, "future callback"),
+    "notify_at": (1, "scheduler timer callback"),
+}
+
+HTTP_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler"}
+
+# extra dotted qnames (exact match) forced to be thread entries; the
+# annotation form (`# thread-entry: <why>` on the def line) is
+# preferred because it lives next to the code it describes.
+KNOWN_ENTRY_QNAMES: set[str] = set()
+
+THREAD_ENTRY_MARK = "# thread-entry"
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qname: str
+    name: str
+    path: str                      # repo-relative module path
+    ctx: ModuleContext
+    node: ast.AST                  # FunctionDef / AsyncFunctionDef
+    cls: Optional["ClassInfo"]     # owning class, if a method
+    parent_fn: Optional[str] = None  # enclosing function qname (nested defs)
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qname: str
+    name: str
+    path: str
+    ctx: ModuleContext
+    node: ast.ClassDef
+    bases: list[str]                          # last dotted component
+    methods: dict[str, FunctionInfo] = dataclasses.field(default_factory=dict)
+    attr_types: dict[str, set[str]] = dataclasses.field(default_factory=dict)
+
+
+def _last_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.rsplit(".", 1)[-1]
+    if isinstance(node, ast.Subscript):        # Optional["X"] and friends
+        return None
+    return None
+
+
+def _ann_class_name(ann: Optional[ast.AST]) -> Optional[str]:
+    """Class name out of a parameter/attribute annotation: ``Tracker``,
+    ``"Tracker"``, ``mod.Tracker``; generics/Optional are skipped."""
+    if ann is None:
+        return None
+    return _last_name(ann)
+
+
+def walk_body(node: ast.AST):
+    """ast.walk over a function body that does NOT descend into nested
+    function/class definitions (they are separate graph nodes); lambdas
+    ARE descended (they execute as part of the enclosing expression
+    flow often enough — sort keys — and when they don't, the
+    thread-entry scan handles them explicitly)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, _FUNC_NODES + (ast.ClassDef,)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+class ProjectContext:
+    """Cross-module facts for the semantic passes."""
+
+    def __init__(self, modules: dict[str, ModuleContext],
+                 errors: Optional[list[Finding]] = None):
+        self.modules = modules
+        self.errors = errors or []
+        self.functions: dict[str, FunctionInfo] = {}
+        self._fn_by_node: dict[tuple[str, int], FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.class_by_name: dict[str, list[ClassInfo]] = {}
+        self._ext_alias: dict[str, dict[str, tuple[str, ...]]] = {}
+        self._mod_name: dict[str, tuple[str, ...]] = {}
+        self._mod_by_name: dict[tuple[str, ...], str] = {}
+        self._local_types: dict[str, dict[str, set[str]]] = {}
+        self.call_edges: dict[str, set[str]] = {}
+        self.thread_entries: dict[str, str] = {}
+        self.reachable: set[str] = set()
+        self._index()
+        self._infer_attr_types()
+        self._build_call_edges()
+        self._find_thread_entries()
+        self._compute_reachable()
+
+    # -- indexing -----------------------------------------------------
+    @staticmethod
+    def module_name(rel_path: str) -> tuple[str, ...]:
+        parts = rel_path.replace(os.sep, "/").split("/")
+        parts[-1] = parts[-1][:-3] if parts[-1].endswith(".py") \
+            else parts[-1]
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return tuple(parts)
+
+    def _index(self) -> None:
+        for path, ctx in self.modules.items():
+            mod = self.module_name(path)
+            self._mod_name[path] = mod
+            self._mod_by_name[mod] = path
+            self._ext_alias[path] = self._extend_aliases(ctx, mod)
+            self._index_scope(ctx, path, ctx.tree.body,
+                              ".".join(mod), cls=None, parent_fn=None)
+
+    def _index_scope(self, ctx, path, body, prefix, cls, parent_fn):
+        for node in body:
+            if isinstance(node, _FUNC_NODES):
+                q = f"{prefix}.{node.name}"
+                info = FunctionInfo(qname=q, name=node.name, path=path,
+                                    ctx=ctx, node=node, cls=cls,
+                                    parent_fn=parent_fn)
+                self.functions[q] = info
+                self._fn_by_node[(path, id(node))] = info
+                if cls is not None and parent_fn is None:
+                    cls.methods.setdefault(node.name, info)
+                self._index_scope(ctx, path, node.body, q, cls=cls,
+                                  parent_fn=q)
+            elif isinstance(node, ast.ClassDef):
+                q = f"{prefix}.{node.name}"
+                ci = ClassInfo(
+                    qname=q, name=node.name, path=path, ctx=ctx,
+                    node=node,
+                    bases=[b for b in (_last_name(x) for x in node.bases)
+                           if b])
+                self.classes[q] = ci
+                self.class_by_name.setdefault(node.name, []).append(ci)
+                self._index_scope(ctx, path, node.body, q, cls=ci,
+                                  parent_fn=None)
+            elif isinstance(node, (ast.If, ast.Try, ast.With)):
+                # module-level guards (if TYPE_CHECKING, try/except import)
+                inner = []
+                for field in ("body", "orelse", "finalbody"):
+                    inner.extend(getattr(node, field, []) or [])
+                for h in getattr(node, "handlers", []) or []:
+                    inner.extend(h.body)
+                self._index_scope(ctx, path, inner, prefix, cls, parent_fn)
+
+    def _extend_aliases(self, ctx: ModuleContext,
+                        mod: tuple[str, ...]) -> dict[str, tuple[str, ...]]:
+        """ctx.alias_map plus *relative* imports resolved against this
+        module's package (the linter skips them; cross-module
+        resolution needs them — they are the repo's normal idiom)."""
+        amap = dict(ctx.alias_map)
+        pkg = mod[:-1] if mod else ()
+        for node in ctx.nodes:
+            if isinstance(node, ast.ImportFrom) and node.level > 0:
+                base = pkg[: len(pkg) - (node.level - 1)] \
+                    if node.level <= len(pkg) + 1 else ()
+                if node.module:
+                    base = base + tuple(node.module.split("."))
+                for a in node.names:
+                    amap[a.asname or a.name] = base + (a.name,)
+        return amap
+
+    def canon(self, path: str, node: ast.AST) -> Optional[tuple[str, ...]]:
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(node.id)
+        d = tuple(reversed(parts))
+        head = self._ext_alias.get(path, {}).get(d[0])
+        return head + d[1:] if head else d
+
+    # -- type inference ------------------------------------------------
+    def _classes_named(self, name: Optional[str]) -> list[ClassInfo]:
+        return self.class_by_name.get(name, []) if name else []
+
+    def _value_class(self, path: str, fn: Optional[FunctionInfo],
+                     value: ast.AST) -> set[str]:
+        """Class qnames a RHS expression constructs/carries."""
+        out: set[str] = set()
+        if isinstance(value, ast.Call):
+            nm = _last_name(value.func)
+            for ci in self._classes_named(nm):
+                out.add(ci.qname)
+        elif isinstance(value, ast.Name) and fn is not None:
+            ptypes = self._param_types(fn)
+            out |= ptypes.get(value.id, set())
+        elif isinstance(value, (ast.IfExp, ast.BoolOp)):
+            for sub in ast.iter_child_nodes(value):
+                out |= self._value_class(path, fn, sub)
+        return out
+
+    def _param_types(self, fn: FunctionInfo) -> dict[str, set[str]]:
+        args = fn.node.args
+        out: dict[str, set[str]] = {}
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            nm = _ann_class_name(a.annotation)
+            cands = {ci.qname for ci in self._classes_named(nm)}
+            if cands:
+                out[a.arg] = cands
+        return out
+
+    def _infer_attr_types(self) -> None:
+        for fn in self.functions.values():
+            if fn.cls is None:
+                continue
+            for node in walk_body(fn.node):
+                tgt = None
+                val = None
+                if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                    tgt, val = node.targets[0], node.value
+                elif isinstance(node, ast.AnnAssign):
+                    tgt, val = node.target, node.value
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                cands: set[str] = set()
+                if isinstance(node, ast.AnnAssign):
+                    nm = _ann_class_name(node.annotation)
+                    cands |= {ci.qname for ci in self._classes_named(nm)}
+                if val is not None:
+                    cands |= self._value_class(fn.path, fn, val)
+                if cands:
+                    fn.cls.attr_types.setdefault(tgt.attr, set()) \
+                        .update(cands)
+
+    def _fn_local_types(self, fn: FunctionInfo) -> dict[str, set[str]]:
+        cached = self._local_types.get(fn.qname)
+        if cached is not None:
+            return cached
+        out = dict(self._param_types(fn))
+        for node in walk_body(fn.node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                cands = self._value_class(fn.path, fn, node.value)
+                if cands:
+                    out.setdefault(node.targets[0].id, set()).update(cands)
+        self._local_types[fn.qname] = out
+        return out
+
+    # -- method lookup -------------------------------------------------
+    def method_in_class(self, ci: ClassInfo, name: str,
+                        _seen: Optional[set] = None) -> list[FunctionInfo]:
+        _seen = _seen if _seen is not None else set()
+        if ci.qname in _seen:
+            return []
+        _seen.add(ci.qname)
+        m = ci.methods.get(name)
+        if m is not None:
+            return [m]
+        out: list[FunctionInfo] = []
+        for b in ci.bases:
+            for base_ci in self._classes_named(b):
+                out.extend(self.method_in_class(base_ci, name, _seen))
+        return out
+
+    # -- call resolution -----------------------------------------------
+    def resolve_callable_ref(self, fn: Optional[FunctionInfo], path: str,
+                             expr: ast.AST) -> list[str]:
+        """Resolve an expression used as a *callable value* (a Thread
+        target, a registered callback) to function qnames."""
+        if isinstance(expr, ast.Lambda):
+            out: list[str] = []
+            for sub in ast.walk(expr.body):
+                if isinstance(sub, ast.Call):
+                    out.extend(self.resolve_call(fn, path, sub))
+            return out
+        if isinstance(expr, ast.Call):          # functools.partial(f, ...)
+            nm = _last_name(expr.func)
+            if nm == "partial" and expr.args:
+                return self.resolve_callable_ref(fn, path, expr.args[0])
+            return []
+        return self._resolve_func_expr(fn, path, expr)
+
+    def _resolve_func_expr(self, fn: Optional[FunctionInfo], path: str,
+                           expr: ast.AST) -> list[str]:
+        mod = ".".join(self._mod_name.get(path, ()))
+        if isinstance(expr, ast.Name):
+            # nested function of an enclosing def
+            if fn is not None:
+                scope: Optional[str] = fn.qname
+                while scope:
+                    q = f"{scope}.{expr.id}"
+                    if q in self.functions:
+                        return [q]
+                    info = self.functions.get(scope)
+                    scope = info.parent_fn if info else None
+            q = f"{mod}.{expr.id}"
+            if q in self.functions:
+                return [q]
+            # constructor: Class() -> Class.__init__ (or the class itself
+            # as a callable unit when no __init__ is defined)
+            for ci in self._classes_named(expr.id):
+                init = self.method_in_class(ci, "__init__")
+                if init:
+                    return [init[0].qname]
+            c = self.canon(path, expr)
+            if c:
+                return self._resolve_canon(c)
+            return []
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            meth = expr.attr
+            # self.m / cls.m
+            if isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and fn is not None and fn.cls is not None:
+                return [m.qname
+                        for m in self.method_in_class(fn.cls, meth)]
+            # local var / param with a known class
+            if isinstance(base, ast.Name) and fn is not None:
+                cands = self._fn_local_types(fn).get(base.id, set())
+                out = []
+                for cq in cands:
+                    ci = self.classes.get(cq)
+                    if ci:
+                        out.extend(m.qname
+                                   for m in self.method_in_class(ci, meth))
+                if out:
+                    return out
+                # ClassName.method
+                for ci in self._classes_named(base.id):
+                    out.extend(m.qname
+                               for m in self.method_in_class(ci, meth))
+                if out:
+                    return out
+            # self.attr.m through an inferred attribute type
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id in ("self", "cls") \
+                    and fn is not None and fn.cls is not None:
+                out = []
+                for cq in fn.cls.attr_types.get(base.attr, set()):
+                    ci = self.classes.get(cq)
+                    if ci:
+                        out.extend(m.qname
+                                   for m in self.method_in_class(ci, meth))
+                return out
+            # module.func through (possibly relative) imports
+            c = self.canon(path, expr)
+            if c:
+                return self._resolve_canon(c)
+        return []
+
+    def _resolve_canon(self, c: tuple[str, ...]) -> list[str]:
+        # longest module prefix match, remainder resolves inside it
+        for cut in range(len(c) - 1, 0, -1):
+            if c[:cut] in self._mod_by_name:
+                q = ".".join(c)
+                if q in self.functions:
+                    return [q]
+                # module.Class -> constructor
+                for ci in self._classes_named(c[-1]):
+                    if ci.qname == q:
+                        init = self.method_in_class(ci, "__init__")
+                        return [init[0].qname] if init else []
+                return []
+        return []
+
+    def resolve_call(self, fn: Optional[FunctionInfo], path: str,
+                     call: ast.Call) -> list[str]:
+        return self._resolve_func_expr(fn, path, call.func)
+
+    # -- call graph ------------------------------------------------------
+    def _build_call_edges(self) -> None:
+        for fn in self.functions.values():
+            edges: set[str] = set()
+            for node in walk_body(fn.node):
+                if isinstance(node, ast.Call):
+                    edges.update(self.resolve_call(fn, fn.path, node))
+            self.call_edges[fn.qname] = edges
+
+    # -- thread entries --------------------------------------------------
+    def _mark_entry(self, qnames: Iterable[str], reason: str) -> None:
+        for q in qnames:
+            self.thread_entries.setdefault(q, reason)
+
+    def _find_thread_entries(self) -> None:
+        for fn in self.functions.values():
+            # `# thread-entry` annotation on the def line
+            line = fn.ctx.lines[fn.node.lineno - 1] \
+                if fn.node.lineno - 1 < len(fn.ctx.lines) else ""
+            if THREAD_ENTRY_MARK in line:
+                self._mark_entry([fn.qname], "thread-entry annotation")
+            if fn.qname in KNOWN_ENTRY_QNAMES:
+                self._mark_entry([fn.qname], "known entry registry")
+        # http.server handlers: one thread per request
+        for ci in self.classes.values():
+            if self._is_http_handler(ci):
+                self._mark_entry(
+                    (m.qname for name, m in ci.methods.items()
+                     if name.startswith("do_")),
+                    "HTTP request handler")
+        # call-shaped registrations
+        for fn in list(self.functions.values()) + [None]:
+            if fn is None:
+                scopes = [(path, None, ctx.tree)
+                          for path, ctx in self.modules.items()]
+            else:
+                scopes = [(fn.path, fn, fn.node)]
+            for path, owner, root in scopes:
+                it = walk_body(root) if owner is not None else (
+                    n for n in ast.walk(root)
+                    if not isinstance(n, _FUNC_NODES))
+                for node in it:
+                    if isinstance(node, ast.Call):
+                        self._scan_entry_call(owner, path, node)
+
+    def _is_http_handler(self, ci: ClassInfo,
+                         _seen: Optional[set] = None) -> bool:
+        _seen = _seen if _seen is not None else set()
+        if ci.qname in _seen:
+            return False
+        _seen.add(ci.qname)
+        for b in ci.bases:
+            if b in HTTP_HANDLER_BASES:
+                return True
+            for base_ci in self._classes_named(b):
+                if self._is_http_handler(base_ci, _seen):
+                    return True
+        return False
+
+    def _scan_entry_call(self, fn: Optional[FunctionInfo], path: str,
+                         call: ast.Call) -> None:
+        c = self.canon(path, call.func)
+        if c and c[0] == "threading" and c[-1] == "Thread":
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    self._mark_entry(
+                        self.resolve_callable_ref(fn, path, kw.value),
+                        "threading.Thread target")
+            return
+        if c == ("atexit", "register") and call.args:
+            self._mark_entry(self.resolve_callable_ref(fn, path,
+                                                       call.args[0]),
+                             "atexit callback")
+            return
+        if isinstance(call.func, ast.Attribute):
+            spec = CALLBACK_REGISTRARS.get(call.func.attr)
+            if spec is not None:
+                idx, reason = spec
+                if len(call.args) > idx:
+                    self._mark_entry(
+                        self.resolve_callable_ref(fn, path, call.args[idx]),
+                        reason)
+
+    # -- reachability ----------------------------------------------------
+    def _compute_reachable(self) -> None:
+        seen = set(self.thread_entries)
+        stack = list(seen)
+        while stack:
+            q = stack.pop()
+            for callee in self.call_edges.get(q, ()):
+                if callee not in seen:
+                    seen.add(callee)
+                    stack.append(callee)
+        self.reachable = seen
+
+    def function_of_node(self, path: str, node: ast.AST) \
+            -> Optional[FunctionInfo]:
+        ctx = self.modules.get(path)
+        if ctx is None:
+            return None
+        fn_node = ctx.enclosing_function(node)
+        if fn_node is None:
+            return None
+        return self._fn_by_node.get((path, id(fn_node)))
+
+
+def stale_pragma_findings(pctx: ProjectContext) -> list[Finding]:
+    """`# lint: disable=` pragmas that suppressed nothing across ALL
+    passes (module rules + semantic passes) — dead suppressions rot
+    into false confidence; prune them. A pragma naming `stale-pragma`
+    itself is exempt (explicit keep)."""
+    out: list[Finding] = []
+    for path in sorted(pctx.modules):
+        ctx = pctx.modules[path]
+        for line in sorted(ctx.line_disables):
+            rules = ctx.line_disables[line]
+            if "stale-pragma" in rules:
+                continue
+            for r in sorted(rules):
+                used = (any(ln == line for ln, _ in ctx.used_line)
+                        if r == "*" else (line, r) in ctx.used_line)
+                if not used:
+                    out.append(Finding(
+                        rule="stale-pragma", severity=WARNING, path=path,
+                        line=line, col=0,
+                        message=(f"pragma 'lint: disable={r}' no longer "
+                                 f"suppresses any finding — prune it")))
+        if "stale-pragma" not in ctx.file_disables:
+            for r in sorted(ctx.file_disables):
+                used = (bool(ctx.used_file)
+                        if r == "*" else r in ctx.used_file)
+                if not used:
+                    out.append(Finding(
+                        rule="stale-pragma", severity=WARNING, path=path,
+                        line=1, col=0,
+                        message=(f"pragma 'lint: disable-file={r}' no "
+                                 f"longer suppresses any finding — "
+                                 f"prune it")))
+    return [f for f in out
+            if not pctx.modules[f.path].suppressed(f)]
+
+
+def lint_project(paths: Iterable[str], root: Optional[str] = None,
+                 rules: Optional[Iterable[str]] = None,
+                 semantic: bool = True,
+                 audit_suppressions: bool = True) -> list[Finding]:
+    """Whole-repo lint: per-module TPU-hygiene rules + the semantic
+    passes (lock-discipline, lock-order, use-after-donate reachability)
+    over one shared parse, plus the stale-pragma audit (only on full
+    runs — a `--rule`-filtered run can't tell a stale pragma from a
+    not-yet-checked one, and a `--changed` subset lacks the cross-module
+    evidence that makes a pragma earn its keep)."""
+    from .registry import module_rules, project_rules
+    from . import concurrency, donation  # noqa: F401 — register rules
+
+    pctx = build_project(paths, root)
+    wanted = set(rules) if rules is not None else None
+    out: list[Finding] = list(pctx.errors)
+    for rel in sorted(pctx.modules):
+        ctx = pctx.modules[rel]
+        for rule in module_rules():
+            if wanted is not None and rule.name not in wanted:
+                continue
+            for f in rule.check(ctx):
+                if not ctx.suppressed(f):
+                    out.append(f)
+    if semantic:
+        for rule in project_rules():
+            if wanted is not None and rule.name not in wanted:
+                continue
+            for f in rule.check(pctx):
+                mctx = pctx.modules.get(f.path)
+                if mctx is None or not mctx.suppressed(f):
+                    out.append(f)
+        if wanted is None and audit_suppressions:
+            out.extend(stale_pragma_findings(pctx))
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def build_project(paths: Iterable[str],
+                  root: Optional[str] = None) -> ProjectContext:
+    """Parse every .py file under `paths` into one ProjectContext.
+    Unparseable files become parse-error findings (ERROR) and are
+    excluded from the graph."""
+    base = os.path.abspath(root or os.getcwd())
+    modules: dict[str, ModuleContext] = {}
+    errors: list[Finding] = []
+    for p in iter_python_files(paths):
+        ap = os.path.abspath(p)
+        rel = os.path.relpath(ap, base).replace(os.sep, "/")
+        with open(ap, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            modules[rel] = ModuleContext(ap, src, rel_path=rel)
+        except SyntaxError as e:
+            errors.append(Finding(
+                rule="parse-error", severity=ERROR, path=rel,
+                line=e.lineno or 1, col=e.offset or 0,
+                message=f"syntax error: {e.msg}"))
+    return ProjectContext(modules, errors)

@@ -2,7 +2,10 @@
 
 Two modes:
 
-- default: lint Python sources with the TPU-hygiene AST rules;
+- default: lint Python sources — per-module TPU-hygiene AST rules PLUS
+  the whole-repo semantic passes (callgraph-based lock-discipline,
+  lock-order cycles, use-after-donate) and the stale-pragma audit;
+  ``--no-semantic`` drops back to the per-module rules only.
 - ``--plan``: treat PATHS as SiddhiQL sources (``.siddhi`` files or
   directories of them) and run the query-plan validator + static type
   checker over each — parse-time errors (undefined streams, schema
@@ -11,21 +14,30 @@ Two modes:
   as the Python rules. File-scope suppression inside ``.siddhi``
   sources: ``-- lint: disable=insert-coerce,dead-output``.
 
-Exit codes: 0 clean (or everything baselined), 1 new findings (in
-``--plan`` mode: any plan/type ERROR, baselined or not, also exits 1),
-2 usage/configuration error.
+CI conveniences:
+
+- ``--changed`` lints only git-modified/untracked ``.py`` files under
+  ``--root`` (lint fixtures excluded — they exist to fire); exit-code
+  contract is unchanged, an empty change set exits 0;
+- ``--sarif out.sarif`` additionally writes the NEW (non-baselined)
+  findings as SARIF 2.1.0 with rule metadata for code-scanning UIs.
+
+Exit codes: 0 clean (or everything baselined), 1 new findings or stale
+baseline entries (in ``--plan`` mode: any plan/type ERROR, baselined or
+not, also exits 1), 2 usage/configuration error.
 """
 from __future__ import annotations
 
 import argparse
 import os
 import re
+import subprocess
 import sys
 from typing import Optional
 
 from . import baseline as baseline_mod
-from .findings import ERROR, Finding
-from .linter import lint_paths
+from .callgraph import lint_project
+from .findings import ERROR, WARNING, Finding
 from .registry import all_rules
 
 _SIDDHI_PRAGMA = re.compile(
@@ -56,6 +68,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run only this rule (repeatable)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule table and exit")
+    p.add_argument("--no-semantic", action="store_true",
+                   help="skip the whole-repo semantic passes (callgraph/"
+                        "lock-discipline/lock-order/donation) and the "
+                        "stale-pragma audit")
+    p.add_argument("--changed", action="store_true",
+                   help="lint only git-modified/untracked .py files under "
+                        "--root (tests/lint_fixtures excluded); an empty "
+                        "change set exits 0")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write the new findings as SARIF 2.1.0")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the summary line")
     return p
@@ -106,6 +128,31 @@ def plan_findings(paths, root: Optional[str] = None) -> list[Finding]:
     return out
 
 
+def changed_python_files(root: str) -> Optional[list[str]]:
+    """Git-modified (vs HEAD) + untracked .py files under `root`; None
+    when git is unavailable. Lint fixtures are excluded — they seed
+    antipatterns on purpose."""
+    files: set[str] = set()
+    for cmd in (["git", "-C", root, "diff", "--name-only", "HEAD", "--"],
+                ["git", "-C", root, "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 check=True)
+        except (OSError, subprocess.CalledProcessError):
+            return None
+        files.update(x.strip() for x in res.stdout.splitlines()
+                     if x.strip())
+    out = []
+    for f in sorted(files):
+        if not f.endswith(".py") or "lint_fixtures" in f:
+            continue
+        ap = os.path.join(root, f)
+        if os.path.exists(ap):
+            out.append(ap)
+    return out
+
+
 def main(argv: Optional[list[str]] = None,
          stdout=None) -> int:
     out = stdout or sys.stdout
@@ -116,18 +163,35 @@ def main(argv: Optional[list[str]] = None,
             print(f"{r.name:24} {r.severity:8} {r.rationale}", file=out)
         return 0
 
+    root = os.path.abspath(args.root or os.getcwd())
+
     if args.plan:
         findings = plan_findings(args.paths, root=args.root)
     else:
-        findings = lint_paths(args.paths, root=args.root, rules=args.rules)
+        paths = args.paths
+        if args.changed:
+            paths = changed_python_files(root)
+            if paths is None:
+                print("--changed requires a git checkout at --root",
+                      file=out)
+                return 2
+            if not paths:
+                if not args.quiet:
+                    print("no changed python files; nothing to lint",
+                          file=out)
+                return 0
+        findings = lint_project(paths, root=args.root, rules=args.rules,
+                                semantic=not args.no_semantic,
+                                audit_suppressions=not args.changed)
 
     if args.update_baseline:
         if not args.baseline:
             print("--update-baseline requires --baseline PATH", file=out)
             return 2
-        baseline_mod.save(args.baseline, findings)
+        keep = [f for f in findings if f.rule != "stale-pragma"]
+        baseline_mod.save(args.baseline, keep)
         if not args.quiet:
-            print(f"baseline updated: {len(findings)} finding(s) -> "
+            print(f"baseline updated: {len(keep)} finding(s) -> "
                   f"{args.baseline}", file=out)
         return 0
 
@@ -140,12 +204,28 @@ def main(argv: Optional[list[str]] = None,
             return 2
     fresh, n_baselined = baseline_mod.filter_new(findings, bl)
 
+    # baseline entries that no longer suppress anything are findings
+    # themselves: a shrinking baseline is the point (WARNING, but still
+    # exit-1 — prune and commit)
+    stale = baseline_mod.stale_keys(findings, bl)
+    if stale:
+        bl_rel = os.path.relpath(os.path.abspath(args.baseline), root) \
+            .replace(os.sep, "/")
+        for k in stale:
+            fresh.append(Finding(
+                rule="stale-pragma", severity=WARNING, path=bl_rel,
+                line=1, col=0,
+                message=("baseline entry no longer matches any finding "
+                         f"— prune it: {k}")))
+
     for f in fresh:
         print(f.render(), file=out)
-    stale = baseline_mod.stale_keys(findings, bl)
-    if stale and not args.quiet:
-        for k in stale:
-            print(f"stale baseline entry (prune it): {k}", file=out)
+    if args.sarif:
+        from .sarif import write_sarif
+        write_sarif(args.sarif, fresh, root_uri=root)
+        if not args.quiet:
+            print(f"sarif written: {args.sarif} ({len(fresh)} result(s))",
+                  file=out)
     if not args.quiet:
         print(f"{len(fresh)} new finding(s), {n_baselined} baselined, "
               f"{len(stale)} stale baseline entr(ies)", file=out)

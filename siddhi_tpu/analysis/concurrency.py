@@ -1,0 +1,587 @@
+"""Whole-repo concurrency passes: lock-discipline and lock-order.
+
+Both run over the ``callgraph.ProjectContext`` and exist because the
+three worst shipped bugs were concurrency bugs found by hand:
+
+- **racy-attribute-read** (WARNING, baselinable): an instance
+  attribute written under a lock on one path but read lock-free on a
+  thread-reachable path — the ``LatencyTracker.summary`` snapshot race
+  class. Guarded-by facts are inferred from ``with self._lock:``
+  blocks around writes; ``# guarded-by: <lock>`` on an assignment line
+  declares the discipline explicitly where inference can't see it.
+  Lock context is interprocedural both ways: a helper only ever
+  *called* while the lock is held inherits it (meet over resolved
+  call sites), so ``with self._lock: self._pump()`` does not flag the
+  reads inside ``_pump``.
+  Reads in ``__init__``/``__new__``/``__del__`` never flag
+  (pre-publication), and a class with no thread-reachable reader or
+  locked writer stays silent — single-threaded code owes no locks.
+
+- **lock-order-cycle** (ERROR): a cycle in the acquires-while-holding
+  graph — the registry collect-vs-record ABBA class. Edges come from
+  syntactic nesting (``with a: ... with b:``) and interprocedurally
+  from calls made while holding a lock into functions that (transitively)
+  acquire other locks. Lock identity is ``Class.attr`` / module-level
+  name; re-acquiring the *same* lock is not an edge (RLock reentrancy),
+  and ``threading.Condition(self._lock)`` aliases the condition to the
+  lock it wraps.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterator, Optional
+
+from .callgraph import ClassInfo, FunctionInfo, ProjectContext, walk_body
+from .findings import ERROR, WARNING, Finding
+from .registry import register_project
+
+_LOCK_CTORS = {("threading", "Lock"), ("threading", "RLock"),
+               ("threading", "Condition"), ("threading", "Semaphore"),
+               ("threading", "BoundedSemaphore")}
+
+_GUARDED_BY = re.compile(r"#\s*guarded-by:\s*(?P<lock>[A-Za-z_][\w]*)")
+
+_INIT_METHODS = {"__init__", "__new__", "__del__"}
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+@dataclasses.dataclass
+class Access:
+    attr: str
+    is_store: bool
+    held: frozenset
+    node: ast.AST
+    fn: FunctionInfo
+
+
+@dataclasses.dataclass
+class CallSite:
+    callees: tuple
+    held: frozenset
+    node: ast.AST
+    fn: FunctionInfo
+
+
+@dataclasses.dataclass
+class AcquireEdge:
+    holding: str
+    acquired: str
+    node: ast.AST
+    fn: FunctionInfo
+
+
+class _ClassLocks:
+    """Lock attributes of one class (+ Condition aliasing)."""
+
+    def __init__(self, pctx: ProjectContext, ci: ClassInfo):
+        self.ci = ci
+        self.attrs: set[str] = set()
+        self.alias: dict[str, str] = {}
+        for fn in ci.methods.values():
+            for node in walk_body(fn.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                tgt = node.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    continue
+                hit = self._lock_ctor_in(pctx, fn.path, node.value)
+                if hit is None:
+                    continue
+                self.attrs.add(tgt.attr)
+                wrapped = self._wrapped_lock(hit)
+                if wrapped is not None:
+                    self.alias[tgt.attr] = wrapped
+
+    @staticmethod
+    def _wrapped_lock(call: ast.Call) -> Optional[str]:
+        # threading.Condition(self._lock): the condition IS that lock
+        if call.args:
+            a = call.args[0]
+            if isinstance(a, ast.Attribute) and \
+                    isinstance(a.value, ast.Name) and a.value.id == "self":
+                return a.attr
+        return None
+
+    def _lock_ctor_in(self, pctx, path, value) -> Optional[ast.Call]:
+        """A threading lock constructor inside the RHS (descends IfExp /
+        BoolOp so `barrier or threading.RLock()` idioms count)."""
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                c = pctx.canon(path, sub.func)
+                if c in _LOCK_CTORS or (c and c[-1] in
+                                        {x[1] for x in _LOCK_CTORS}
+                                        and c[0] == "threading"):
+                    return sub
+        return None
+
+    def resolve(self, attr: str) -> str:
+        seen = set()
+        while attr in self.alias and attr not in seen:
+            seen.add(attr)
+            attr = self.alias[attr]
+        return attr
+
+    def key(self, attr: str) -> str:
+        return f"{self.ci.qname}.{self.resolve(attr)}"
+
+
+class _ModuleLocks:
+    def __init__(self, pctx: ProjectContext, path: str):
+        self.names: set[str] = set()
+        ctx = pctx.modules[path]
+        mod = ".".join(ProjectContext.module_name(path))
+        self.mod = mod
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call):
+                        c = pctx.canon(path, sub.func)
+                        if c in _LOCK_CTORS:
+                            self.names.add(node.targets[0].id)
+
+    def key(self, name: str) -> str:
+        return f"{self.mod}.{name}"
+
+
+class _Analysis:
+    """One walk of every function, collecting lock-held facts."""
+
+    def __init__(self, pctx: ProjectContext):
+        self.pctx = pctx
+        self.class_locks: dict[str, _ClassLocks] = {}
+        self.module_locks: dict[str, _ModuleLocks] = {}
+        self.accesses: list[Access] = []
+        self.calls: list[CallSite] = []
+        self.edges: list[AcquireEdge] = []
+        self.direct_acquires: dict[str, set[str]] = {}
+        for path in pctx.modules:
+            self.module_locks[path] = _ModuleLocks(pctx, path)
+        for ci in pctx.classes.values():
+            self.class_locks[ci.qname] = _ClassLocks(pctx, ci)
+        for fn in pctx.functions.values():
+            self._walk_function(fn)
+
+    # -- lock expression -> key ---------------------------------------
+    def _lock_key(self, fn: FunctionInfo, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and fn.cls is not None:
+            cl = self.class_locks.get(fn.cls.qname)
+            if cl is not None and expr.attr in cl.attrs:
+                return cl.key(expr.attr)
+            # inherited lock attr (base class defines it)
+            for b in fn.cls.bases:
+                for base_ci in self.pctx.class_by_name.get(b, []):
+                    bcl = self.class_locks.get(base_ci.qname)
+                    if bcl is not None and expr.attr in bcl.attrs:
+                        return bcl.key(expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            ml = self.module_locks.get(fn.path)
+            if ml is not None and expr.id in ml.names:
+                return ml.key(expr.id)
+        return None
+
+    # -- function walk -------------------------------------------------
+    def _walk_function(self, fn: FunctionInfo) -> None:
+        self.direct_acquires.setdefault(fn.qname, set())
+        body = getattr(fn.node, "body", [])
+        self._walk_stmts(fn, body, frozenset())
+
+    def _walk_stmts(self, fn: FunctionInfo, stmts, held: frozenset) -> None:
+        for st in stmts:
+            if isinstance(st, _FUNC_NODES + (ast.ClassDef,)):
+                continue  # separate graph nodes (no lock inheritance)
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                new = set()
+                for item in st.items:
+                    self._scan_expr(fn, item.context_expr, held)
+                    k = self._lock_key(fn, item.context_expr)
+                    if k is not None and k not in held:
+                        new.add(k)
+                        self.direct_acquires[fn.qname].add(k)
+                        for h in held:
+                            if h != k:
+                                self.edges.append(AcquireEdge(
+                                    holding=h, acquired=k,
+                                    node=item.context_expr, fn=fn))
+                self._walk_stmts(fn, st.body, held | new)
+            elif isinstance(st, ast.If):
+                self._scan_expr(fn, st.test, held)
+                self._walk_stmts(fn, st.body, held)
+                self._walk_stmts(fn, st.orelse, held)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._scan_expr(fn, st.iter, held)
+                self._scan_expr(fn, st.target, held)
+                self._walk_stmts(fn, st.body, held)
+                self._walk_stmts(fn, st.orelse, held)
+            elif isinstance(st, ast.While):
+                self._scan_expr(fn, st.test, held)
+                self._walk_stmts(fn, st.body, held)
+                self._walk_stmts(fn, st.orelse, held)
+            elif isinstance(st, ast.Try):
+                self._walk_stmts(fn, st.body, held)
+                for h in st.handlers:
+                    self._walk_stmts(fn, h.body, held)
+                self._walk_stmts(fn, st.orelse, held)
+                self._walk_stmts(fn, st.finalbody, held)
+            elif hasattr(ast, "Match") and isinstance(st, ast.Match):
+                self._scan_expr(fn, st.subject, held)
+                for case in st.cases:
+                    self._walk_stmts(fn, case.body, held)
+            else:
+                self._scan_expr(fn, st, held)
+
+    def _scan_expr(self, fn: FunctionInfo, node: ast.AST,
+                   held: frozenset) -> None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, _FUNC_NODES + (ast.ClassDef,)):
+                continue
+            if isinstance(n, ast.Call):
+                callees = tuple(self.pctx.resolve_call(fn, fn.path, n))
+                if callees:
+                    self.calls.append(CallSite(callees=callees, held=held,
+                                               node=n, fn=fn))
+            if isinstance(n, ast.Attribute) and \
+                    isinstance(n.value, ast.Name) and n.value.id == "self":
+                is_store = isinstance(n.ctx, (ast.Store, ast.Del))
+                self.accesses.append(Access(
+                    attr=n.attr, is_store=is_store, held=held,
+                    node=n, fn=fn))
+                # an AugAssign target is a read-modify-write
+                if is_store and isinstance(n.ctx, ast.Store):
+                    parent = fn.ctx.parent(n)
+                    if isinstance(parent, ast.AugAssign) \
+                            and parent.target is n:
+                        self.accesses.append(Access(
+                            attr=n.attr, is_store=False, held=held,
+                            node=n, fn=fn))
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _entry_held(a: "_Analysis") -> dict[str, frozenset]:
+    """Locks guaranteed held on ENTRY to each function: the meet
+    (intersection) over every resolved call site of ``held-at-site ∪
+    entry-held(caller)``. A helper only ever called inside ``with
+    self._lock:`` inherits the lock — its lock-free-looking reads are
+    not racy. Thread entries and externally-callable functions (no
+    resolved caller) enter with nothing held; unresolved call sites
+    simply don't contribute (precision over soundness — this is a
+    false-positive filter, the WARNING stays advisory)."""
+    callers: dict[str, list[tuple[str, frozenset]]] = {}
+    for cs in a.calls:
+        if cs.fn.name in _INIT_METHODS:
+            # pre-publication call sites can't race and must not drag
+            # the meet to ∅ for helpers shared with locked paths
+            continue
+        for q in cs.callees:
+            callers.setdefault(q, []).append((cs.fn.qname, cs.held))
+    TOP = None  # unknown yet (identity for the meet)
+    ctx: dict[str, Optional[frozenset]] = {}
+    for q in a.pctx.functions:
+        if q in a.pctx.thread_entries or q not in callers:
+            ctx[q] = frozenset()
+        else:
+            ctx[q] = TOP
+    changed = True
+    while changed:
+        changed = False
+        for q, sites in callers.items():
+            if q not in ctx or ctx[q] == frozenset() \
+                    or q in a.pctx.thread_entries:
+                continue
+            acc: Optional[frozenset] = None
+            for caller_q, held in sites:
+                c = ctx.get(caller_q, frozenset())
+                if c is TOP:
+                    continue
+                eff = held | c
+                acc = eff if acc is None else (acc & eff)
+                if not acc:
+                    break
+            if acc is not None and acc != ctx[q]:
+                ctx[q] = acc
+                changed = True
+    # functions still TOP sit on caller cycles never entered from a
+    # known root; nothing is provably held
+    return {q: (v if v is not TOP else frozenset())
+            for q, v in ctx.items()}
+
+
+_ANALYSIS_CACHE: dict[int, _Analysis] = {}
+
+
+def _analysis(pctx: ProjectContext) -> _Analysis:
+    # both passes share one walk; keyed by context identity
+    a = _ANALYSIS_CACHE.get(id(pctx))
+    if a is None or a.pctx is not pctx:
+        a = _Analysis(pctx)
+        _ANALYSIS_CACHE.clear()
+        _ANALYSIS_CACHE[id(pctx)] = a
+    return a
+
+
+# ---------------------------------------------------------------------
+# guarded-by facts + racy reads
+# ---------------------------------------------------------------------
+
+
+def _explicit_guards(pctx: ProjectContext, ci: ClassInfo,
+                     cl: _ClassLocks) -> dict[str, set[str]]:
+    """`# guarded-by: <lock>` on an attribute assignment/declaration
+    line inside the class — declares the invariant where inference
+    can't see a locked write (e.g. the attr is only ever written
+    externally or pre-publication)."""
+    out: dict[str, set[str]] = {}
+    for node in ast.walk(ci.node):
+        tgt = None
+        if isinstance(node, ast.Assign) and node.targets:
+            tgt = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            tgt = node.target
+        if tgt is None:
+            continue
+        attr = None
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            attr = tgt.attr
+        elif isinstance(tgt, ast.Name) and isinstance(
+                pctx.modules[ci.path].parent(node), ast.ClassDef):
+            attr = tgt.id
+        if attr is None:
+            continue
+        line = ci.ctx.lines[node.lineno - 1] \
+            if node.lineno - 1 < len(ci.ctx.lines) else ""
+        m = _GUARDED_BY.search(line)
+        if m:
+            out.setdefault(attr, set()).add(cl.key(m.group("lock")))
+    return out
+
+
+@register_project(
+    "racy-attribute-read", WARNING,
+    "attribute written under a lock on one path but read lock-free on a "
+    "thread-reachable path — the LatencyTracker.summary snapshot-race "
+    "class; guard the read, or annotate the invariant")
+def racy_attribute_read(pctx: ProjectContext) -> Iterator[Finding]:
+    a = _analysis(pctx)
+    entry = _entry_held(a)
+
+    def eff_held(acc: Access) -> frozenset:
+        return acc.held | entry.get(acc.fn.qname, frozenset())
+
+    # per class: guard facts from locked writes outside __init__
+    by_class: dict[str, list[Access]] = {}
+    for acc in a.accesses:
+        if acc.fn.cls is not None:
+            by_class.setdefault(acc.fn.cls.qname, []).append(acc)
+    for cq, accesses in sorted(by_class.items()):
+        ci = pctx.classes[cq]
+        cl = a.class_locks[cq]
+        guards: dict[str, set[str]] = _explicit_guards(pctx, ci, cl)
+        guarded_writer_reachable: dict[str, bool] = {}
+        for acc in accesses:
+            if acc.is_store and eff_held(acc) \
+                    and acc.fn.name not in _INIT_METHODS:
+                guards.setdefault(acc.attr, set()).update(eff_held(acc))
+                if acc.fn.qname in pctx.reachable:
+                    guarded_writer_reachable[acc.attr] = True
+        if not guards:
+            continue
+        for acc in accesses:
+            if acc.is_store or acc.attr not in guards:
+                continue
+            if acc.attr in cl.attrs:
+                continue  # reading the lock object itself is fine
+            if acc.fn.name in _INIT_METHODS:
+                continue
+            if eff_held(acc) & guards[acc.attr]:
+                continue
+            if not (acc.fn.qname in pctx.reachable
+                    or guarded_writer_reachable.get(acc.attr)):
+                continue
+            locks = ", ".join(sorted(k.rsplit(".", 1)[-1]
+                                     for k in guards[acc.attr]))
+            yield Finding(
+                rule="racy-attribute-read", severity=WARNING,
+                path=acc.fn.path, line=acc.node.lineno,
+                col=acc.node.col_offset,
+                message=(f"'self.{acc.attr}' of {ci.name} is written "
+                         f"under '{locks}' but read lock-free on a "
+                         f"thread-reachable path; take the lock, or "
+                         f"justify with `# lint: "
+                         f"disable=racy-attribute-read`"))
+
+
+# ---------------------------------------------------------------------
+# lock-order cycles (ABBA)
+# ---------------------------------------------------------------------
+
+
+def _locks_star(a: _Analysis) -> dict[str, set[str]]:
+    """Transitive locks-acquired-by-function (fixpoint over the call
+    graph): what a callee may acquire while the caller holds locks."""
+    star = {q: set(ks) for q, ks in a.direct_acquires.items()}
+    edges = a.pctx.call_edges
+    changed = True
+    while changed:
+        changed = False
+        for q, callees in edges.items():
+            cur = star.setdefault(q, set())
+            before = len(cur)
+            for g in callees:
+                cur |= star.get(g, set())
+            if len(cur) != before:
+                changed = True
+    return star
+
+
+@register_project(
+    "lock-order-cycle", ERROR,
+    "cycle in the acquires-while-holding graph across modules — the "
+    "ABBA deadlock class (registry collect vs tracker record); break "
+    "the cycle by calling out of the critical section")
+def lock_order_cycle(pctx: ProjectContext) -> Iterator[Finding]:
+    a = _analysis(pctx)
+    star = _locks_star(a)
+    # edge -> example site (first by file:line)
+    sites: dict[tuple[str, str], tuple] = {}
+
+    def note(h: str, k: str, fn: FunctionInfo, node: ast.AST, how: str):
+        if h == k:
+            return
+        key = (h, k)
+        cand = (fn.path, node.lineno, node.col_offset, fn, how)
+        if key not in sites or (cand[0], cand[1]) < sites[key][:2]:
+            sites[key] = cand
+
+    for e in a.edges:
+        note(e.holding, e.acquired, e.fn, e.node, "nested `with`")
+    for cs in a.calls:
+        if not cs.held:
+            continue
+        for callee in cs.callees:
+            for k in star.get(callee, ()):
+                for h in cs.held:
+                    note(h, k, cs.fn, cs.node,
+                         f"call into {callee.rsplit('.', 1)[-1]}() which "
+                         f"acquires it")
+    # cycle detection over the edge set
+    adj: dict[str, set[str]] = {}
+    for (h, k) in sites:
+        adj.setdefault(h, set()).add(k)
+        adj.setdefault(k, set())
+    for cyc in _cycles(adj):
+        # anchor at the first edge site of the cycle (stable choice)
+        pairs = [p for p in zip(cyc, cyc[1:] + cyc[:1]) if p in sites]
+        if not pairs:  # degenerate SCC ordering: any in-component edge
+            comp = set(cyc)
+            pairs = [p for p in sites if p[0] in comp and p[1] in comp]
+        if not pairs:
+            continue
+        anchor = min((sites[p] for p in pairs),
+                     key=lambda s: (s[0], s[1]))
+        path, line, col, fn, how = anchor
+        pretty = " -> ".join(k.rsplit(".", 2)[-2] + "." +
+                             k.rsplit(".", 2)[-1] for k in cyc + [cyc[0]])
+        detail = "; ".join(
+            f"{h.rsplit('.', 1)[-1]} held while acquiring "
+            f"{k.rsplit('.', 1)[-1]} at {sites[p][0]}:{sites[p][1]} "
+            f"({sites[p][4]})"
+            for p in pairs
+            for h, k in [p])
+        yield Finding(
+            rule="lock-order-cycle", severity=ERROR,
+            path=path, line=line, col=col,
+            message=(f"lock-order cycle (ABBA deadlock hazard): "
+                     f"{pretty} — {detail}"))
+
+
+def _cycles(adj: dict[str, set[str]]) -> list[list[str]]:
+    """Elementary cycle per SCC with >1 node (or a self-loop-free
+    2+-cycle): enough to report each ABBA family once."""
+    index: dict[str, int] = {}
+    low: dict[str, int] = {}
+    on_stack: set[str] = set()
+    stack: list[str] = []
+    sccs: list[list[str]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(adj.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    sccs.append(comp)
+
+    for v in sorted(adj):
+        if v not in index:
+            strongconnect(v)
+    out = []
+    for comp in sccs:
+        # find one concrete cycle inside the SCC by DFS
+        comp_set = set(comp)
+        start = min(comp)
+        path = [start]
+        seen = {start}
+        found = None
+
+        def dfs(v):
+            nonlocal found
+            if found:
+                return
+            for w in sorted(adj.get(v, ())):
+                if w not in comp_set:
+                    continue
+                if w == start and len(path) > 1:
+                    found = list(path)
+                    return
+                if w not in seen:
+                    seen.add(w)
+                    path.append(w)
+                    dfs(w)
+                    if found:
+                        return
+                    path.pop()
+
+        dfs(start)
+        out.append(found or sorted(comp))
+    return out

@@ -1,0 +1,325 @@
+"""use-after-donate: reads of buffers already donated to XLA.
+
+The PR 5 double-free class: a value passed in a donated position of a
+``jax.jit(..., donate_argnums=...)`` call is INVALID afterwards — XLA
+aliases the output onto its buffer, so a later read sees freed/reused
+memory (worse when the buffer was a zero-copy ``device_put`` alias of a
+numpy snapshot payload: the "donation" frees memory numpy still owns).
+
+The rule is per-module and flow-approximate:
+
+- **donated callables** are collected module-wide: any name or
+  ``self.<attr>`` assigned from ``jax.jit(fn, donate_argnums=(...))``
+  or the runtime's ``jax.jit(fn, **_donate(...))`` idiom, plus
+  immediately-invoked ``jax.jit(...)(args)`` calls;
+- at a call of a donated callable, the expressions in donated
+  positions (plain names and ``self.x`` / ``self.x.y`` chains) become
+  *dead*;
+- any later read of a dead value is an ERROR; **any rebind kills** —
+  ``states = stepf(states, ...)``, tuple unpacking, and the restore
+  idiom ``self.states = _fresh_device(snap["states"])`` all make the
+  name valid again (fresh buffers, fresh reference);
+- loop bodies are walked twice so a donation on iteration N is seen by
+  the read on iteration N+1; ``if``/``else`` branches merge as a
+  union (dead on any path counts — this is the bug class where "works
+  in the happy path" ships the double-free).
+
+``SIDDHI_TPU_DONATE=0`` disables donation at runtime but the static
+contract must hold for the default configuration, so the rule does not
+try to see through the env gate.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from .findings import ERROR, Finding
+from .linter import ModuleContext
+from .registry import register
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _donated_argnums(call: ast.Call, ctx: ModuleContext) -> Optional[set]:
+    """The donated positions of a ``jax.jit(...)`` call, else None."""
+    if ctx.canon(call.func) != ("jax", "jit"):
+        return None
+    nums: set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            nums |= _int_literals(kw.value)
+        elif kw.arg is None and isinstance(kw.value, ast.Call):
+            # **_donate(0, 1, 2) — the runtime idiom; resolved by tail
+            # name so relative imports (`from ..core.runtime import
+            # _donate`) count
+            c = ctx.canon(kw.value.func)
+            if c and c[-1] == "_donate":
+                nums |= _int_literals_from_args(kw.value.args)
+    return nums or None
+
+
+def _int_literals(node: ast.AST) -> set:
+    out: set[int] = set()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        out.add(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.add(e.value)
+    return out
+
+
+def _int_literals_from_args(args) -> set:
+    out: set[int] = set()
+    for a in args:
+        out |= _int_literals(a)
+    return out
+
+
+def _ref_key(expr: ast.AST) -> Optional[str]:
+    """A trackable value reference: plain name or a self.-rooted
+    attribute chain ('states', 'self.states', 'self.win.states')."""
+    parts: list[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Donated:
+    """Table of donated callables, keyed the same way the call sites
+    will reference them. ``self.<attr>`` keys are module-wide (the
+    ``self._step = jax.jit(...)`` in ``__init__`` is called from other
+    methods); plain-name keys are scoped to the function that assigned
+    them — a generic local like ``fn = jax.jit(...)`` in one method
+    must not poison every other ``fn(...)`` in the module."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.ctx = ctx
+        self.self_keys: dict[str, set] = {}
+        # id(enclosing fn node) (None = module scope) -> name -> argnums
+        self.local: dict[Optional[int], dict[str, set]] = {}
+        for node in ctx.nodes:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.value, ast.Call):
+                nums = _donated_argnums(node.value, ctx)
+                if not nums:
+                    continue
+                k = _ref_key(node.targets[0])
+                if k is None:
+                    continue
+                if k.startswith("self."):
+                    self.self_keys[k] = nums
+                else:
+                    fn = ctx.enclosing_function(node)
+                    scope = id(fn) if fn is not None else None
+                    self.local.setdefault(scope, {})[k] = nums
+
+    @property
+    def has_any(self) -> bool:
+        return bool(self.self_keys) or bool(self.local)
+
+    def argnums_for_call(self, call: ast.Call,
+                         fn_node: Optional[ast.AST]) -> Optional[set]:
+        # direct: jax.jit(...)(x) immediately invoked
+        if isinstance(call.func, ast.Call):
+            nums = _donated_argnums(call.func, self.ctx)
+            if nums:
+                return nums
+        k = _ref_key(call.func)
+        if k is None:
+            return None
+        if k.startswith("self."):
+            return self.self_keys.get(k)
+        node = fn_node
+        while node is not None:
+            nums = self.local.get(id(node), {}).get(k)
+            if nums:
+                return nums
+            node = self.ctx.enclosing_function(node)
+        return self.local.get(None, {}).get(k)
+
+
+class _FlowState:
+    """dead: ref key -> donation site (line) for the message."""
+
+    def __init__(self):
+        self.dead: dict[str, int] = {}
+
+    def copy(self) -> "_FlowState":
+        s = _FlowState()
+        s.dead = dict(self.dead)
+        return s
+
+    def merge(self, other: "_FlowState") -> None:
+        self.dead.update(other.dead)
+
+
+class _FunctionFlow:
+    def __init__(self, ctx: ModuleContext, table: _Donated,
+                 fn: ast.AST, findings: list):
+        self.ctx = ctx
+        self.table = table
+        self.fn = fn
+        self.findings = findings
+        self.reported: set[tuple[str, int]] = set()
+
+    def run(self) -> None:
+        self._stmts(self.fn.body, _FlowState())
+
+    # -- statement flow ------------------------------------------------
+    def _stmts(self, stmts, st: _FlowState) -> _FlowState:
+        for s in stmts:
+            st = self._stmt(s, st)
+        return st
+
+    def _stmt(self, s: ast.stmt, st: _FlowState) -> _FlowState:
+        if isinstance(s, _FUNC_NODES + (ast.ClassDef,)):
+            return st
+        if isinstance(s, ast.If):
+            self._expr(s.test, st)
+            a = self._stmts(s.body, st.copy())
+            b = self._stmts(s.orelse, st.copy())
+            a.merge(b)
+            return a
+        if isinstance(s, (ast.For, ast.AsyncFor)):
+            self._expr(s.iter, st)
+            self._kill_target(s.target, st)
+            body = st.copy()
+            # two passes: a donation late in the body reaches the reads
+            # at the top of the next iteration
+            body = self._stmts(s.body, body)
+            self._kill_target(s.target, body)
+            body = self._stmts(s.body, body)
+            body = self._stmts(s.orelse, body)
+            st.merge(body)
+            return st
+        if isinstance(s, ast.While):
+            self._expr(s.test, st)
+            body = self._stmts(s.body, st.copy())
+            self._expr(s.test, body)
+            body = self._stmts(s.body, body)
+            body = self._stmts(s.orelse, body)
+            st.merge(body)
+            return st
+        if isinstance(s, ast.Try):
+            st = self._stmts(s.body, st)
+            for h in s.handlers:
+                st.merge(self._stmts(h.body, st.copy()))
+            st = self._stmts(s.orelse, st)
+            st = self._stmts(s.finalbody, st)
+            return st
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                self._expr(item.context_expr, st)
+                if item.optional_vars is not None:
+                    self._kill_target(item.optional_vars, st)
+            return self._stmts(s.body, st)
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(s, "value", None)
+            if value is not None:
+                self._expr(value, st)
+            if isinstance(s, ast.AugAssign):
+                # read-modify-write: the target is read too
+                self._check_read(s.target, st)
+            targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+            for t in targets:
+                self._kill_target(t, st)
+            return st
+        if isinstance(s, ast.Delete):
+            for t in s.targets:
+                self._kill_target(t, st)
+            return st
+        if isinstance(s, ast.Return) and s.value is not None:
+            self._expr(s.value, st)
+            return st
+        # generic simple statement: scan expressions
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._expr(child, st)
+        return st
+
+    # -- expressions ----------------------------------------------------
+    def _expr(self, e: ast.AST, st: _FlowState) -> None:
+        """Scan an expression: donated-callable calls first mark their
+        donated args dead *after* checking the args as reads; every
+        other read of a dead ref is a finding."""
+        calls = [n for n in ast.walk(e) if isinstance(n, ast.Call)]
+        self._check_read(e, st)
+        for call in calls:
+            nums = self.table.argnums_for_call(call, self.fn)
+            if not nums:
+                continue
+            for i, arg in enumerate(call.args):
+                if i in nums:
+                    k = _ref_key(arg)
+                    if k is not None:
+                        st.dead[k] = call.lineno
+
+    def _check_read(self, e: ast.AST, st: _FlowState) -> None:
+        if not st.dead:
+            return
+        for n in ast.walk(e):
+            if isinstance(n, _FUNC_NODES + (ast.ClassDef,)):
+                continue
+            k = None
+            if isinstance(n, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(n, "ctx", None), ast.Load):
+                k = _ref_key(n)
+            if k is not None and k in st.dead:
+                site = st.dead[k]
+                if (k, site) in self.reported:
+                    continue
+                self.reported.add((k, site))
+                self.findings.append(Finding(
+                    rule="use-after-donate", severity=ERROR,
+                    path=self.ctx.path, line=n.lineno, col=n.col_offset,
+                    message=(f"'{k}' was passed in a donated position "
+                             f"(donate_argnums) on line {site} and read "
+                             f"afterwards — the buffer is invalid after "
+                             f"donation (double-free class); rebind it "
+                             f"from the step result or copy through "
+                             f"_fresh_device before reuse")))
+
+    def _kill_target(self, t: ast.AST, st: _FlowState) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._kill_target(e, st)
+            return
+        if isinstance(t, ast.Starred):
+            self._kill_target(t.value, st)
+            return
+        k = _ref_key(t)
+        if k is None:
+            return
+        # rebinding self.x also invalidates stale knowledge of deeper
+        # chains (self.x.y) and vice versa is NOT killed — a donated
+        # self.x.y stays dead when only self.x.y is what was donated
+        for dead_k in list(st.dead):
+            if dead_k == k or dead_k.startswith(k + "."):
+                del st.dead[dead_k]
+
+
+@register(
+    "use-after-donate", ERROR,
+    "a value passed in a donated position of a jit call is read "
+    "afterwards — donated buffers are invalid (the restore-path "
+    "double-free class); rebind or _fresh_device-copy first")
+def use_after_donate(ctx: ModuleContext) -> Iterator[Finding]:
+    table = _Donated(ctx)
+    has_direct_jit = any(
+        isinstance(n, ast.Call) and isinstance(n.func, ast.Call)
+        and _donated_argnums(n.func, ctx)
+        for n in ctx.nodes)
+    if not table.has_any and not has_direct_jit:
+        return
+    findings: list[Finding] = []
+    for node in ctx.nodes:
+        if isinstance(node, _FUNC_NODES):
+            _FunctionFlow(ctx, table, node, findings).run()
+    for f in sorted(findings, key=lambda f: (f.line, f.col)):
+        yield f

@@ -101,7 +101,7 @@ def _src(node: ast.AST) -> str:
     "a module-level jax array captured by a jitted step adds ~2.4 ms to "
     "every subsequent dispatch; module constants must be numpy")
 def module_device_array(ctx: ModuleContext) -> Iterator[Finding]:
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Call):
             continue
         c = ctx.canon(node.func)
@@ -152,13 +152,13 @@ def _host_sync_reason(ctx: ModuleContext, call: ast.Call):
     "jax.device_get over a pytree")
 def host_sync_in_loop(ctx: ModuleContext) -> Iterator[Finding]:
     flagged: dict[int, str] = {}
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Call):
             continue
         reason = _host_sync_reason(ctx, node)
         if reason and ctx.in_loop(node):
             flagged[id(node)] = reason
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if id(node) not in flagged:
             continue
         # `int(jax.device_get(x))` is ONE sync: report the outermost call
@@ -181,7 +181,7 @@ def host_sync_in_loop(ctx: ModuleContext) -> Iterator[Finding]:
     "device_get/.item()/int()/float() inside a jit-compiled body forces "
     "a concretization: trace-time failure or a silent host round-trip")
 def host_sync_in_jit(ctx: ModuleContext) -> Iterator[Finding]:
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Call):
             continue
         fn = ctx.enclosing_jitted_function(node)
@@ -222,7 +222,7 @@ def host_sync_in_jit(ctx: ModuleContext) -> Iterator[Finding]:
     "Python if/while on a traced value inside @jax.jit leaks the tracer; "
     "use jnp.where / jax.lax.cond / jax.lax.while_loop")
 def traced_branch_in_jit(ctx: ModuleContext) -> Iterator[Finding]:
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, (ast.If, ast.While)):
             continue
         if ctx.enclosing_jitted_function(node) is None:
@@ -250,7 +250,7 @@ def traced_branch_in_jit(ctx: ModuleContext) -> Iterator[Finding]:
     "Python scalars feeding shapes, non-hashable static args, and "
     "per-call jax.jit wrapping trigger a fresh trace/compile per call")
 def recompile_hazard(ctx: ModuleContext) -> Iterator[Finding]:
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.Call):
             c = ctx.canon(node.func)
             if c == ("jax", "jit"):
@@ -350,7 +350,7 @@ def quadratic_grid_hazard(ctx: ModuleContext) -> Iterator[Finding]:
     conditions, table full-scan conditions, the cap-bounded NFA pending
     grids) are grandfathered via the checked-in baseline / inline
     pragmas; any NEW cross product must justify itself the same way."""
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, (ast.BinOp, ast.Compare, ast.BoolOp)):
             continue
         # report the OUTERMOST expression of a grid chain once (an
@@ -413,7 +413,7 @@ def cross_shard_transfer_hazard(ctx: ModuleContext) -> Iterator[Finding]:
     serving/pool.py `_collect_sharded_locked` pattern — those args
     reference the shard objects, not the state names, so they pass)."""
     flagged: dict[int, str] = {}
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Call) or not node.args:
             continue
         if not ctx.in_loop(node):
@@ -431,7 +431,7 @@ def cross_shard_transfer_hazard(ctx: ModuleContext) -> Iterator[Finding]:
             continue
         if _mentions_slot_state(arg):
             flagged[id(node)] = ".".join(c)
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if id(node) not in flagged:
             continue
         if any(id(anc) in flagged for anc in ctx.ancestors(node)):
@@ -501,7 +501,7 @@ def unbounded_retry(ctx: ModuleContext) -> Iterator[Finding]:
     ``time.sleep(backoff.next_wait_s())`` (core/io.py) — pass on both
     counts; a loop whose test is a real condition (``while attempt <
     n``) is bounded by construction and out of scope."""
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.While):
             continue
         test = node.test
@@ -542,7 +542,7 @@ def unbounded_retry(ctx: ModuleContext) -> Iterator[Finding]:
     "doubles memory/ALU cost on TPU; prefer float32 or jnp.float_")
 def float64_literal(ctx: ModuleContext) -> Iterator[Finding]:
     f64 = (("jax", "numpy", "float64"), ("numpy", "float64"))
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Call):
             continue
         c = ctx.canon(node.func)
@@ -589,14 +589,14 @@ def bare_gauge_family(ctx: ModuleContext) -> Iterator[Finding]:
     contract. Plain ``gauge()`` instruments are exempt: collector-fed
     dotted gauges are documented by the statistics() schema."""
     described: set = set()
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if isinstance(node, ast.Call) \
                 and isinstance(node.func, ast.Attribute) \
                 and node.func.attr == "describe" and node.args:
             a0 = node.args[0]
             if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
                 described.add(a0.value)
-    for node in ast.walk(ctx.tree):
+    for node in ctx.nodes:
         if not isinstance(node, ast.Call) \
                 or not isinstance(node.func, ast.Attribute) \
                 or node.func.attr != "labeled_gauge":

@@ -7,20 +7,22 @@ for — canonical dotted names across import aliases (``jnp.zeros`` /
 jit-compiled, and which source lines carry ``# lint: disable=`` pragmas
 — so individual rules stay ~20 lines of pattern matching.
 
-Suppressions:
-  ``# lint: disable=rule-a,rule-b``   suppress those rules on this line
-  ``# lint: disable=*``               suppress everything on this line
-  ``# lint: disable-file=rule-a``     suppress a rule for the whole file
+Suppressions (written as ``#``-comments; the marker is elided here so
+the examples don't register as real pragmas in this module):
+  ``lint: disable=rule-a,rule-b``   suppress those rules on this line
+  ``lint: disable=*``               suppress everything on this line
+  ``lint: disable-file=rule-a``     suppress a rule for the whole file
 """
 from __future__ import annotations
 
 import ast
 import os
 import re
+from collections import deque
 from typing import Iterable, Iterator, Optional
 
 from .findings import ERROR, Finding
-from .registry import all_rules
+from .registry import module_rules
 
 _PRAGMA = re.compile(r"#\s*lint:\s*disable(?P<scope>-file)?\s*=\s*"
                      r"(?P<rules>[\w*,\- ]+)")
@@ -49,17 +51,30 @@ class ModuleContext:
         self.tree = ast.parse(source, filename=path)
         self.lines = source.splitlines()
         self.parents: dict[int, ast.AST] = {}
-        for parent in ast.walk(self.tree):
+        # one BFS (same order as ast.walk) builds both the parent map
+        # and the flat node list every rule iterates — re-walking an
+        # 80-module tree once per rule is where whole-repo lint time
+        # goes
+        self.nodes: list[ast.AST] = []
+        todo = deque([self.tree])
+        while todo:
+            parent = todo.popleft()
+            self.nodes.append(parent)
             for child in ast.iter_child_nodes(parent):
                 self.parents[id(child)] = parent
+                todo.append(child)
         self.alias_map = self._build_alias_map()
         self.line_disables, self.file_disables = self._scan_pragmas()
         self._jitted = self._find_jitted_functions()
+        # which pragma tokens actually suppressed something — the
+        # stale-pragma audit reads these after all passes ran
+        self.used_line: set[tuple[int, str]] = set()
+        self.used_file: set[str] = set()
 
     # -- imports / canonical names ------------------------------------
     def _build_alias_map(self) -> dict[str, tuple[str, ...]]:
         amap: dict[str, tuple[str, ...]] = {}
-        for node in ast.walk(self.tree):
+        for node in self.nodes:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     parts = tuple(a.name.split("."))
@@ -101,10 +116,20 @@ class ModuleContext:
         return line_dis, file_dis
 
     def suppressed(self, finding: Finding) -> bool:
-        if finding.rule in self.file_disables or "*" in self.file_disables:
+        if finding.rule in self.file_disables:
+            self.used_file.add(finding.rule)
+            return True
+        if "*" in self.file_disables:
+            self.used_file.add("*")
             return True
         dis = self.line_disables.get(finding.line, ())
-        return finding.rule in dis or "*" in dis
+        if finding.rule in dis:
+            self.used_line.add((finding.line, finding.rule))
+            return True
+        if "*" in dis:
+            self.used_line.add((finding.line, "*"))
+            return True
+        return False
 
     # -- structural helpers -------------------------------------------
     def parent(self, node: ast.AST) -> Optional[ast.AST]:
@@ -166,13 +191,13 @@ class ModuleContext:
     def _find_jitted_functions(self) -> set[int]:
         by_name: dict[str, list[ast.AST]] = {}
         jitted: set[int] = set()
-        for node in ast.walk(self.tree):
+        for node in self.nodes:
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 by_name.setdefault(node.name, []).append(node)
                 if any(self._is_jit_expr(d) for d in node.decorator_list):
                     jitted.add(id(node))
         # `stepf = jax.jit(step)` style wrapping of a local function
-        for node in ast.walk(self.tree):
+        for node in self.nodes:
             if isinstance(node, ast.Call) \
                     and self.canon(node.func) == ("jax", "jit"):
                 for arg in node.args[:1]:
@@ -207,7 +232,7 @@ def lint_source(source: str, path: str = "<string>",
                         col=e.offset or 0, message=f"syntax error: {e.msg}")]
     wanted = set(rules) if rules is not None else None
     out: list[Finding] = []
-    for rule in all_rules():
+    for rule in module_rules():
         if wanted is not None and rule.name not in wanted:
             continue
         for f in rule.check(ctx):

@@ -1,17 +1,30 @@
 """Lint rule registry.
 
-A rule is a callable ``(ModuleContext) -> Iterable[Finding]`` registered
-under a stable kebab-case id with a default severity and a one-line
-rationale (shown by ``tools/lint.py --list-rules`` and quoted in
-docs/tpu_hygiene.md). Rules are pure functions of the parsed module —
-no imports of the linted code ever happen.
+A rule is a callable registered under a stable kebab-case id with a
+default severity and a one-line rationale (shown by ``tools/lint.py
+--list-rules``, embedded as SARIF rule metadata, and quoted in
+docs/tpu_hygiene.md). Two scopes exist:
+
+- ``module`` rules: ``(ModuleContext) -> Iterable[Finding]`` — pure
+  functions of one parsed module (the TPU-hygiene AST rules);
+- ``project`` rules: ``(ProjectContext) -> Iterable[Finding]`` — the
+  whole-repo semantic passes (lock-discipline, lock-order, donation
+  reachability) that need the cross-module call graph.
+
+Rules never import the linted code. ``register_meta`` registers
+metadata-only ids (``parse-error``, ``stale-pragma``) that are emitted
+by the drivers themselves rather than a check function, so rule
+listings and SARIF metadata stay complete.
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Iterable, Iterator
+from typing import Callable, Iterable, Iterator, Optional
 
 from .findings import SEVERITIES, Finding
+
+MODULE = "module"
+PROJECT = "project"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -19,29 +32,58 @@ class Rule:
     name: str
     severity: str
     rationale: str
-    check: Callable[["ModuleContext"], Iterable[Finding]]  # noqa: F821
+    check: Optional[Callable[..., Iterable[Finding]]]  # None: metadata-only
+    scope: str = MODULE
 
 
 _RULES: dict[str, Rule] = {}
 
 
-def register(name: str, severity: str, rationale: str):
-    """Decorator: register a check function as a lint rule."""
+def _register(name: str, severity: str, rationale: str, check, scope: str):
     if severity not in SEVERITIES:
         raise ValueError(f"bad severity {severity!r} for rule {name!r}")
+    if name in _RULES:
+        raise ValueError(f"duplicate rule {name!r}")
+    _RULES[name] = Rule(name=name, severity=severity, rationale=rationale,
+                        check=check, scope=scope)
 
+
+def register(name: str, severity: str, rationale: str):
+    """Decorator: register a per-module check function as a lint rule."""
     def deco(fn):
-        if name in _RULES:
-            raise ValueError(f"duplicate rule {name!r}")
-        _RULES[name] = Rule(name=name, severity=severity,
-                            rationale=rationale, check=fn)
+        _register(name, severity, rationale, fn, MODULE)
         return fn
 
     return deco
 
 
+def register_project(name: str, severity: str, rationale: str):
+    """Decorator: register a whole-repo semantic pass
+    (``(ProjectContext) -> Iterable[Finding]``)."""
+    def deco(fn):
+        _register(name, severity, rationale, fn, PROJECT)
+        return fn
+
+    return deco
+
+
+def register_meta(name: str, severity: str, rationale: str) -> None:
+    """Register a driver-emitted rule id for listings/SARIF metadata."""
+    _register(name, severity, rationale, None, MODULE)
+
+
 def all_rules() -> Iterator[Rule]:
     return iter(sorted(_RULES.values(), key=lambda r: r.name))
+
+
+def module_rules() -> Iterator[Rule]:
+    return (r for r in all_rules()
+            if r.scope == MODULE and r.check is not None)
+
+
+def project_rules() -> Iterator[Rule]:
+    return (r for r in all_rules()
+            if r.scope == PROJECT and r.check is not None)
 
 
 def get_rule(name: str) -> Rule:

@@ -1,0 +1,93 @@
+"""SARIF 2.1.0 serialization of lint findings.
+
+One run, one tool (``siddhi-tpu-lint``), rule metadata pulled from the
+registry (rationale as the short description, default severity as the
+configuration level). Findings anchor as ``physicalLocation`` with a
+repo-root-relative URI so CI viewers (GitHub code scanning et al.) can
+jump to the line. Severity maps 1:1 — the linter's ``error``/``warning``
+are already SARIF levels.
+"""
+from __future__ import annotations
+
+import json
+from typing import Iterable, Optional
+
+from .findings import Finding
+from .registry import rule_names, get_rule
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+TOOL_NAME = "siddhi-tpu-lint"
+
+
+def _rule_meta(rule_id: str) -> dict:
+    if rule_id in rule_names():
+        r = get_rule(rule_id)
+        return {
+            "id": r.name,
+            "shortDescription": {"text": r.rationale},
+            "defaultConfiguration": {"level": r.severity},
+        }
+    # driver-synthesized ids that escaped registration
+    return {"id": rule_id,
+            "shortDescription": {"text": rule_id},
+            "defaultConfiguration": {"level": "warning"}}
+
+
+def to_sarif(findings: Iterable[Finding],
+             root_uri: Optional[str] = None) -> dict:
+    findings = list(findings)
+    ids = sorted({f.rule for f in findings} | rule_names())
+    rules = [_rule_meta(i) for i in ids]
+    index = {r["id"]: i for i, r in enumerate(rules)}
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "ruleIndex": index[f.rule],
+            "level": f.severity,
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path,
+                        "uriBaseId": "REPOROOT",
+                    },
+                    "region": {
+                        "startLine": max(1, f.line),
+                        # SARIF columns are 1-based; ast cols are 0-based
+                        "startColumn": f.col + 1,
+                    },
+                },
+            }],
+        })
+    run = {
+        "tool": {
+            "driver": {
+                "name": TOOL_NAME,
+                "informationUri":
+                    "https://example.invalid/siddhi-tpu/docs/tpu_hygiene",
+                "rules": rules,
+            },
+        },
+        "columnKind": "utf16CodeUnits",
+        "results": results,
+    }
+    if root_uri:
+        uri = root_uri if root_uri.endswith("/") else root_uri + "/"
+        if not uri.startswith("file:"):
+            uri = "file://" + uri
+        run["originalUriBaseIds"] = {"REPOROOT": {"uri": uri}}
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [run],
+    }
+
+
+def write_sarif(path: str, findings: Iterable[Finding],
+                root_uri: Optional[str] = None) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(to_sarif(findings, root_uri=root_uri), fh, indent=1)
+        fh.write("\n")

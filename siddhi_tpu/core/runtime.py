@@ -590,8 +590,9 @@ class QueryRuntime(Receiver):
 
     def stats(self) -> dict:
         """Runtime counters (device-synced on read)."""
-        return {"emitted": int(jax.device_get(self._emitted_dev)),
-                "overflow": self.overflow_total()}
+        with self._lock:  # vs restore_state rebinding the counter
+            emitted = int(jax.device_get(self._emitted_dev))
+        return {"emitted": emitted, "overflow": self.overflow_total()}
 
     # -- snapshot (SnapshotService state walk -> one device_get) ----------
     def snapshot_state(self) -> dict:
@@ -615,8 +616,9 @@ class QueryRuntime(Receiver):
         (the reference re-registers Schedulers on restore)."""
         if not self._has_timers:
             return
-        dues = [op.next_due(st) for op, st in zip(self.operators,
-                                                  self.states)
+        with self._lock:  # restore_state rebinds the whole tuple
+            states = self.states
+        dues = [op.next_due(st) for op, st in zip(self.operators, states)
                 if isinstance(op, WindowOp)]
         dues = [d for d in dues if d is not None]
         if dues:
@@ -641,7 +643,9 @@ class QueryRuntime(Receiver):
                 for v in st:
                     walk(v)
 
-        walk(jax.device_get(self.states))
+        with self._lock:  # vs restore_state rebinding mid-walk
+            host = jax.device_get(self.states)
+        walk(host)
         return total
 
     # -- runtime ---------------------------------------------------------
@@ -899,7 +903,7 @@ class QueryRuntime(Receiver):
             # genuinely need per-boundary catch-up (hopping) opt out via
             # needs_catchup.
             return
-        if self._sched_due is not None and self._sched_due <= due:
+        if self._sched_due is not None and self._sched_due <= due:  # lint: disable=racy-attribute-read (arm-dedup heuristic only; a stale due costs one redundant no-op timer arm)
             return
         self._sched_due = due
         self.app.scheduler.notify_at(due, self._on_timer)
@@ -1814,8 +1818,11 @@ class SiddhiAppRuntime:
 
     # -- time ------------------------------------------------------------
     def current_time(self) -> int:
-        if self._playback and self._playback_time is not None:
-            return self._playback_time
+        # the playback clock is ingest-thread-owned; background writers
+        # (idle advance, restore) serialize against each other via the
+        # barrier, and a clock read one write stale is by-design here
+        if self._playback and self._playback_time is not None:  # lint: disable=racy-attribute-read (ingest-thread-owned clock)
+            return self._playback_time  # lint: disable=racy-attribute-read (ingest-thread-owned clock)
         return int(time.time() * 1000)
 
     def on_ingest(self, stream_id: str, events: list[Event]) -> None:
@@ -1859,11 +1866,11 @@ class SiddhiAppRuntime:
                 self._cron_armed = True
                 base = (first_ts if first_ts is not None else last_ts) - 1
                 self._arm_cron(base)
-            if self._reorder and self._playback_time is not None:
+            if self._reorder and self._playback_time is not None:  # lint: disable=racy-attribute-read (ingest-thread-owned clock)
                 # watermark mode: PROCESS-policy late events and replay
                 # re-injection carry old timestamps — the watermark
                 # clock never regresses
-                last_ts = max(last_ts, self._playback_time)
+                last_ts = max(last_ts, self._playback_time)  # lint: disable=racy-attribute-read (ingest-thread-owned clock)
             self._playback_time = last_ts
             self._last_ingest_wall = time.monotonic()
             self.scheduler.advance_to(last_ts)
@@ -1885,8 +1892,8 @@ class SiddhiAppRuntime:
                 self._cron_armed = True
                 self._arm_cron(first_ts - 1)
             self.scheduler.advance_to(first_ts - 1)
-            if self._reorder and self._playback_time is not None:
-                last_ts = max(last_ts, self._playback_time)
+            if self._reorder and self._playback_time is not None:  # lint: disable=racy-attribute-read (ingest-thread-owned clock)
+                last_ts = max(last_ts, self._playback_time)  # lint: disable=racy-attribute-read (ingest-thread-owned clock)
             self._playback_time = last_ts
             self._last_ingest_wall = time.monotonic()
 

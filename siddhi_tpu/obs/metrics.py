@@ -223,7 +223,7 @@ class MetricsRegistry:
             self._collectors.append(fn)
 
     # -- collection ------------------------------------------------------
-    def collect(self) -> dict:
+    def collect(self) -> dict:  # thread-entry (reporter + /metrics scrape threads reach here through untyped runtime handles)
         """Run every collector, fold the results into gauges, and return
         a flat JSON-serializable ``{dotted_name: number}`` snapshot
         (histograms flatten to ``<name>.avg/.p50/.p95/.p99/.count/.sum``).

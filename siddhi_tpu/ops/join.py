@@ -325,7 +325,7 @@ class JoinCross:
 
         joinable = trig.valid & ((trig.kind == CURRENT) |
                                  (trig.kind == EXPIRED))
-        pair = grid & joinable[:, None] & opp_buf["valid"][None, :]
+        pair = grid & joinable[:, None] & opp_buf["valid"][None, :]  # lint: disable=quadratic-grid-hazard (blessed grid fallback: arbitrary ON-conditions can't use the banded probe)
         if gate_alive and self.opp_window_ms is not None:
             # columnar mode only: timer fires coalesce, so the opposite
             # buffer may hold rows its own (skipped) expiry would have
@@ -333,7 +333,7 @@ class JoinCross:
             # the trigger's timestamp. The row path fires per boundary
             # and needs no gate (the reference pairs expiring rows with
             # the opposite content AT the fire).
-            alive = (opp_buf["ts"][None, :] + self.opp_window_ms
+            alive = (opp_buf["ts"][None, :] + self.opp_window_ms  # lint: disable=quadratic-grid-hazard (liveness gate rides the already-materialized fallback grid)
                      >= trig.ts[:, None])
             pair = pair & alive
         matched_any = jnp.any(pair, axis=1)

@@ -602,7 +602,9 @@ class PartitionBlockRuntime:
         for p in self.plans:
             if not self._has_timers[p.name]:
                 continue
-            for op, st in zip(p.operators, self.qstates[p.name]):
+            with self._lock:  # restore rebinds the stacked states
+                qstates = self.qstates[p.name]
+            for op, st in zip(p.operators, qstates):
                 if isinstance(op, WindowOp):
                     d = jax.vmap(op.next_due)(st)
                     if d is not None:
@@ -615,7 +617,9 @@ class PartitionBlockRuntime:
 
     # -- introspection ----------------------------------------------------
     def overflow_total(self) -> int:
-        host = jax.device_get((self.slot_tbl, self.qstates, self._lost))
+        with self._lock:  # vs restore/process rebinding mid-read
+            host = jax.device_get((self.slot_tbl, self.qstates,
+                                   self._lost))
         tbl, qstates, losts = host
         total = int(tbl["overflow"])
         total += _tree_overflow_sum(qstates)
@@ -623,6 +627,7 @@ class PartitionBlockRuntime:
         return total
 
     def stats(self) -> dict:
-        return {"emitted": {qn: int(v) for qn, v in
-                            jax.device_get(self._emitted).items()},
+        with self._lock:  # vs the step path rebinding counters
+            emitted = jax.device_get(self._emitted)
+        return {"emitted": {qn: int(v) for qn, v in emitted.items()},
                 "overflow": self.overflow_total()}

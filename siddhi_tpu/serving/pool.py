@@ -408,11 +408,13 @@ class TenantPool:
 
     def _device_loads_locked(self) -> list:
         """Tenants currently placed per device (host-side bookkeeping;
-        caller holds the lock)."""
-        loads = [0] * self.n_devices
-        for slot in self._tenants.values():
-            loads[self._device_of_slot(slot)] += 1
-        return loads
+        re-entrant — callers already inside the RLock pay nothing,
+        admission probes arriving lock-free get a consistent count)."""
+        with self._lock:
+            loads = [0] * self.n_devices
+            for slot in self._tenants.values():
+                loads[self._device_of_slot(slot)] += 1
+            return loads
 
     def _pick_slot(self) -> int:
         """Pop a free slot, mesh-aware: choose the slot on the device
@@ -965,7 +967,7 @@ class TenantPool:
         through, and the delivery outcome feeds the state machine.
         Shared by the round delivery path and replay_errors."""
         gate = "closed"
-        if self._qos is not None:
+        if self._qos is not None:  # lint: disable=racy-attribute-read (qos ref rebinds only under restore quiesce; a stale ref delays new dials one round)
             with self._lock:
                 # gate() on an elapsed cooldown IS the HALF_OPEN
                 # transition, so it runs only when rows are in hand
@@ -982,7 +984,7 @@ class TenantPool:
                 except Exception as exc:  # noqa: BLE001 — isolate
                     failed = True
                     self._tenant_error(tid, sid, events, exc)
-        if self._qos is not None:
+        if self._qos is not None:  # lint: disable=racy-attribute-read (qos ref rebinds only under restore quiesce; a stale ref delays new dials one round)
             with self._lock:
                 self._qos.on_delivery(tid, ok=not failed)
 
@@ -999,7 +1001,7 @@ class TenantPool:
                 self.tenant_partition(tid),
                 ErroredEvent.from_events(
                     sid, events, "circuit-open: delivery short-circuited",
-                    now=self._now))
+                    now=self._now))  # lint: disable=racy-attribute-read (monotonic round clock; an error-record timestamp one round stale is tolerable)
         except Exception:  # noqa: BLE001 — isolation must not cascade
             log.exception("pool '%s': error-store write failed for "
                           "short-circuited tenant '%s'", self.name, tid)
@@ -1034,7 +1036,7 @@ class TenantPool:
                 self.tenant_partition(tid),
                 ErroredEvent.from_events(
                     sid, events, f"{type(exc).__name__}: {exc}",
-                    now=self._now))
+                    now=self._now))  # lint: disable=racy-attribute-read (monotonic round clock; an error-record timestamp one round stale is tolerable)
         except Exception:  # noqa: BLE001 — isolation must not cascade
             log.exception("pool '%s': error-store write failed for "
                           "tenant '%s'", self.name, tid)

@@ -1,0 +1,46 @@
+"""Seeded antipattern: ABBA lock ordering across two classes
+(lock-order-cycle) — the registry collect-vs-record shape.
+
+``Registry.collect_one`` holds ``Registry._lock`` and calls into
+``Tracker.record_total`` which takes ``Tracker._lock``; meanwhile
+``Tracker.record`` holds ``Tracker._lock`` and calls back into
+``Registry.bump`` which takes ``Registry._lock``. Two threads on the
+two paths deadlock.
+"""
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._trackers = []
+
+    def add(self, tracker):
+        with self._lock:
+            self._trackers.append(tracker)
+
+    def collect_one(self, t: "Tracker"):
+        # BAD edge A: Registry._lock held -> acquires Tracker._lock
+        with self._lock:
+            t.record_total()
+
+    def bump(self, t):
+        with self._lock:
+            pass
+
+
+class Tracker:
+    def __init__(self, registry: "Registry"):
+        self._lock = threading.Lock()
+        self.registry = registry
+        self.total = 0
+
+    def record_total(self):
+        with self._lock:
+            self.total += 1
+
+    def record(self, n):
+        # BAD edge B: Tracker._lock held -> acquires Registry._lock
+        with self._lock:
+            self.total += n
+            self.registry.bump(self)

@@ -1,0 +1,86 @@
+"""Seeded antipattern: the pre-hardening ``LatencyTracker.summary``
+shape (racy-attribute-read) — record paths rebind sample state under
+``self._lock`` while a reporter thread reads the same attributes
+lock-free. The writes are plain stores (``+=`` / rebinds), the class of
+torn state the rule targets.
+
+Also seeds the NEGATIVES the rule must stay quiet on:
+
+- ``summary_locked``   takes the lock around the same reads;
+- ``_percentile``      reads lock-free but every resolved caller holds
+                       the lock (interprocedural entry-held inference);
+- ``Quiet``            identical shape, but no thread ever reaches it.
+"""
+import threading
+
+
+class Tracker:
+    """Writers lock, the thread-reachable reader does not."""
+
+    CAP = 1024
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = ()
+        self._count = 0
+
+    def record(self, dt):
+        with self._lock:
+            self._samples = (self._samples + (dt,))[-self.CAP:]
+            self._count += 1
+
+    def summary(self):
+        # BAD: reporter-thread reads of lock-guarded attrs, no lock
+        if not self._samples:                      # racy read
+            return None
+        xs = sorted(self._samples)                 # racy read
+        return {"p50": xs[len(xs) // 2], "n": self._count}  # racy read
+
+    def summary_locked(self):
+        # OK: snapshot under the same lock the writers hold
+        with self._lock:
+            xs = sorted(self._samples)
+        return {"p50": xs[len(xs) // 2]} if xs else None
+
+    def _percentile(self, q):
+        # OK lock-free: every resolved caller already holds the lock,
+        # so the entry-held meet puts _lock in scope here
+        xs = sorted(self._samples)
+        return xs[int(q * (len(xs) - 1))] if xs else None
+
+    def quantiles(self):
+        with self._lock:
+            return self._percentile(0.5), self._percentile(0.95)
+
+
+class Reporter:
+    """Background thread that scrapes the tracker — makes
+    ``Tracker.summary`` thread-reachable."""
+
+    def __init__(self, tracker: "Tracker"):
+        self.tracker = tracker
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.tracker.summary()
+            self.tracker.quantiles()
+
+
+class Quiet:
+    """Same attribute shape as Tracker, but nothing threaded reaches
+    it — the rule must stay silent (reachability gate)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def tick(self):
+        with self._lock:
+            self._count += 1
+
+    def snapshot(self):
+        return self._count

@@ -1,0 +1,75 @@
+"""Seeded antipattern: thread-entry reachability feeding
+racy-attribute-read — the three ways a function becomes a thread entry:
+
+- ``threading.Thread(target=...)``                 (``Worker._run``)
+- a callback registrar (``executor.submit``)       (``submit_probe``)
+- an explicit ``# thread-entry`` def-line mark     (``annotated_scrape``)
+
+``Quietish.peek`` has the racy shape but is only reachable from
+unmarked, unthreaded code — the rule must stay silent there.
+"""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ticks = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self._tick()
+
+    def _tick(self):
+        with self._lock:
+            self._ticks += 1
+
+    def snapshot(self):
+        # BAD: lock-free read of _ticks while the Thread-target path
+        # writes it under the lock
+        return self._ticks
+
+
+def submit_probe(executor, w: "Worker"):
+    # registrar: submit(fn) makes Worker.snapshot a thread entry, so
+    # its racy read above counts as thread-reachable
+    executor.submit(w.snapshot)
+
+
+class Config:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vals = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._vals = {**self._vals, k: v}
+
+    def peek(self):
+        # BAD when reached from a thread entry (annotated_scrape)
+        return dict(self._vals)
+
+
+def annotated_scrape(cfg: "Config"):  # thread-entry
+    return cfg.peek()
+
+
+class Quietish:
+    """Racy shape, but only plain unthreaded code reaches it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._vals = {}
+
+    def put(self, k, v):
+        with self._lock:
+            self._vals = {**self._vals, k: v}
+
+    def peek(self):
+        return dict(self._vals)
+
+
+def plain_main(q: "Quietish"):
+    q.put("k", 1)
+    return q.peek()

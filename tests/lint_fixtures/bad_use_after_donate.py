@@ -1,0 +1,64 @@
+"""Seeded antipattern: reading a value after passing it in a donated
+argument position (use-after-donate) — the restore double-free shape.
+Donation hands the buffer to XLA; touching the old reference afterwards
+reads freed device memory.
+
+Negatives the rule must stay quiet on: rebinding the name from the
+call result (``run_good``) and re-materializing through a
+``_fresh_device``-style copy (``Runtime.restore_good``).
+"""
+import jax
+
+
+def _donate(*argnums):
+    return {"donate_argnums": argnums}
+
+
+def _fresh_device(tree):
+    """Re-materialize a host snapshot as fresh device buffers."""
+    return jax.device_put(tree)
+
+
+def step(states, buf, x):
+    return states, buf
+
+
+stepf = jax.jit(step, **_donate(0, 1))
+
+
+def run_bad(states, buf, xs):
+    out = None
+    for x in xs:
+        # BAD: states/buf donated on iteration 1, passed again (read)
+        # on iteration 2 without rebinding
+        out = stepf(states, buf, x)
+    return out
+
+
+def run_good(states, buf, xs):
+    for x in xs:
+        # OK: the loop rebinds both donated names from the call result
+        states, buf = stepf(states, buf, x)
+    return states
+
+
+class Runtime:
+    def __init__(self, states):
+        self.states = states
+        self._step = jax.jit(step, donate_argnums=(0,))
+
+    def process(self, batch):
+        # OK: donated self.states rebound from the same call
+        self.states, out = self._step(self.states, batch)
+        return out
+
+    def restore_bad(self, snapshot):
+        self._step(self.states, snapshot)
+        # BAD: self.states was donated above and never rebound
+        return self.states
+
+    def restore_good(self, snapshot):
+        self._step(self.states, snapshot)
+        # OK: fresh device buffers re-bind the donated reference
+        self.states = _fresh_device(snapshot)
+        return self.states

@@ -8,13 +8,22 @@ without jax ever materializing a device array.
 """
 import pathlib
 
-from siddhi_tpu.analysis import lint_file, lint_source, rule_names
+from siddhi_tpu.analysis import (lint_file, lint_project, lint_source,
+                                 rule_names)
 
 FIXTURES = pathlib.Path(__file__).parent / "lint_fixtures"
 
 
 def findings_for(name):
     return lint_file(str(FIXTURES / name), rel_path=name)
+
+
+def project_findings(*names):
+    """Whole-project semantic lint over a set of fixture modules —
+    the project-scope rules (racy-attribute-read, lock-order-cycle)
+    need the cross-module call graph that lint_file never builds."""
+    return lint_project([str(FIXTURES / n) for n in names],
+                        root=str(FIXTURES))
 
 
 def lines_of(findings, rule):
@@ -76,8 +85,8 @@ def test_quadratic_grid_hazard_fires_once_per_expression():
     """[B,W]-style cross products ([:, None] against [None, :]) fire
     once per outermost expression; single-axis broadcasts, the
     searchsorted probe idiom, and pragma'd blessed fallbacks stay
-    clean (the intentional ops/join.py grid fallback rides the
-    checked-in baseline instead)."""
+    clean (the intentional ops/join.py grid fallback carries inline
+    `# lint: disable=quadratic-grid-hazard` justifications)."""
     fs = findings_for("bad_grid.py")
     assert lines_of(fs, "quadratic-grid-hazard") == [8, 14]
     f = [x for x in fs if x.rule == "quadratic-grid-hazard"][0]
@@ -216,3 +225,111 @@ def test_unbounded_retry_registered_and_repo_clean():
     src = pathlib.Path(__file__).parents[1] / "siddhi_tpu" / "core" / "io.py"
     fs = lint_file(str(src), rel_path="siddhi_tpu/core/io.py")
     assert [x for x in fs if x.rule == "unbounded-retry"] == []
+
+
+# ---------------------------------------------------------------------
+# semantic (project-scope) passes: lock discipline, lock order, donation
+# ---------------------------------------------------------------------
+
+
+def test_racy_attribute_read_fires_on_snapshot_race():
+    """The pre-hardening LatencyTracker.summary shape: record paths
+    rebind sample state under self._lock, the reporter-thread summary
+    reads it lock-free — every lock-free read in summary fires."""
+    fs = project_findings("bad_racy_counter.py")
+    assert lines_of(fs, "racy-attribute-read") == [34, 36, 37]
+    f = [x for x in fs if x.rule == "racy-attribute-read"][0]
+    assert f.severity == "warning"
+    assert "_lock" in f.message
+    # negatives: the locked snapshot (summary_locked), the helper whose
+    # every caller holds the lock (_percentile via the entry-held
+    # meet), and the thread-unreachable Quiet class all stay silent
+    assert all(x.line <= 37 for x in fs)
+
+
+def test_thread_entry_variants_gate_reachability():
+    """Thread targets, callback registrars (executor.submit) and the
+    explicit `# thread-entry` mark all make a function a root; the
+    identical racy shape with no threaded path (Quietish) is silent."""
+    fs = project_findings("bad_thread_entry.py")
+    assert lines_of(fs, "racy-attribute-read") == [31, 51]
+
+
+def test_lock_order_cycle_reports_abba():
+    """Registry.collect_one (R held -> T) vs Tracker.record (T held ->
+    R): the cross-class ABBA cycle is an ERROR naming both locks."""
+    fs = project_findings("bad_lock_order.py")
+    cyc = [x for x in fs if x.rule == "lock-order-cycle"]
+    assert cyc
+    assert cyc[0].severity == "error"
+    assert "Registry._lock" in cyc[0].message
+    assert "Tracker._lock" in cyc[0].message
+
+
+def test_use_after_donate_fires_and_rebinding_kills():
+    """Reading a name after it went into a donate_argnums position is
+    an ERROR (restore double-free class); rebinding from the call
+    result or through a _fresh_device-style copy clears the taint."""
+    fs = findings_for("bad_use_after_donate.py")
+    assert lines_of(fs, "use-after-donate") == [34, 34, 58]
+    f = [x for x in fs if x.rule == "use-after-donate"][0]
+    assert f.severity == "error"
+    # run_good / process / restore_good stay silent
+    assert {x.line for x in fs} == {34, 58}
+
+
+def test_guarded_by_annotation_declares_invariant(tmp_path):
+    """`# guarded-by: <lock>` states the invariant where inference
+    can't see a locked write (attr only assigned pre-publication):
+    lock-free reads on thread-reachable paths then fire, locked reads
+    don't."""
+    mod = tmp_path / "box.py"
+    mod.write_text(
+        "import threading\n"
+        "\n"
+        "\n"
+        "class Box:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.val = 0  # guarded-by: _lock\n"
+        "        self._t = threading.Thread(target=self.reader)\n"
+        "\n"
+        "    def reader(self):\n"
+        "        return self.val\n"
+        "\n"
+        "    def reader_locked(self):\n"
+        "        with self._lock:\n"
+        "            return self.val\n")
+    fs = lint_project([str(mod)], root=str(tmp_path))
+    assert [(f.rule, f.line) for f in fs] == [("racy-attribute-read", 11)]
+
+
+def test_stale_pragma_flags_dead_suppressions(tmp_path):
+    """A pragma that stopped suppressing anything is itself a WARNING
+    (dead suppressions mask future bugs); a pragma that still earns
+    its keep is not."""
+    live = tmp_path / "live.py"
+    live.write_text(
+        "import jax.numpy as jnp\n"
+        "X = jnp.zeros((2,))  # lint: disable=module-device-array\n")
+    dead = tmp_path / "dead.py"
+    dead.write_text("x = 1  # lint: disable=module-device-array\n")
+    fs = lint_project([str(live), str(dead)], root=str(tmp_path))
+    assert [(f.rule, f.path) for f in fs] == [("stale-pragma", "dead.py")]
+
+
+def test_stale_pragma_audit_skipped_on_rule_filtered_runs(tmp_path):
+    """A --rule-filtered run can't tell a stale pragma from a
+    not-yet-checked one, and a --changed subset lacks the cross-module
+    evidence — the audit only runs on full sweeps."""
+    dead = tmp_path / "dead.py"
+    dead.write_text("x = 1  # lint: disable=module-device-array\n")
+    assert lint_project([str(dead)], root=str(tmp_path),
+                        rules=["module-device-array"]) == []
+    assert lint_project([str(dead)], root=str(tmp_path),
+                        audit_suppressions=False) == []
+
+
+def test_semantic_rules_registered():
+    assert {"racy-attribute-read", "lock-order-cycle", "use-after-donate",
+            "stale-pragma"} <= rule_names()

@@ -11,10 +11,16 @@ Usage (from anywhere; relative paths resolve against the repo root):
         --update-baseline                 # re-grandfather current findings
     python tools/lint.py --plan apps/     # validate + type-check .siddhi
                                           # query files (exit 1 on errors)
+    python tools/lint.py --changed        # only git-modified .py files
+    python tools/lint.py --sarif out.sarif  # + SARIF 2.1.0 for CI viewers
+    python tools/lint.py --no-semantic    # per-module AST rules only
 
-Exits nonzero when any non-baselined, non-suppressed finding exists —
-this is the CI gate (tests/test_lint_repo.py runs the same check in
-tier-1).
+The default run is the whole-repo pass: per-module TPU-hygiene rules
+plus the semantic analyses (callgraph + thread-entry reachability,
+lock-discipline, lock-order cycles, use-after-donate) and the
+stale-suppression audit. Exits nonzero when any non-baselined,
+non-suppressed finding exists — this is the CI gate
+(tests/test_lint_repo.py runs the same check in tier-1).
 """
 import os
 import sys
