@@ -776,6 +776,39 @@ def _run_tenant_fairness(rows: int, batch_max: int, skew: int = 8):
     }
 
 
+def _run_tenant_rebalance(skew: int = 8, starved_rows: int = 64):
+    """Live-migration REBALANCE arm (docs/serving.md "Live migration &
+    rebalance"): one hot tenant floods ``skew``x the starved tenant's
+    traffic into the device they share (sharded pool, per-device round
+    caps), one live migration moves the hot tenant off, and the arm
+    reports the starved p99 before/after vs a no-hot fair twin, the
+    migration pause, and the rows moved. Runs the SAME seeded scenario
+    the chaos suite asserts on (tools/chaos.py --mesh), so the bench
+    number and the chaos acceptance can never drift apart. Needs >= 2
+    devices (TPU mesh, or the forced-CPU-shim smoke); skipped
+    otherwise."""
+    if len(jax.devices()) < 2:
+        return {"skipped": "needs >= 2 devices for a sharded pool"}
+    from siddhi_tpu.resilience.scenarios import run_mesh_hot_tenant_skew
+    # flood_rounds x 16-row chunks / starved_rows == the skew factor
+    res = run_mesh_hot_tenant_skew(
+        seed=11, flood_rounds=skew * starved_rows // 16,
+        starved_rows=starved_rows)
+    return {
+        "skew": skew,
+        "rows_per_starved_tenant": starved_rows,
+        "starved_p99_ms_before": res["starved_p99_ms_before"],
+        "starved_p99_ms_after": res["starved_p99_ms_after"],
+        "starved_p99_ms_fair": res["starved_p99_ms_fair"],
+        "p99_restored": res["p99_restored"],
+        "bit_identical": res["bit_identical"],
+        "migration_pause_ms": res["migration_pause_ms"],
+        "rows_moved": res["rows_moved"],
+        "lost": res["lost"],
+        "duplicates": res["duplicates"],
+    }
+
+
 def bench_tenants():
     """Multi-tenant serving acceptance (ROADMAP item 2): N tenants of
     ONE filter+window template as a vmapped TenantPool vs N separate
@@ -786,7 +819,10 @@ def bench_tenants():
     objective with one hot tenant (docs/observability.md). The
     ``fairness`` block is the QoS acceptance: hot tenant at 8x with
     and without QoS — starved-tenant p99 vs the 2x-of-fair bound,
-    per-class drain order, throttled_429s (docs/serving.md)."""
+    per-class drain order, throttled_429s (docs/serving.md). The
+    ``rebalance`` block is the live-migration acceptance: 8x skew on
+    a sharded pool healed by one migration, starved p99 before/after
+    vs the fair twin + pause ms + rows moved (ISSUE 17)."""
     n_list = [int(x) for x in
               _env("SIDDHI_BENCH_TENANTS", "64,256,1024").split(",")
               if x.strip()]
@@ -818,6 +854,7 @@ def bench_tenants():
         }
     slo_arm = _run_tenant_slo(min(n_list), rows, batch_max)
     fairness = _run_tenant_fairness(rows, batch_max)
+    rebalance = _run_tenant_rebalance()
     n_max = max(n_list)
     head = per_n[n_max]
     return {
@@ -834,6 +871,7 @@ def bench_tenants():
         "plan": plan,
         "slo": slo_arm,
         "fairness": fairness,
+        "rebalance": rebalance,
     }
 
 

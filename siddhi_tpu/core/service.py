@@ -195,6 +195,36 @@ class SiddhiService:
                         return self._send(404, {"error": str(e)})
                     except Exception as e:  # noqa: BLE001 — to client
                         return self._send(400, {"error": str(e)})
+                if self.path.startswith("/siddhi/tenant/migrate/"):
+                    # live slot migration: {"device": N} moves one
+                    # tenant between mesh devices at the next round
+                    # boundary (docs/serving.md)
+                    parts = self.path.split("/")
+                    if len(parts) != 6:
+                        return self._send(404, {"error": "not found"})
+                    try:
+                        return self._send(200, service.tenant_migrate(
+                            parts[4], parts[5], self._json_body()))
+                    except AdmissionError as e:
+                        return self._send_429(e)
+                    except KeyError as e:
+                        return self._send(404, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 — to client
+                        return self._send(400, {"error": str(e)})
+                if self.path.startswith("/siddhi/tenant/evacuate/"):
+                    # device-loss recovery: lost slots restore from the
+                    # newest pool checkpoint onto surviving devices
+                    # (docs/resilience.md "Device evacuation")
+                    parts = self.path.split("/")
+                    if len(parts) != 5:
+                        return self._send(404, {"error": "not found"})
+                    try:
+                        return self._send(
+                            200, service.tenant_evacuate(parts[4]))
+                    except KeyError as e:
+                        return self._send(404, {"error": str(e)})
+                    except Exception as e:  # noqa: BLE001 — to client
+                        return self._send(400, {"error": str(e)})
                 if self.path != "/siddhi/artifact/deploy":
                     return self._send(404, {"error": "not found"})
                 n = int(self.headers.get("Content-Length", 0))
@@ -381,7 +411,8 @@ class SiddhiService:
         pool_conf = dict(body.get("pool") or {})
         pool_kwargs = {k: pool_conf[k] for k in
                        ("slots", "max_tenants", "state_quota_bytes",
-                        "batch_max", "pending_cap", "slo", "qos")
+                        "batch_max", "pending_cap", "slo", "qos",
+                        "device_round_cap")
                        if k in pool_conf}
         pool = self.templates.pool(template,
                                    shared=body.get("shared"),
@@ -465,6 +496,36 @@ class SiddhiService:
         restored, replayed = sup.recover()
         return {"status": "recovered", "pool": pool_name,
                 "restored": restored, "replayed": replayed}
+
+    def tenant_migrate(self, pool_name: str, tenant: str,
+                       body: dict) -> dict:
+        """``POST /siddhi/tenant/migrate/<pool>/<tid>`` with
+        ``{"device": N}``: live-migrate one tenant's slot to another
+        mesh device at the next round boundary (zero recompiles,
+        bit-identical state, parked-ingest flip — serving/migrate.py
+        protocol; docs/serving.md "Live migration & rebalance")."""
+        pool = self._pool(pool_name)
+        if "device" not in body:
+            raise ValueError("migrate body needs 'device' (target "
+                             "mesh device index)")
+        rec = pool.migrate_tenant(tenant, int(body["device"]),
+                                  cause=str(body.get("cause",
+                                                     "manual")))
+        return {"status": "migrated", "pool": pool_name, **rec}
+
+    def tenant_evacuate(self, pool_name: str) -> dict:
+        """``POST /siddhi/tenant/evacuate/<pool>``: restore every
+        lost-device victim from the newest restorable pool checkpoint
+        onto the surviving devices, then replay their error backlog in
+        original-timestamp order (serving/migrate.py evacuate;
+        docs/resilience.md "Device evacuation")."""
+        from ..serving.migrate import evacuate
+        pool = self._pool(pool_name)
+        out = evacuate(pool)
+        return {"status": "evacuated", "pool": pool_name,
+                "evacuated": out["evacuated"],
+                "revision": out["revision"],
+                "replayed": out["replayed"]}
 
     def tenant_stats(self, pool_name: str,
                      tenant: str = None) -> dict:

@@ -456,6 +456,21 @@ class SLOEngine:
             self.recorder.record("span", scope=scope_name(labels),
                                  lat_ms=round(lat_ms, 3), rows=rows)
 
+    def percentiles_since(self, labels: tuple,
+                          since_wall_ms: float) -> dict:
+        """Latency percentiles for one scope restricted to samples at
+        or after ``since_wall_ms`` (epoch ms) — the before/after phase
+        split the migration scenarios and bench use to show a starved
+        tenant's p99 recovering across a move. Returns counts only
+        ({'count': 0}) when the scope has no samples in range."""
+        with self._lock:
+            w = self._windows.get(labels)
+            vals = [v for t, v in w.samples
+                    if t >= since_wall_ms] if w is not None else []
+        out = _percentiles(vals)
+        out["count"] = len(vals)
+        return out
+
     # -- evaluation -------------------------------------------------------
     def _scope_entry(self, w: _Window, now_ms: float) -> dict:
         obj = self.objective

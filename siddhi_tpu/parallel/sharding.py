@@ -177,6 +177,21 @@ def check_divisible(n: int, mesh: Mesh, what: str) -> None:
             f"'{axis}' ({nd} devices)")
 
 
+def device_of_index(index: int, n: int, mesh: Mesh, axis=None) -> int:
+    """Mesh position that owns row ``index`` of a length-``n`` leading
+    axis sharded over ``axis`` (first axis by default) — the host-side
+    twin of the ``PartitionSpec(axis)`` block layout. The tenant pool's
+    slot->device math (placement budgets, migration and evacuation
+    targets) routes through here so it can never drift from the rule-
+    table placement that `shard_pytree` actually applies."""
+    axis = axis or mesh.axis_names[0]
+    nd = int(mesh.shape[axis])
+    if not 0 <= index < n:
+        raise ValueError(f"index {index} out of range for axis of "
+                         f"length {n}")
+    return index // (n // nd)
+
+
 def _already_placed(leaf, sharding: NamedSharding) -> bool:
     cur = getattr(leaf, "sharding", None)
     if cur is None:          # host numpy: never placed

@@ -287,6 +287,18 @@ class FaultInjector:
         orig_cols(hts, hcols)
         return int(hts.shape[0])
 
+    # -- devices ----------------------------------------------------------
+    def kill_device(self, pool, device: int) -> dict:
+        """Mark one mesh device lost on a tenant pool (the device-loss
+        fault, serving/pool.py `mark_device_lost`): the pool degrades —
+        surviving slots keep serving, the dead device's tenants await
+        `serving.migrate.evacuate`, admission budgets re-derive over
+        the survivors. Unlike the transport faults there is nothing to
+        heal: recovery is the evacuation path, not un-patching."""
+        self._arm("kill_device", pool=pool.name, device=device)
+        self.injected["kill_device"] += 1
+        return pool.mark_device_lost(device)
+
     # -- persistence ------------------------------------------------------
     def corrupt_saves(self, store, mode: str = "truncate",
                       times: Optional[int] = None) -> None:

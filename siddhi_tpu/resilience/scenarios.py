@@ -612,3 +612,363 @@ def run_soak(seed: int = 0, rounds: int = 5) -> list[dict]:
             rate=0.5 + 0.1 * (r % 5))
         results.append(res)
     return results
+
+
+# ---------------------------------------------------------------------------
+# mesh scenarios (tools/chaos.py --mesh; tests/test_resilience.py
+# TestMeshChaos) — need >= 2 devices (the CPU shim provides them via
+# XLA_FLAGS=--xla_force_host_platform_device_count)
+# ---------------------------------------------------------------------------
+
+
+def _mesh_pool(name: str, mgr=None, batch_max: int = 16,
+               device_round_cap: int = 16, qos=None):
+    from ..parallel.sharding import build_mesh
+    from ..serving import Template, TenantPool
+    from .. import SiddhiManager
+    return TenantPool(
+        Template(POOL_TPL), manager=mgr or SiddhiManager(),
+        name=name, slots=8, max_tenants=8, batch_max=batch_max,
+        mesh=build_mesh(2), device_round_cap=device_round_cap,
+        qos=qos, slo={"p99_ms": 10_000.0, "target": 0.99, "every": 1})
+
+
+def run_mesh_hot_tenant_skew(seed: int = 0, flood_rounds: int = 24,
+                             starved_rows: int = 64) -> dict:
+    """Hot-tenant skew -> live migration restores the starved p99.
+
+    Two tenants land on the same device ('hot' and 'starved' — the
+    balanced picker places them on device 1, 'b' on device 0); the
+    per-device round cap means hot's flood consumes device 1's entire
+    budget every round, so starved's rows wait out the whole flood
+    (phase 1: p99 blows past the 2x-fair bound). Migrating hot to
+    device 0 (`migrate_tenant`, cause='skew') frees the device:
+    starved's identical phase-2 traffic drains at the fair cadence and
+    its p99 lands within the PR 15 2x-fair bound measured on a no-hot
+    twin pool. The move is asserted bit-identical (snapshot_tenant
+    before/after), zero rows are lost or duplicated anywhere, and the
+    migration is flight-recorded with cause + before/after placement.
+    """
+    import time as _time
+
+    import jax
+    import numpy as np
+
+    from ..core.persistence import deserialize
+
+    batch = 16
+    flood = batch * flood_rounds
+
+    def phase(pool, tid, rows, base, eng_labels):
+        """Send `rows` for tid up front, then pump until drained (every
+        round sleeps ~2ms so queue-wait converts into measurable wall
+        latency on a fast CPU)."""
+        t0_ms = _time.time() * 1000.0
+        ts, cols = _pool_chunk(rows, seed + base, base)
+        pool.send(tid, ts, cols)
+        for _ in range(flood_rounds * 4):
+            _time.sleep(0.002)
+            pool.pump()
+            if not any(pool._pending_rows.get(t, 0)
+                       for t in pool._tenants):
+                break
+        return pool.slo_engine.percentiles_since(eng_labels, t0_ms)
+
+    labels = (("tenant", "starved"),)
+    delivered: dict = {}
+
+    def hook(tid, pool):
+        pool.add_callback(
+            tid, lambda evs, t=tid: delivered.setdefault(
+                t, []).extend(evs))
+
+    # -- skewed pool: hot floods device 1, starved shares it ----------
+    pool = _mesh_pool(f"meshskew{seed}")
+    # the balanced picker alternates devices, so this add order
+    # COLOCATES hot and starved (hot->d1, b->d0, starved->d1) — the
+    # skew the rebalance machinery exists to fix
+    for tid in ("hot", "b", "starved"):
+        pool.add_tenant(tid, {"lo": 0.0})
+        hook(tid, pool)
+    d_hot = pool._device_of_slot(pool._tenants["hot"])
+    d_b = pool._device_of_slot(pool._tenants["b"])
+    d_starved = pool._device_of_slot(pool._tenants["starved"])
+    faults = [{"fault": "hot_tenant_skew", "seed": seed,
+               "flood_rows": flood, "device": d_hot}]
+
+    # phase 1: flood hot, then send starved's rows — device 1's round
+    # cap goes to hot (insertion order) until the flood drains
+    ts, cols = _pool_chunk(flood, seed + 1, 1_000_000)
+    pool.send("hot", ts, cols)
+    p99_before = phase(pool, "starved", starved_rows,
+                       2_000_000, labels).get("p99_ms")
+
+    # the move: snapshot -> migrate -> snapshot must be bit-identical
+    snap_a = deserialize(pool.snapshot_tenant("hot"))
+    rec = pool.migrate_tenant("hot", d_b, cause="skew")
+    snap_b = deserialize(pool.snapshot_tenant("hot"))
+    fa, _ = jax.tree_util.tree_flatten(snap_a["queries"])
+    fb, _ = jax.tree_util.tree_flatten(snap_b["queries"])
+    bit_identical = all(np.array_equal(np.asarray(x), np.asarray(y))
+                        for x, y in zip(fa, fb))
+
+    # phase 2: identical starved traffic + a fresh hot flood — now on
+    # separate devices, so starved drains at the fair cadence
+    ts, cols = _pool_chunk(flood, seed + 3, 3_000_000)
+    pool.send("hot", ts, cols)
+    after = phase(pool, "starved", starved_rows, 4_000_000, labels)
+    p99_after = after.get("p99_ms")
+    mig_log = pool.migration_log()
+    pool.shutdown()
+
+    # -- fair twin: same starved traffic, no hot tenant ----------------
+    fair = _mesh_pool(f"meshfair{seed}")
+    for tid in ("starved", "b"):
+        fair.add_tenant(tid, {"lo": 0.0})
+    fair_delivered: dict = {}
+    fair.add_callback("starved",
+                      lambda evs: fair_delivered.setdefault(
+                          "starved", []).extend(evs))
+    t0_ms = _time.time() * 1000.0
+    ts, cols = _pool_chunk(starved_rows, seed + 2, 2_000_000)
+    fair.send("starved", ts, cols)
+    for _ in range(flood_rounds * 4):
+        _time.sleep(0.002)
+        if fair.pump() == 0 and not any(
+                fair._pending_rows.get(t, 0) for t in fair._tenants):
+            break
+    p99_fair = fair.slo_engine.percentiles_since(
+        labels, t0_ms).get("p99_ms")
+    fair.shutdown()
+
+    def key_rows(evs):
+        return sorted((e.timestamp, e.data[1]) for e in evs)
+
+    sent_starved = 2 * starved_rows
+    got_starved = key_rows(delivered.get("starved", []))
+    lost = sent_starved - len(got_starved)
+    dup = len(got_starved) - len(set(got_starved))
+    bound = (p99_fair is not None and p99_after is not None
+             and p99_after <= max(2.0 * p99_fair, p99_fair + 50.0))
+    return {
+        "same_device_before": d_hot == d_starved,
+        "migration": rec,
+        "migration_logged": any(
+            m["tenant"] == "hot" and m["cause"] == "skew"
+            and m["from"]["device"] == d_hot
+            and m["to"]["device"] == d_b for m in mig_log),
+        "bit_identical": bit_identical,
+        "starved_p99_ms_before": p99_before,
+        "starved_p99_ms_after": p99_after,
+        "starved_p99_ms_fair": p99_fair,
+        "p99_restored": bound,
+        "p99_improved": (p99_before is not None
+                         and p99_after is not None
+                         and p99_after < p99_before),
+        "hot_delivered": len(delivered.get("hot", [])),
+        "hot_sent": 2 * flood,
+        "lost": lost,
+        "duplicates": dup,
+        "migration_pause_ms": rec.get("pause_ms"),
+        "rows_moved": rec.get("rows_moved"),
+        "faults": faults,
+    }
+
+
+def run_mesh_kill_device(seed: int = 0) -> dict:
+    """Kill-device -> degraded serving -> checkpoint evacuation.
+
+    A supervised mesh pool (checkpoint every 2 rounds) serves a & c on
+    device 1 and b on device 0; c's callback is dead, so its output
+    accumulates in its error partition. After round 4's checkpoint the
+    round-5 chunks are SENT but not pumped, and `FaultInjector
+    .kill_device` takes device 1 down — a and c become victims with
+    their pending round-5 rows RETAINED. The pool keeps serving b
+    degraded (admission still answers, budgets re-derived over the
+    survivor), then `evacuate` grafts a's and c's slots from the
+    round-4 checkpoint onto device 0 — bit-identical to their pre-kill
+    snapshots. c heals, its error backlog replays in original-ts order,
+    and the retained round-5 queues drain: every row sent to a and c is
+    delivered exactly once. Recovery age + evacuation count land in
+    ``statistics()['mesh']``."""
+    import jax
+    import numpy as np
+
+    from ..core.persistence import deserialize
+    from ..serving.migrate import evacuate
+    from .faults import FaultInjector
+    from .supervisor import PoolCheckpointSupervisor
+    from .. import InMemoryPersistenceStore, SiddhiManager
+    from .errorstore import InMemoryErrorStore
+
+    mgr = SiddhiManager()
+    mgr.set_persistence_store(InMemoryPersistenceStore())
+    mgr.set_error_store(InMemoryErrorStore())
+    pool = _mesh_pool(f"meshkill{seed}", mgr=mgr)
+    delivered: dict = {"a": [], "b": [], "c": []}
+    # add order (a, b, c): the balanced picker puts a->d1, b->d0, c->d1
+    for tid in ("a", "b", "c"):
+        pool.add_tenant(tid, {"lo": 0.0})
+    d_a = pool._device_of_slot(pool._tenants["a"])
+    d_c = pool._device_of_slot(pool._tenants["c"])
+
+    def dead(_events):
+        raise RuntimeError("tenant-c sink down (injected)")
+
+    pool.add_callback("a", delivered["a"].extend)
+    pool.add_callback("b", delivered["b"].extend)
+    pool.add_callback("c", dead)
+    sup = PoolCheckpointSupervisor(pool, interval_rounds=2)
+
+    for r in range(4):   # checkpoints land after rounds 2 and 4
+        for i, tid in enumerate(("a", "b", "c")):
+            ts, cols = _pool_chunk(8, seed + r * 10 + i,
+                                   1_000_000 + r * 1000)
+            pool.send(tid, ts, cols)
+        pool.pump()
+    checkpoint_rev = sup.last_revision
+    pre = {tid: deserialize(pool.snapshot_tenant(tid))
+           for tid in ("a", "c")}
+    backlog_c = mgr.error_store.size(pool.tenant_partition("c"))
+
+    # round-5 chunks are in flight (sent, not pumped) when the device
+    # dies: the victims' queues must be RETAINED through evacuation
+    for i, tid in enumerate(("a", "b", "c")):
+        ts, cols = _pool_chunk(8, seed + 90 + i, 9_000_000)
+        pool.send(tid, ts, cols)
+    fi = FaultInjector(seed=seed)
+    kill = fi.kill_device(pool, d_a)
+    # degraded: the survivor keeps serving through normal rounds
+    pool.pump()
+    b_degraded = len(delivered["b"])
+    sat_degraded = pool.saturation()
+
+    res = evacuate(pool, replay=False)
+    identical = True
+    for tid in ("a", "c"):
+        post = deserialize(pool.snapshot_tenant(tid))
+        f_pre, _ = jax.tree_util.tree_flatten(pre[tid]["queries"])
+        f_post, _ = jax.tree_util.tree_flatten(post["queries"])
+        for x, y in zip(f_pre, f_post):
+            if not np.array_equal(np.asarray(x), np.asarray(y)):
+                identical = False
+
+    pool.add_callback("c", delivered["c"].extend)   # healed
+    replayed = pool.replay_errors("c").get("c", 0)
+    ts_seq = [e.timestamp for e in delivered["c"]]
+    pool.flush()             # retained round-5 queues drain normally
+    # admission must still answer over the survivor
+    pool.add_tenant("late", {"lo": 0.0})
+    d_late = pool._device_of_slot(pool._tenants["late"])
+    stats = pool.statistics()
+    mesh = stats["mesh"]
+    pool.shutdown()
+
+    def keys(tid):
+        return sorted((e.timestamp, e.data[1])
+                      for e in delivered[tid])
+
+    lost = {tid: 5 * 8 - len(delivered[tid]) for tid in ("a", "c")}
+    dups = {tid: len(keys(tid)) - len(set(keys(tid)))
+            for tid in ("a", "c")}
+    return {
+        "victims": kill["victims"],
+        "checkpoint": checkpoint_rev,
+        "survivor_kept_serving": b_degraded >= 5 * 8,
+        "degraded_lost_devices":
+            sat_degraded.get("lost_devices") == [d_a],
+        "evacuated": sorted(r["tenant"] for r in res["evacuated"]),
+        "evacuated_from_revision": res["revision"] == checkpoint_rev,
+        "victims_bit_identical": identical,
+        "stored_backlog": backlog_c,
+        "replayed": replayed,
+        "replay_in_ts_order": bool(ts_seq) and ts_seq == sorted(ts_seq),
+        "lost": lost,
+        "duplicates": dups,
+        "late_admitted_on_survivor": d_late not in (d_a,),
+        "mesh_lost_devices": mesh.get("lost_devices"),
+        "evacuations": mesh.get("evacuations"),
+        "evacuation_age_ms": mesh.get("evacuation_age_ms"),
+        "faults": fi.events,
+    }
+
+
+def run_mesh_rebalance_flap_guard(seed: int = 0) -> dict:
+    """Rebalancer hysteresis: oscillating load never migrates,
+    sustained skew migrates EXACTLY once, and the kill switch works.
+
+    Phase 1 (flap guard): the hot device alternates every observation —
+    the confirm streak resets on every flip, so after 8 steps the
+    rebalancer has moved NOTHING. Phase 2 (sustained): the same device
+    stays hot for ``confirm_steps`` consecutive observations -> exactly
+    one migration (cause='rebalance'), then the cooldown swallows the
+    migration's own backlog spike and further steps stay idle. Phase 3:
+    with SIDDHI_TPU_REBALANCE=0 a fresh Rebalancer refuses to start and
+    its step() no-ops."""
+    import os as _os
+
+    from ..serving.rebalance import REBALANCE_ENV, Rebalancer
+
+    pool = _mesh_pool(f"meshflap{seed}")
+    pool.add_tenant("t0", {"lo": 0.0})   # -> device 1
+    pool.add_tenant("t1", {"lo": 0.0})   # -> device 0
+    rb = Rebalancer(pool, hot_ratio=3.0, confirm_steps=2,
+                    cooldown_steps=2, min_rows=8)
+    faults = [{"fault": "rebalance_flap", "seed": seed}]
+
+    # phase 1: oscillation — hot device flips every step
+    for i in range(8):
+        tid = "t0" if i % 2 == 0 else "t1"
+        ts, cols = _pool_chunk(32, seed + i, 1_000_000 + i * 1000)
+        pool.send(tid, ts, cols)
+        rb.step()
+        pool.flush()
+    flap_migrations = rb.migrations
+    flap_actions = [d["action"] for d in rb.decisions]
+
+    # phase 2: sustained skew on t0's device — confirm, migrate ONCE
+    for i in range(2):
+        ts, cols = _pool_chunk(32, seed + 20 + i,
+                               2_000_000 + i * 1000)
+        pool.send("t0", ts, cols)
+        rb.step()
+    first = rb.migrations
+    rec = next((d["migration"] for d in rb.decisions
+                if d["action"] == "migrated"), None)
+    pool.flush()             # drain during the cooldown window
+    for _ in range(4):       # cooldown + cleared condition: no more
+        rb.step()
+    sustained_migrations = rb.migrations
+    pool.shutdown()
+
+    # phase 3: kill switch — start() refuses, step() no-ops
+    prev = _os.environ.get(REBALANCE_ENV)
+    _os.environ[REBALANCE_ENV] = "0"
+    try:
+        pool2 = _mesh_pool(f"meshflapks{seed}")
+        pool2.add_tenant("t0", {"lo": 0.0})
+        rb2 = Rebalancer(pool2)
+        started = rb2.start()
+        stepped = rb2.step()
+        rb2.stop()
+        pool2.shutdown()
+    finally:
+        if prev is None:
+            _os.environ.pop(REBALANCE_ENV, None)
+        else:
+            _os.environ[REBALANCE_ENV] = prev
+
+    return {
+        "flap_migrations": flap_migrations,
+        "flap_confirming_seen": "confirming" in flap_actions,
+        "sustained_migrations": sustained_migrations,
+        "migrated_once": first == 1 and sustained_migrations == 1,
+        "migration": rec,
+        "cause_rebalance": bool(rec) and rec.get("cause") == "rebalance",
+        "cooldown_seen": any(d["action"] == "cooldown"
+                             for d in rb.decisions),
+        "kill_switch_start_refused": started is False,
+        "kill_switch_step_noop": stepped is None,
+        "report": rb.report(),
+        "faults": faults,
+    }

@@ -10,7 +10,11 @@ pre-staged re-configurable processing units (PAPERS.md).
 from .template import Template, TemplateRegistry
 from .pool import AdmissionError, TenantPool
 from .qos import CircuitBreaker, PoolQoS, TokenBucket
+from .migrate import evacuate, newest_restorable_checkpoint
+from .rebalance import REBALANCE_ENV, Rebalancer
 
 __all__ = ["Template", "TemplateRegistry", "TenantPool",
            "AdmissionError", "PoolQoS", "TokenBucket",
-           "CircuitBreaker"]
+           "CircuitBreaker", "evacuate",
+           "newest_restorable_checkpoint", "Rebalancer",
+           "REBALANCE_ENV"]

@@ -1,0 +1,175 @@
+"""Live slot migration & device evacuation for tenant pools.
+
+Two pool-reshaping operations built from machinery that already exists
+(the elastic-scaling model of "Towards Concurrent Stateful Stream
+Processing on Multicore", with Diba's pre-warmed re-configurable
+processing units as the zero-recompile mechanism — PAPERS.md):
+
+- **Live migration** (`TenantPool.migrate_tenant` /
+  `request_migration`): one tenant's slot slice moves to another mesh
+  device between fair rounds. The slice is exactly the PR 15
+  `snapshot_tenant` read; the write is an `.at[slot].set` on the
+  sharded stacked arrays, so XLA routes the data to the target device
+  through the PR 12 rule-table placement — zero recompiles, and the
+  moving tenant's in-flight chunks park in a bounded queue until the
+  slot map flips. This module adds the ORCHESTRATION on top: picking
+  targets, and the failure-driven evacuation below.
+
+- **Evacuation** (`evacuate`): after `FaultInjector.kill_device` marks
+  a device lost (`pool.mark_device_lost`), the victims' live state is
+  gone — there is nothing to snapshot. Their slots restore from the
+  newest restorable whole-pool checkpoint (walking revisions newest-
+  first and skipping corrupt ones, the PoolCheckpointSupervisor
+  contract) onto the least-loaded surviving devices, WITHOUT touching
+  the survivors' live state — this is a per-slot graft, not a whole-
+  pool restore. Victims with no checkpointed state re-init fresh from
+  their bindings (flight-recorded as such). Their retained pending
+  queues then drain through normal rounds and their error-partition
+  backlog replays in original-timestamp order.
+
+docs/serving.md "Live migration & rebalance" and docs/resilience.md
+"Device evacuation" describe the protocols; the `migration.*` /
+`evacuation.*` gauge families (docs/observability.md) expose the
+counters this module bumps.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+log = logging.getLogger("siddhi_tpu.serving")
+
+
+def newest_restorable_checkpoint(pool) -> tuple[Optional[str],
+                                                Optional[dict]]:
+    """Walk the pool's checkpoint revisions newest-first and return
+    (revision, deserialized payload) for the first one that
+    deserializes to a matching tenant-pool snapshot — or (None, None)
+    when no restorable checkpoint exists. Corrupt/foreign revisions are
+    skipped with a warning (the supervisor's fallback contract), never
+    raised: evacuation must proceed even when it can only fresh-init."""
+    from ..core.persistence import deserialize
+    store = pool.proto._persistence_store()
+    for rev in reversed(store.list_revisions(pool.name)):
+        try:
+            data = store.load(pool.name, rev)
+            if data is None:
+                continue
+            payload = deserialize(data)
+            if payload.get("kind") != "tenant-pool" or \
+                    payload.get("template") != pool.template.key:
+                raise ValueError("not a snapshot of this pool")
+            return rev, payload
+        except Exception as exc:  # noqa: BLE001 — corrupt revision
+            log.warning("pool '%s': revision %s is not restorable "
+                        "(%s); falling back to the previous one",
+                        pool.name, rev, exc)
+    return None, None
+
+
+def _pick_target_slot(pool) -> int:
+    """Least-loaded surviving device's free slot (caller holds the pool
+    lock; ``_free`` never contains lost-device slots)."""
+    if not pool._free:
+        raise ValueError(
+            f"pool '{pool.name}': no free slot on any surviving device "
+            "to evacuate into")
+    loads = pool._placement_counts
+    best = min(range(len(pool._free)),
+               key=lambda i: (loads[pool._device_of_slot(
+                   pool._free[i])], -pool._free[i]))
+    return pool._free.pop(best)
+
+
+def evacuate(pool, replay: bool = True) -> dict:
+    """Restore every lost-device victim onto the surviving devices.
+
+    Per victim: graft its slot slice from the newest restorable pool
+    checkpoint into a free slot on the least-loaded surviving device
+    (`.at[slot].set` on the sharded arrays — survivors' live state is
+    untouched, bit-identical), or fresh-init from its bindings when the
+    checkpoint predates the tenant. Then (``replay=True``) its error-
+    partition backlog replays in original-timestamp order, and its
+    RETAINED pending queue drains through the next normal rounds.
+    Admission budgets re-derive; every graft is flight-recorded with
+    before/after placement + source revision; recovery age and
+    evacuation count surface in ``statistics()['mesh']``.
+    """
+    with pool._lock:
+        victims = dict(pool._lost_tenants)
+        if not victims:
+            return {"evacuated": [], "revision": None, "replayed": {}}
+        revision, payload = newest_restorable_checkpoint(pool)
+        snap_tenants = (payload or {}).get("tenants", {})
+        snap_queries = (payload or {}).get("queries", {})
+        if payload is not None:
+            from ..core.persistence import load_strings
+            load_strings(payload["strings"])
+        moved = []
+        for tid in sorted(victims):
+            old_slot = victims[tid]
+            target = _pick_target_slot(pool)
+            entry = snap_tenants.get(tid)
+            if entry is not None:
+                # slot-slice graft from the checkpoint payload: index
+                # into the SNAPSHOT's arrays at the tenant's slot AT
+                # CHECKPOINT TIME (may differ from its dying slot)
+                s_slot = int(entry["slot"])
+                for qn in pool._order:
+                    snap = snap_queries[qn]
+                    pool._states[qn] = jax.tree_util.tree_map(
+                        lambda full, s: full.at[target].set(
+                            jnp.asarray(s[s_slot])),
+                        pool._states[qn], snap["states"])
+                    pool._emitted[qn] = \
+                        pool._emitted[qn].at[target].set(
+                            jnp.asarray(snap["emitted"][s_slot]))
+                source = "checkpoint"
+            else:
+                # the checkpoint predates this tenant (or none exists):
+                # fresh state from its bindings — flight-recorded so
+                # the operator knows this victim lost its window state
+                from ..analysis.plan_rules import \
+                    check_template_bindings
+                vals = check_template_bindings(
+                    pool.proto.ast, dict(pool._bindings.get(tid, {})))
+                for qn in pool._order:
+                    init = pool._tenant_init_states(qn, vals)
+                    pool._states[qn] = jax.tree_util.tree_map(
+                        lambda full, iv: full.at[target].set(iv),
+                        pool._states[qn], init)
+                    pool._emitted[qn] = \
+                        pool._emitted[qn].at[target].set(0)
+                source = "fresh-init"
+            pool._tenants[tid] = target
+            del pool._lost_tenants[tid]
+            new_dev = pool._device_of_slot(target)
+            pool._placement_counts[new_dev] += 1   # fresh per pick
+            rec = {"tenant": tid, "source": source,
+                   "revision": revision,
+                   "from": {"slot": old_slot,
+                            "device": pool._device_of_slot(old_slot)},
+                   "to": {"slot": target, "device": new_dev}}
+            pool.flight.record("evacuation", **rec)
+            log.info("pool '%s': evacuated tenant '%s' slot %d -> "
+                     "%d(d%d) from %s", pool.name, tid, old_slot,
+                     target, new_dev,
+                     revision if source == "checkpoint" else source)
+            moved.append(rec)
+        if pool.mesh is not None:
+            pool._place_state()   # dedupe rule-table re-placement pass
+        pool._recompute_placement_locked()
+        pool._evacuations += len(moved)
+        pool._last_evacuation_wall = time.time()
+        pool._work.notify()
+    replayed: dict = {}
+    if replay:
+        # OUTSIDE the lock: replay delivers through callbacks/breakers
+        for rec in moved:
+            replayed.update(pool.replay_errors(rec["tenant"]))
+    return {"evacuated": moved, "revision": revision,
+            "replayed": replayed}

@@ -118,12 +118,20 @@ class TenantPool:
                  pending_cap: int = _DEFAULT_PENDING_CAP,
                  slo: Optional[dict] = None,
                  qos: Optional[dict] = None,
-                 mesh=None):
+                 mesh=None,
+                 device_round_cap: Optional[int] = None):
         """``mesh``: optional ``jax.sharding.Mesh`` — the tenant slot
         axis then shards over its first axis (1/n of the slots per
         device, parallel/sharding.py POOL_STATE_RULES), ingest rounds
         place the stacked batch the same way, and admission control
-        accounts per-device slot budgets (docs/serving.md)."""
+        accounts per-device slot budgets (docs/serving.md).
+
+        ``device_round_cap``: optional per-DEVICE row budget per fair
+        round on a mesh (None = unlimited, the legacy round shape).
+        When a device's tenants together hit the cap, later tenants on
+        that device wait for the next round — the signal the SLO-driven
+        rebalancer (serving/rebalance.py) uses to move a colocated
+        victim off a saturated device."""
         from ..core.manager import SiddhiManager
         from ..obs.metrics import MetricsRegistry
         self.template = template
@@ -292,6 +300,28 @@ class TenantPool:
         # supervisor registers itself here; restore() fills _recovery
         self._checkpoint_supervisor = None
         self._recovery: Optional[dict] = None
+        # -- live migration / evacuation (serving/migrate.py;
+        #    docs/serving.md "Live migration & rebalance") ---------------
+        self.device_round_cap = int(device_round_cap) \
+            if device_round_cap else None
+        # tid -> {from/to slot+device, cause, parked deque, park_cap, ...}
+        self._migrations: dict[str, dict] = {}
+        self._migration_log: deque = deque(maxlen=64)
+        self._migrations_done = 0
+        self._rows_migrated = 0
+        self._migration_pause_ms_last: Optional[float] = None
+        self._lost_devices: set[int] = set()
+        # victims of a lost device, awaiting evacuation: tid -> old slot
+        # (their pending queues are RETAINED and drain after evacuation)
+        self._lost_tenants: dict[str, int] = {}
+        self._evacuations = 0
+        self._last_evacuation_wall: Optional[float] = None
+        # cached per-device placement + budget, re-derived on EVERY
+        # slot-map change (_recompute_placement_locked) — the admission
+        # staleness fix: a drained/evacuated device must stop 429-ing
+        self._placement_counts: list = [0] * self.n_devices
+        self._slot_budget = -(-self.max_tenants
+                              // max(1, self.n_devices))
 
     # -- planning ---------------------------------------------------------
 
@@ -380,8 +410,14 @@ class TenantPool:
         return self.slots // self.n_devices
 
     def _device_of_slot(self, slot: int) -> int:
-        return slot // self.slots_per_device if self.mesh is not None \
-            else 0
+        if self.mesh is None:
+            return 0
+        # host-side twin of the PartitionSpec placement — one shared
+        # definition (parallel/sharding.py device_of_index) so the
+        # migration/evacuation target math can never drift from the
+        # rule-table layout
+        return self._sharding.device_of_index(
+            slot, self.slots, self.mesh, axis=self.mesh_axis)
 
     def _place_state(self) -> None:
         """Shard the stacked tenant states over the mesh's slot axis.
@@ -416,10 +452,28 @@ class TenantPool:
                 loads[self._device_of_slot(slot)] += 1
             return loads
 
+    def _recompute_placement_locked(self) -> None:
+        """Re-derive the cached per-device placement counts AND the
+        per-device slot budget. Called on EVERY slot-map change
+        (add/remove/restore/migrate/evacuate/device-loss) — the
+        admission-staleness fix: a device drained by removal or
+        evacuation stops 429-ing traffic it can now accept, budgets
+        split over the SURVIVING devices after a loss, and the 429
+        payload's per-device placement reflects reality."""
+        self._placement_counts = self._device_loads_locked()
+        alive = self.n_devices - len(self._lost_devices)
+        self._slot_budget = -(-self.max_tenants // max(1, alive))
+
+    def _alive_devices_locked(self) -> list:
+        return [d for d in range(self.n_devices)
+                if d not in self._lost_devices]
+
     def _pick_slot(self) -> int:
         """Pop a free slot, mesh-aware: choose the slot on the device
         with the fewest placed tenants so the vmapped work stays
-        balanced across the mesh (single-device pools keep LIFO order)."""
+        balanced across the mesh (single-device pools keep LIFO order).
+        Lost devices' slots never sit in ``_free`` (mark_device_lost
+        strips them), so a degraded mesh places only on survivors."""
         if self.mesh is None:
             return self._free.pop()
         loads = self._device_loads_locked()
@@ -464,8 +518,11 @@ class TenantPool:
 
     def admit(self) -> tuple[bool, str]:
         """Admission control: (ok, reason). Checked by add_tenant and by
-        the service front door BEFORE building anything (429 + reason)."""
-        ok, reason, _cause = self._admit_check()
+        the service front door BEFORE building anything (429 + reason).
+        Takes the pool lock: the mesh branch reads the cached placement
+        counts, which migrations rewrite under the lock."""
+        with self._lock:
+            ok, reason, _cause = self._admit_check()
         return ok, reason
 
     def _admit_check(self) -> tuple[bool, str, str]:
@@ -480,13 +537,21 @@ class TenantPool:
             return False, (f"pool '{self.name}' tenant slots exhausted "
                            f"(cap {self.max_tenants})"), "slots-exhausted"
         if self.mesh is not None:
-            budget = -(-self.max_tenants // self.n_devices)  # ceil
-            loads = self._device_loads_locked()
-            if min(loads) >= budget:
+            # CACHED placement + budget (re-derived on every slot-map
+            # change by _recompute_placement_locked — never recomputed
+            # here, so a stale cache would be an observable bug, and
+            # tests/test_migrate.py asserts it never goes stale)
+            alive = self._alive_devices_locked()
+            budget = self._slot_budget
+            loads = self._placement_counts
+            if not alive:
+                return False, (f"pool '{self.name}' has no surviving "
+                               "mesh devices"), "no-devices"
+            if min(loads[d] for d in alive) >= budget:
                 return False, (
                     f"pool '{self.name}' per-device slot budgets "
                     f"exhausted ({budget} tenants/device x "
-                    f"{self.n_devices} devices, placed {loads})"), \
+                    f"{len(alive)} surviving devices, placed {loads})"), \
                     "slots-exhausted"
         if self.state_quota_bytes is not None:
             need = (len(self._tenants) + 1) * self.state_bytes_per_tenant
@@ -506,6 +571,15 @@ class TenantPool:
         rounds = max(1, math.ceil(pending_rows / max(1, self.batch_max)))
         per_round = self._round_ms_ema if self._round_ms_ema else 1.0
         return int(math.ceil(rounds * max(per_round, 1.0)))
+
+    def _retry_after_flip_ms(self) -> int:
+        """Retry hint for the `migrating` cause: the parked queue
+        releases at the NEXT round boundary (the flip), so the honest
+        estimate is ONE EMA round — not the backlog-drain estimate,
+        which assumes the whole queue must empty first and over-reports
+        the pause by orders of magnitude (the satellite fix)."""
+        per_round = self._round_ms_ema if self._round_ms_ema else 1.0
+        return int(math.ceil(max(per_round, 1.0)))
 
     def _reject(self, cause: str, reason: str,
                 tenant: Optional[str] = None, **info):
@@ -531,7 +605,7 @@ class TenantPool:
         if pending_total and self._last_pump_wall is not None:
             lag = (now - self._last_pump_wall) * 1000.0
         recent = sum(1 for t in self._rejection_times if now - t <= 60.0)
-        return {
+        sat = {
             "pending_rows": pending_total,
             "queue_age_ms_max": round(max(ages) * 1000.0, 1)
             if ages else 0.0,
@@ -541,6 +615,15 @@ class TenantPool:
             "rejections": dict(self._rejections),
             "rejections_last_60s": recent,
         }
+        if self.mesh is not None:
+            # the 429 payload must show the REAL per-device placement
+            # (the cached counts, re-derived on every slot-map change)
+            sat["placement"] = {str(d): self._placement_counts[d]
+                                for d in range(self.n_devices)}
+            sat["slot_budget"] = self._slot_budget
+            if self._lost_devices:
+                sat["lost_devices"] = sorted(self._lost_devices)
+        return sat
 
     def saturation(self) -> dict:
         with self._lock:
@@ -595,6 +678,7 @@ class TenantPool:
             self._pending[tenant_id] = deque()
             self._pending_rows[tenant_id] = 0
             self._error_counts[tenant_id] = 0
+            self._recompute_placement_locked()
             return slot
 
     def remove_tenant(self, tenant_id: str) -> bool:
@@ -613,6 +697,12 @@ class TenantPool:
             self._tenant_qos_raw.pop(tenant_id, None)
             if self._qos is not None:
                 self._qos.remove_tenant(tenant_id)
+            mig = self._migrations.pop(tenant_id, None)
+            if mig is not None:
+                # an undeployed tenant's reserved target slot and
+                # parked rows go with it
+                self._free.append(mig["to_slot"])
+            self._recompute_placement_locked()
             return True
 
     def _grow(self) -> None:
@@ -642,6 +732,13 @@ class TenantPool:
             # (the other is restore): the concatenated arrays come back
             # sharded over the NEW width in one placement pass
             self._place_state()
+        if self._lost_devices:
+            # growth re-derives slot->device (slots_per_device changed);
+            # slots now landing on a lost device must not be handed out
+            self._free = [s for s in self._free
+                          if self._device_of_slot(s)
+                          not in self._lost_devices]
+        self._recompute_placement_locked()
         self._vsteps.clear()
         self._grows += 1
         self._warmed = False
@@ -682,7 +779,10 @@ class TenantPool:
         cols = [np.ascontiguousarray(c) for c in cols]
         t_arr = time.perf_counter()
         with self._lock:
-            self._slot(tenant_id)
+            if tenant_id not in self._lost_tenants:
+                # a lost device's victim keeps its queue: rows buffer
+                # here through the outage and drain after evacuation
+                self._slot(tenant_id)
             if self._qos is not None:
                 # token-bucket rate limit (serving/qos.py): over-rate
                 # ingest is rejected BEFORE it queues, with the
@@ -696,6 +796,27 @@ class TenantPool:
                         f"{retry_ms} ms)",
                         tenant=tenant_id, rows=n,
                         retry_after_ms=retry_ms)
+            mig = self._migrations.get(tenant_id)
+            if mig is not None:
+                # migration pause: in-flight chunks park in the bounded
+                # migration queue and release after the flip — the 429
+                # here carries the `migrating` cause with the flip
+                # latency (one round), NOT the backlog-drain estimate
+                if mig["parked_rows"] + n > mig["park_cap"]:
+                    self._reject(
+                        "migrating",
+                        f"tenant '{tenant_id}' is migrating to device "
+                        f"{mig['to_device']} and its parked-ingest "
+                        f"queue is full ({mig['parked_rows']} rows "
+                        f"parked, cap {mig['park_cap']}); retry after "
+                        "the round-boundary flip",
+                        tenant=tenant_id, rows=n,
+                        parked_rows=mig["parked_rows"],
+                        park_cap=mig["park_cap"],
+                        retry_after_ms=self._retry_after_flip_ms())
+                mig["parked"].append((ts, cols, t_arr))
+                mig["parked_rows"] += n
+                return
             if self._pending_rows[tenant_id] + n > self.pending_cap:
                 self._reject(
                     "ingest-backlog",
@@ -751,6 +872,10 @@ class TenantPool:
         pool-wide from the chunks' host arrival stamps."""
         t_round0 = time.perf_counter()
         with self._lock:
+            # round boundary: requested migrations flip HERE, before any
+            # take — the moving tenant is never dispatched between its
+            # request and its flip, so the move is atomic w.r.t. rounds
+            self._apply_migrations_locked()
             per_slot = {}
             stamps: dict[str, float] = {}
             taken = 0
@@ -763,15 +888,26 @@ class TenantPool:
             if self._qos is not None:
                 limits = self._qos.plan_round(dict(self._pending_rows),
                                               self.batch_max)
+            # optional per-DEVICE row budget (device_round_cap): tenants
+            # colocated on a saturated device wait for later rounds —
+            # the contention signal the rebalancer reads
+            dev_budget = None
+            if self.mesh is not None and self.device_round_cap:
+                dev_budget = [self.device_round_cap] * self.n_devices
             for tid, slot in self._tenants.items():
                 limit = self.batch_max if limits is None \
                     else limits.get(tid, 0)
+                dev = self._device_of_slot(slot)
+                if dev_budget is not None:
+                    limit = min(limit, dev_budget[dev])
                 if limit <= 0:
                     continue
                 got = self._take(tid, limit)
                 if got is None:
                     continue
                 ts_a, cols_a, t_arr = got
+                if dev_budget is not None:
+                    dev_budget[dev] -= len(ts_a)
                 per_slot[slot] = (ts_a, cols_a)
                 stamps[tid] = t_arr
                 taken += len(ts_a)
@@ -1182,6 +1318,225 @@ class TenantPool:
                 self._emitted[qn] = self._emitted[qn].at[slot].set(
                     jnp.asarray(snap["emitted"]))
 
+    # -- live slot migration (serving/migrate.py orchestrates; docs/
+    # serving.md "Live migration & rebalance") ----------------------------
+
+    def request_migration(self, tenant_id: str, device: int,
+                          cause: str = "manual",
+                          park_cap: Optional[int] = None) -> dict:
+        """Reserve a free slot on ``device`` and start parking the
+        tenant's new ingest in a bounded migration queue. The actual
+        state move + slot-map flip happens at the NEXT round boundary
+        (`_apply_migrations_locked`, called at the top of pump while
+        the lock is held). Returns the planned move."""
+        if self.mesh is None:
+            raise ValueError(
+                f"pool '{self.name}' has no mesh — migration moves a "
+                "slot between mesh devices")
+        with self._lock:
+            slot = self._slot(tenant_id)
+            if not 0 <= device < self.n_devices:
+                raise ValueError(
+                    f"device {device} out of range "
+                    f"(mesh has {self.n_devices})")
+            if device in self._lost_devices:
+                raise ValueError(f"device {device} is marked lost")
+            src = self._device_of_slot(slot)
+            if device == src:
+                raise ValueError(
+                    f"tenant '{tenant_id}' is already on device "
+                    f"{device}")
+            if tenant_id in self._migrations:
+                raise ValueError(
+                    f"tenant '{tenant_id}' already has a migration "
+                    "in flight")
+            target = None
+            for i, s in enumerate(self._free):
+                if self._device_of_slot(s) == device:
+                    target = self._free.pop(i)
+                    break
+            if target is None:
+                raise ValueError(
+                    f"no free slot on device {device} for tenant "
+                    f"'{tenant_id}'")
+            self._migrations[tenant_id] = {
+                "from_slot": slot, "from_device": src,
+                "to_slot": target, "to_device": device,
+                "cause": cause, "parked": deque(), "parked_rows": 0,
+                "park_cap": int(park_cap) if park_cap
+                else self.pending_cap,
+                "t_req": time.perf_counter(),
+            }
+            self.flight.record(
+                "migration-request", tenant=tenant_id, cause=cause,
+                from_={"slot": slot, "device": src},
+                to={"slot": target, "device": device})
+            return {"tenant": tenant_id, "from_slot": slot,
+                    "from_device": src, "to_slot": target,
+                    "to_device": device}
+
+    def _apply_migrations_locked(self) -> list:
+        """Flip every requested migration at this round boundary
+        (caller holds the pool RLock — holding it across a pump round
+        IS the boundary). Per move: slice the source slot exactly like
+        `snapshot_tenant` (the PR 15 per-slot machinery), write it into
+        the reserved target slot with `.at[slot].set` on the SHARDED
+        arrays — XLA routes the slice to the target device through the
+        PR 12 placement, zero recompiles — then flip the slot map,
+        release the parked chunks in arrival order, and assert row
+        conservation. Every move is flight-recorded with its cause and
+        before/after placement."""
+        if not self._migrations:
+            return []
+        results = []
+        t0 = time.perf_counter()
+        # ONE host round-trip for every flip this boundary (the
+        # snapshot_tenant slice per tenant, batched into a single
+        # pytree transfer): fresh buffers on write keep the
+        # donation-safe contract
+        moved_all = jax.device_get({
+            tid: {qn: {"states": jax.tree_util.tree_map(
+                           lambda x, s=mig["from_slot"]: x[s],
+                           self._states[qn]),
+                       "emitted":
+                           self._emitted[qn][mig["from_slot"]]}
+                  for qn in self._order}
+            for tid, mig in self._migrations.items()})
+        for tid, mig in list(self._migrations.items()):
+            old, new = mig["from_slot"], mig["to_slot"]
+            moved = moved_all[tid]
+            for qn in self._order:
+                snap = moved[qn]
+                self._states[qn] = jax.tree_util.tree_map(
+                    lambda full, s: full.at[new].set(jnp.asarray(s)),
+                    self._states[qn], snap["states"])
+                self._emitted[qn] = self._emitted[qn].at[new].set(
+                    jnp.asarray(snap["emitted"]))
+            self._tenants[tid] = new
+            self._free.append(old)
+            # release the parked chunks BEHIND the surviving pending
+            # tail (they arrived later; arrival stamps ride along), then
+            # assert conservation: parked + pending in == pending out
+            before = self._pending_rows.get(tid, 0)
+            parked = mig["parked_rows"]
+            q = self._pending.setdefault(tid, deque())
+            q.extend(mig["parked"])
+            self._pending_rows[tid] = before + parked
+            actual = sum(len(t) for t, _c, _a in q)
+            assert actual == self._pending_rows[tid], (
+                f"migration row conservation broken for '{tid}': "
+                f"{actual} queued != {before} pending + {parked} parked")
+            pause_ms = (time.perf_counter() - mig["t_req"]) * 1000.0
+            flip_ms = (time.perf_counter() - t0) * 1000.0
+            rec = {"tenant": tid, "cause": mig["cause"],
+                   "from": {"slot": old, "device": mig["from_device"]},
+                   "to": {"slot": new, "device": mig["to_device"]},
+                   "rows_moved": self._pending_rows[tid],
+                   "parked_rows": parked,
+                   "pause_ms": round(pause_ms, 3),
+                   "flip_ms": round(flip_ms, 3),
+                   "round": self._rounds}
+            del self._migrations[tid]
+            self._migration_log.append(rec)
+            self._migrations_done += 1
+            self._rows_migrated += rec["rows_moved"]
+            self._migration_pause_ms_last = rec["pause_ms"]
+            self.flight.record("migration", **rec)
+            log.info("pool '%s': migrated tenant '%s' slot %d(d%d) -> "
+                     "%d(d%d) (%s, %d rows, pause %.1f ms)",
+                     self.name, tid, old, mig["from_device"], new,
+                     mig["to_device"], mig["cause"], rec["rows_moved"],
+                     rec["pause_ms"])
+            results.append(rec)
+        if self.mesh is not None:
+            # dedupe re-placement pass through the rule tables: every
+            # leaf already carries the slot-axis sharding, so this
+            # transfers NOTHING (shard_pytree's skip contract) — it re-
+            # asserts the layout instead of trusting the .at[] writes
+            self._place_state()
+        self._recompute_placement_locked()
+        self._work.notify()
+        return results
+
+    def migrate_tenant(self, tenant_id: str, device: int,
+                       cause: str = "manual",
+                       park_cap: Optional[int] = None) -> dict:
+        """Request + flip in ONE held-lock critical section: the RLock
+        spans both, no pump round can interleave, so the call site sees
+        a completed move (service endpoint + rebalancer entry point).
+        Returns the migration record (cause, before/after placement,
+        rows moved, pause ms)."""
+        with self._lock:
+            self.request_migration(tenant_id, device, cause=cause,
+                                   park_cap=park_cap)
+            recs = self._apply_migrations_locked()
+        return next(r for r in recs if r["tenant"] == tenant_id)
+
+    def migration_log(self) -> list:
+        with self._lock:
+            return list(self._migration_log)
+
+    # -- device loss & degraded mode (serving/migrate.py evacuate();
+    # docs/resilience.md "Device evacuation") -----------------------------
+
+    def mark_device_lost(self, device: int) -> dict:
+        """Degraded mode: mark one mesh device lost
+        (`FaultInjector.kill_device` arms this). Its slots leave the
+        free list, its tenants move to the lost set (pending queues and
+        error partitions RETAINED — they drain after evacuation),
+        admission budgets re-derive over the survivors, and pump keeps
+        serving the surviving slots. `serving.migrate.evacuate`
+        restores the victims from the newest pool checkpoint."""
+        if self.mesh is None:
+            raise ValueError(f"pool '{self.name}' has no mesh")
+        with self._lock:
+            if not 0 <= device < self.n_devices:
+                raise ValueError(
+                    f"device {device} out of range "
+                    f"(mesh has {self.n_devices})")
+            if device in self._lost_devices:
+                return {"device": device, "victims": []}
+            if len(self._lost_devices) + 1 >= self.n_devices:
+                raise ValueError(
+                    f"pool '{self.name}': cannot lose device {device} "
+                    "— no surviving device would remain")
+            self._lost_devices.add(device)
+            self._free = [s for s in self._free
+                          if self._device_of_slot(s) != device]
+            victims = sorted(
+                tid for tid, slot in self._tenants.items()
+                if self._device_of_slot(slot) == device)
+            for tid in victims:
+                self._lost_tenants[tid] = self._tenants.pop(tid)
+            # cancel any in-flight migration touching the dead device:
+            # parked rows fall back onto the pending queue (retained)
+            for tid, mig in list(self._migrations.items()):
+                if device not in (mig["from_device"],
+                                  mig["to_device"]):
+                    continue
+                if mig["to_device"] != device:
+                    self._free.append(mig["to_slot"])
+                q = self._pending.setdefault(tid, deque())
+                q.extend(mig["parked"])
+                self._pending_rows[tid] = \
+                    self._pending_rows.get(tid, 0) + mig["parked_rows"]
+                del self._migrations[tid]
+                self.flight.record("migration-cancelled", tenant=tid,
+                                   reason=f"device {device} lost")
+            self._recompute_placement_locked()
+            self.flight.record("device-lost", device=device,
+                               victims=victims,
+                               survivors=len(self._tenants))
+            log.warning("pool '%s': device %d lost — %d victim(s) %s "
+                        "await evacuation, %d tenant(s) keep serving",
+                        self.name, device, len(victims), victims,
+                        len(self._tenants))
+            return {"device": device, "victims": victims}
+
+    def lost_tenants(self) -> dict:
+        with self._lock:
+            return dict(self._lost_tenants)
+
     # -- whole-pool checkpoint / crash recovery ---------------------------
     # (resilience/supervisor.py PoolCheckpointSupervisor drives these;
     # docs/resilience.md "Pool recovery")
@@ -1304,8 +1659,17 @@ class TenantPool:
                 self._error_counts[tid] = 0
                 if self._qos is not None:
                     self._qos.add_tenant(tid, self._tenant_qos_raw[tid])
+            # restore is a whole-pool rebuild: in-flight migrations die
+            # with the old slot map (their parked rows were never acked
+            # past the snapshot), lost-device marks survive — the
+            # hardware did not come back because we restored
+            self._migrations = {}
+            self._lost_tenants = {}
             self._free = [s for s in range(self.slots - 1, -1, -1)
-                          if s not in used]
+                          if s not in used
+                          and self._device_of_slot(s)
+                          not in self._lost_devices]
+            self._recompute_placement_locked()
             self._recovery = {
                 "restored_wall": time.time(),
                 "revision": None,       # restore_revision fills it
@@ -1489,16 +1853,29 @@ class TenantPool:
                     })
             mesh_info = None
             if self.mesh is not None:
-                loads = self._device_loads_locked()
+                loads = list(self._placement_counts)
+                wall = time.time()
                 mesh_info = {
                     "axis": self.mesh_axis,
                     "n_devices": self.n_devices,
                     "slots_per_device": self.slots_per_device,
+                    "lost_devices": sorted(self._lost_devices),
+                    "lost_tenants": sorted(self._lost_tenants),
+                    "evacuations": self._evacuations,
+                    "evacuation_age_ms":
+                        round((wall - self._last_evacuation_wall)
+                              * 1000.0, 1)
+                        if self._last_evacuation_wall else None,
+                    "migrations": self._migrations_done,
+                    "migrations_in_flight": len(self._migrations),
+                    "rows_migrated": self._rows_migrated,
+                    "migration_pause_ms_last":
+                        self._migration_pause_ms_last,
                     "per_device": {
                         str(d): {
                             "slots_placed": loads[d],
-                            "slot_budget":
-                                -(-self.max_tenants // self.n_devices),
+                            "slot_budget": self._slot_budget,
+                            "lost": d in self._lost_devices,
                             "rows_ingested": self._rows_per_device[d],
                             "collect_ms":
                                 self._collect_ms_per_device[d],
@@ -1570,6 +1947,30 @@ class TenantPool:
                         f"{p}.mesh.{key}", {"device": d},
                         dotted=f"{p}.mesh.device.{d}.{key}",
                         help=fam_help[key]).set(entry[key])
+                self.metrics.labeled_gauge(
+                    f"{p}.mesh.device_lost", {"device": d},
+                    dotted=f"{p}.mesh.device.{d}.lost",
+                    help="1 when this mesh device is marked lost"
+                ).set(int(entry["lost"]))
+            # migration.* / evacuation.* gauge families
+            # (docs/observability.md): live-move and device-loss
+            # counters for the rebalance/evacuation loops
+            flat[f"{p}.migration.count"] = mesh_info["migrations"]
+            flat[f"{p}.migration.in_flight"] = \
+                mesh_info["migrations_in_flight"]
+            flat[f"{p}.migration.rows_moved"] = \
+                mesh_info["rows_migrated"]
+            if mesh_info["migration_pause_ms_last"] is not None:
+                flat[f"{p}.migration.pause_ms_last"] = \
+                    mesh_info["migration_pause_ms_last"]
+            flat[f"{p}.evacuation.count"] = mesh_info["evacuations"]
+            flat[f"{p}.evacuation.lost_devices"] = \
+                len(mesh_info["lost_devices"])
+            flat[f"{p}.evacuation.lost_tenants"] = \
+                len(mesh_info["lost_tenants"])
+            if mesh_info["evacuation_age_ms"] is not None:
+                flat[f"{p}.evacuation.age_ms"] = \
+                    mesh_info["evacuation_age_ms"]
         # SLO + saturation (obs/slo.py): host-side windows, labeled
         # p99/burn/state families, machine-readable pressure signals
         report["slo"] = self.slo_engine.evaluate(saturation=saturation)

@@ -1,0 +1,214 @@
+"""SLO-driven pool rebalancer: at most one live migration per interval.
+
+The rebalancer closes the loop over signals the pool already emits —
+per-device pending backlog, saturation, QoS priority deferrals, SLO
+burn rates, per-device ``rows_ingested``/``collect_ms`` — and answers
+one question per interval: *is one device persistently hotter than the
+rest, and would moving one tenant off it help?* If yes, it calls
+`TenantPool.migrate_tenant` (serving/migrate.py protocol) exactly once
+and then cools down.
+
+Hysteresis, so it cannot flap:
+
+- the SAME device must be the hot one for ``confirm_steps``
+  CONSECUTIVE observations before anything moves (oscillating load
+  resets the streak every time the hot device changes);
+- after a migration the loop sleeps ``cooldown_steps`` intervals
+  (backlog the move itself created must not look like new skew);
+- at most ONE migration per step, ever.
+
+Kill switch: ``SIDDHI_TPU_REBALANCE=0`` disables the loop entirely —
+`start()` refuses and `step()` no-ops (docs/serving.md "Live migration
+& rebalance" lists the dials).
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import deque
+from typing import Optional
+
+REBALANCE_ENV = "SIDDHI_TPU_REBALANCE"   # "0" kills the loop
+
+log = logging.getLogger("siddhi_tpu.serving")
+
+
+class Rebalancer:
+    """Background skew->migration loop for one mesh TenantPool.
+
+    ``hot_ratio``: a device is hot when its pending backlog is at least
+    this multiple of the coolest survivor's (and >= ``min_rows``).
+    ``confirm_steps``: consecutive same-device hot observations before
+    migrating. ``cooldown_steps``: idle observations after a move.
+    """
+
+    def __init__(self, pool, interval_s: float = 1.0,
+                 hot_ratio: float = 3.0, confirm_steps: int = 2,
+                 cooldown_steps: int = 4, min_rows: int = 1):
+        if pool.mesh is None:
+            raise ValueError(
+                f"pool '{pool.name}' has no mesh — nothing to rebalance")
+        self.pool = pool
+        self.interval_s = float(interval_s)
+        self.hot_ratio = float(hot_ratio)
+        self.confirm_steps = int(confirm_steps)
+        self.cooldown_steps = int(cooldown_steps)
+        self.min_rows = int(min_rows)
+        self.steps = 0
+        self.migrations = 0
+        # per-step decision log (signals + action) — the flap-guard
+        # chaos scenario and the operator's post-mortem both read it
+        self.decisions: deque = deque(maxlen=256)
+        self._hot_device: Optional[int] = None
+        self._streak = 0
+        self._cooldown = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def enabled(self) -> bool:
+        return os.environ.get(REBALANCE_ENV, "1") != "0"
+
+    # -- signals ----------------------------------------------------------
+
+    def signals(self) -> dict:
+        """One consistent observation of everything the decision reads:
+        per-device backlog/tenants (from the slot map), saturation,
+        QoS deferrals, burn rates, per-device ingest/collect counters."""
+        pool = self.pool
+        with pool._lock:
+            backlog = [0] * pool.n_devices
+            tenants_by_device: list = [[] for _ in
+                                       range(pool.n_devices)]
+            pending = dict(pool._pending_rows)
+            for tid, slot in pool._tenants.items():
+                d = pool._device_of_slot(slot)
+                backlog[d] += pending.get(tid, 0)
+                tenants_by_device[d].append(tid)
+            sig = {
+                "backlog": backlog,
+                "tenants_by_device": tenants_by_device,
+                "pending": pending,
+                "lost_devices": sorted(pool._lost_devices),
+                "rows_per_device": list(pool._rows_per_device),
+                "collect_ms_per_device":
+                    list(pool._collect_ms_per_device),
+                "saturation": pool._saturation_locked(),
+                "deferrals": dict(pool._qos.deferrals)
+                if pool._qos is not None else {},
+            }
+        # burn rates ride the SLO evaluation (host-side windows only);
+        # scopes keyed "tenant=<id>" — the starved tenant's burn is the
+        # leading indicator that backlog skew became an SLO breach
+        slo = pool.slo_engine.evaluate()
+        sig["burn"] = {
+            name: {k: v for k, v in entry.items() if "burn" in k}
+            for name, entry in (slo.get("scopes") or {}).items()}
+        return sig
+
+    # -- one decision -----------------------------------------------------
+
+    def step(self) -> Optional[dict]:
+        """One observation + at most one migration. Returns the
+        migration record when one happened, else None. Synchronous and
+        lock-free at the top so tests drive it directly."""
+        if not self.enabled:
+            return None
+        self.steps += 1
+        sig = self.signals()
+        entry = {"step": self.steps, "action": "idle",
+                 "backlog": sig["backlog"],
+                 "lost_devices": sig["lost_devices"]}
+        self.decisions.append(entry)
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            entry["action"] = "cooldown"
+            return None
+        alive = [d for d in range(self.pool.n_devices)
+                 if d not in set(sig["lost_devices"])]
+        if len(alive) < 2:
+            return None
+        backlog = sig["backlog"]
+        hot = max(alive, key=lambda d: backlog[d])
+        coldest = min((d for d in alive if d != hot),
+                      key=lambda d: backlog[d])
+        baseline = max(1, backlog[coldest])
+        if backlog[hot] < self.min_rows or \
+                backlog[hot] < self.hot_ratio * baseline:
+            # not hot enough — and a cleared condition resets the
+            # confirmation streak (half the hysteresis)
+            self._hot_device, self._streak = None, 0
+            return None
+        if hot != self._hot_device:
+            # the hot spot MOVED: oscillating load never confirms
+            self._hot_device, self._streak = hot, 0
+        self._streak += 1
+        entry.update(hot_device=hot, streak=self._streak)
+        if self._streak < self.confirm_steps:
+            entry["action"] = "confirming"
+            return None
+        victims = sig["tenants_by_device"][hot]
+        if not victims:
+            self._hot_device, self._streak = None, 0
+            return None
+        victim = max(victims, key=lambda t: sig["pending"].get(t, 0))
+        try:
+            rec = self.pool.migrate_tenant(victim, coldest,
+                                           cause="rebalance")
+        except ValueError as exc:
+            # no free slot / racing churn: log, reset, try again later
+            entry["action"] = f"skipped: {exc}"
+            self._hot_device, self._streak = None, 0
+            return None
+        self.migrations += 1
+        self._hot_device, self._streak = None, 0
+        self._cooldown = self.cooldown_steps
+        entry["action"] = "migrated"
+        entry["migration"] = rec
+        log.info("pool '%s': rebalancer moved tenant '%s' d%d -> d%d "
+                 "(backlog %s)", self.pool.name, victim, hot, coldest,
+                 backlog)
+        return rec
+
+    # -- background loop --------------------------------------------------
+
+    def start(self) -> bool:
+        """Arm the interval loop on a daemon thread. Returns False (and
+        starts nothing) under the SIDDHI_TPU_REBALANCE=0 kill switch."""
+        if not self.enabled:
+            log.info("pool '%s': rebalancer disabled (%s=0)",
+                     self.pool.name, REBALANCE_ENV)
+            return False
+        if self._thread is not None:
+            return True
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"rebalance-{self.pool.name}")
+        self._thread.start()
+        return True
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — keep observing
+                log.exception("pool '%s': rebalance step failed",
+                              self.pool.name)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def report(self) -> dict:
+        last = self.decisions[-1] if self.decisions else None
+        return {"enabled": self.enabled, "steps": self.steps,
+                "migrations": self.migrations,
+                "interval_s": self.interval_s,
+                "hot_ratio": self.hot_ratio,
+                "confirm_steps": self.confirm_steps,
+                "cooldown_steps": self.cooldown_steps,
+                "last_decision": last}

@@ -222,6 +222,22 @@ def test_bench_tenants_quick_parses():
     assert fair["class_drain_order"][0] == "high"
     assert fair["class_drain_order"][-1] == "low"
     assert all(fair["drain_rounds"][t] for t in ("hi", "cold", "lo"))
+    # live-migration rebalance arm (docs/serving.md "Live migration &
+    # rebalance"): 8x skew on a sharded pool, one migration moves the
+    # hot tenant off the shared device — the starved p99 must come
+    # back within the 2x-of-fair bound, bit-identically, zero loss
+    # (the smoke child inherits the forced-8-device CPU shim)
+    reb = d["rebalance"]
+    assert "skipped" not in reb, reb
+    assert reb["skew"] == 8
+    assert reb["starved_p99_ms_before"] > 0
+    assert reb["starved_p99_ms_after"] > 0
+    assert reb["starved_p99_ms_fair"] > 0
+    assert reb["p99_restored"] is True, reb
+    assert reb["bit_identical"] is True, reb
+    assert reb["migration_pause_ms"] >= 0
+    assert reb["rows_moved"] >= 0
+    assert reb["lost"] == 0 and reb["duplicates"] == 0
 
 
 def test_bench_fanout_quick_parses():

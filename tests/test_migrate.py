@@ -1,0 +1,410 @@
+"""Live slot migration, evacuation & rebalance (serving/migrate.py,
+serving/rebalance.py, the TenantPool migration protocol): round-boundary
+flip semantics, the bounded park queue and its `migrating` 429, the
+placement-cache regression (admission budgets re-derive on EVERY
+slot-map change), the threaded soak equivalence (concurrent ingest /
+migration / checkpoint / breaker == serial replay, bit-exact), and the
+50-migration zero-recompile guard.
+"""
+import functools
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.persistence import deserialize
+from siddhi_tpu.parallel import sharding
+from siddhi_tpu.serving import AdmissionError, Template, TenantPool
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="migration needs >= 2 mesh devices")
+
+TPL = """
+define stream In (v double, k long);
+@info(name='q')
+from In[v > ${lo:double}]#window.lengthBatch(4)
+select v, k
+insert into Out;
+"""
+
+
+def _pool(name, slots=8, max_tenants=8, nd=2, qos=None, mgr=None,
+          **kw):
+    return TenantPool(Template(TPL), manager=mgr or SiddhiManager(),
+                      name=name, slots=slots, max_tenants=max_tenants,
+                      batch_max=16, mesh=sharding.build_mesh(nd),
+                      qos=qos, **kw)
+
+
+def _chunk(n, seed, base):
+    rng = np.random.default_rng(seed)
+    ts = base + np.arange(n, dtype=np.int64)
+    return ts, [rng.uniform(1.0, 10.0, n),
+                np.arange(n, dtype=np.int64) + base]
+
+
+def _snap(pool, tid):
+    payload = deserialize(pool.snapshot_tenant(tid))
+    flat, _ = jax.tree_util.tree_flatten(payload["queries"])
+    return [np.asarray(x) for x in flat]
+
+
+class TestMigrationProtocol:
+    def test_request_parks_then_flip_releases_in_order(self):
+        """In-flight chunks sent AFTER the request park in the bounded
+        queue; the next round boundary flips the slot map, releases
+        them behind the surviving pending tail, and every row lands
+        exactly once in arrival order."""
+        pool = _pool("mig1")
+        got = []
+        pool.add_tenant("a", {"lo": 0.0})
+        pool.add_callback("a", got.extend)
+        old_dev = pool._device_of_slot(pool._tenants["a"])
+        target = 1 - old_dev
+        ts, cols = _chunk(8, 1, 1_000)
+        pool.send("a", ts, cols)          # pre-request pending tail
+        pool.request_migration("a", target, cause="test")
+        ts2, cols2 = _chunk(8, 2, 2_000)
+        pool.send("a", ts2, cols2)        # parks, not pending
+        assert pool._pending_rows.get("a", 0) == 8
+        pool.flush()                      # flip at the round boundary
+        assert pool._device_of_slot(pool._tenants["a"]) == target
+        assert not pool._migrations
+        seen = [e.timestamp for e in got]
+        assert seen == sorted(seen) and len(seen) == 16
+        rec = pool.migration_log()[-1]
+        assert rec["cause"] == "test" and rec["parked_rows"] == 8
+        assert rec["rows_moved"] == 16
+        assert rec["from"]["device"] == old_dev
+        assert rec["to"]["device"] == target
+        pool.shutdown()
+
+    def test_flip_is_bit_identical_and_frees_old_slot(self):
+        pool = _pool("mig2")
+        pool.add_tenant("a", {"lo": 0.0})
+        pool.add_tenant("b", {"lo": 0.0})
+        ts, cols = _chunk(10, 3, 1_000)   # 10 rows: window holds 2
+        pool.send("a", ts, cols)
+        pool.flush()
+        before = _snap(pool, "a")
+        other = _snap(pool, "b")
+        old_slot = pool._tenants["a"]
+        rec = pool.migrate_tenant(
+            "a", 1 - pool._device_of_slot(old_slot))
+        after = _snap(pool, "a")
+        assert all(np.array_equal(x, y)
+                   for x, y in zip(before, after))
+        # the bystander's slice is untouched too
+        assert all(np.array_equal(x, y)
+                   for x, y in zip(other, _snap(pool, "b")))
+        assert old_slot in pool._free
+        assert rec["tenant"] == "a"
+        pool.shutdown()
+
+    def test_migrating_429_uses_flip_estimate_not_backlog(self):
+        """Satellite fix: the park-queue overflow 429 carries the
+        `migrating` cause and a retry hint of ONE round (the flip
+        happens at the next boundary) — NOT the backlog-drain estimate,
+        which grows with the queue the move is waiting out."""
+        pool = _pool("mig3")
+        pool.add_tenant("a", {"lo": 0.0})
+        ts, cols = _chunk(16, 4, 1_000)
+        pool.send("a", ts, cols)
+        pool.flush()                      # establish the round EMA
+        ts, cols = _chunk(64, 5, 10_000)  # deep backlog, unpumped
+        pool.send("a", ts, cols)
+        pool.request_migration(
+            "a", 1 - pool._device_of_slot(pool._tenants["a"]),
+            park_cap=8)
+        ts, cols = _chunk(8, 6, 20_000)
+        pool.send("a", ts, cols)          # fills the park queue
+        with pytest.raises(AdmissionError) as ei:
+            pool.send("a", *(_chunk(8, 7, 30_000)))
+        sat = ei.value.saturation
+        assert sat["cause"] == "migrating"
+        assert sat["park_cap"] == 8
+        backlog_estimate = pool._retry_after_ms(
+            pool._pending_rows["a"] + 8)
+        assert 0 < sat["retry_after_ms"] <= backlog_estimate
+        # one-round flip estimate, not rounds x backlog
+        assert sat["retry_after_ms"] == pool._retry_after_flip_ms()
+        pool.flush()                      # flip releases the queue
+        assert pool._pending_rows.get("a", 0) == 0
+        pool.shutdown()
+
+    def test_migration_rejects_bad_targets(self):
+        pool = _pool("mig4")
+        pool.add_tenant("a", {"lo": 0.0})
+        dev = pool._device_of_slot(pool._tenants["a"])
+        with pytest.raises(ValueError, match="already on device"):
+            pool.request_migration("a", dev)
+        with pytest.raises(ValueError, match="out of range"):
+            pool.request_migration("a", 99)
+        pool.request_migration("a", 1 - dev)
+        with pytest.raises(ValueError, match="in flight"):
+            pool.request_migration("a", 1 - dev)
+        pool.shutdown()
+
+
+class TestPlacementCache:
+    def test_admission_rederives_on_every_slot_map_change(self):
+        """Satellite fix: the cached per-device budgets follow add /
+        remove / migrate — the 429 payload always shows the REAL
+        placement, and freeing a device's slot re-opens admission."""
+        pool = _pool("cache1", slots=4, max_tenants=4)
+        for i in range(4):
+            pool.add_tenant(f"t{i}", {"lo": 0.0})
+        with pytest.raises(AdmissionError) as ei:
+            pool.add_tenant("late", {"lo": 0.0})
+        sat = ei.value.saturation
+        real = [0] * pool.n_devices
+        for slot in pool._tenants.values():
+            real[pool._device_of_slot(slot)] += 1
+        assert sat["placement"] == {str(d): real[d]
+                                    for d in range(pool.n_devices)}
+        assert sat["slot_budget"] == 2
+        pool.remove_tenant("t0")
+        pool.add_tenant("late", {"lo": 0.0})   # budget re-derived
+        pool.shutdown()
+
+    def test_migration_updates_the_429_placement(self):
+        pool = _pool("cache2", slots=4, max_tenants=4)
+        pool.add_tenant("a", {"lo": 0.0})
+        pool.add_tenant("b", {"lo": 0.0})
+        d_a = pool._device_of_slot(pool._tenants["a"])
+        pool.migrate_tenant("a", 1 - d_a)
+        sat = pool.saturation()
+        real = [0] * pool.n_devices
+        for slot in pool._tenants.values():
+            real[pool._device_of_slot(slot)] += 1
+        assert sat["placement"] == {str(d): real[d]
+                                    for d in range(pool.n_devices)}
+        pool.shutdown()
+
+    def test_device_loss_rederives_budget_over_survivors(self):
+        pool = _pool("cache3", slots=4, max_tenants=4)
+        pool.add_tenant("a", {"lo": 0.0})
+        dead = 1 - pool._device_of_slot(pool._tenants["a"])
+        pool.mark_device_lost(dead)
+        sat = pool.saturation()
+        assert sat["lost_devices"] == [dead]
+        assert sat["slot_budget"] == 4      # ceil(4 / 1 survivor)
+        # the dead device's slots are out of the free list
+        assert all(pool._device_of_slot(s) != dead
+                   for s in pool._free)
+        pool.add_tenant("b", {"lo": 0.0})   # lands on the survivor
+        assert pool._device_of_slot(pool._tenants["b"]) != dead
+        with pytest.raises(ValueError, match="no surviving"):
+            pool.mark_device_lost(1 - dead)
+        pool.shutdown()
+
+
+class TestServiceEndpoints:
+    def test_migrate_and_evacuate_routes(self):
+        """POST /siddhi/tenant/migrate/<pool>/<tid> flips the slot
+        (200 + the migration record), bad targets map to 400, unknown
+        pools to 404; POST /siddhi/tenant/evacuate/<pool> answers even
+        with nothing to evacuate."""
+        import json
+        import urllib.request
+        import urllib.error
+
+        from siddhi_tpu.core.service import SiddhiService
+
+        def post(port, path, body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(body).encode(), method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req) as r:
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        svc = SiddhiService()
+        svc.start()
+        try:
+            pool = svc.templates.pool(
+                TPL, warm=False, slots=4, max_tenants=4, batch_max=16,
+                mesh=sharding.build_mesh(2), name="svcmig")
+            pool.add_tenant("t1", {"lo": 0.0})
+            dev = pool._device_of_slot(pool._tenants["t1"])
+            code, body = post(
+                svc.port, f"/siddhi/tenant/migrate/{pool.name}/t1",
+                {"device": 1 - dev, "cause": "ops"})
+            assert code == 200, body
+            assert body["status"] == "migrated"
+            assert body["cause"] == "ops"
+            assert body["to"]["device"] == 1 - dev
+            assert pool._device_of_slot(
+                pool._tenants["t1"]) == 1 - dev
+            # same device again -> ValueError -> 400
+            code, body = post(
+                svc.port, f"/siddhi/tenant/migrate/{pool.name}/t1",
+                {"device": 1 - dev})
+            assert code == 400 and "already on device" in body["error"]
+            code, body = post(
+                svc.port, "/siddhi/tenant/migrate/nope/t1",
+                {"device": 0})
+            assert code == 404
+            code, body = post(
+                svc.port, f"/siddhi/tenant/evacuate/{pool.name}", {})
+            assert code == 200 and body["evacuated"] == []
+        finally:
+            svc.stop()
+
+
+class TestThreadedSoak:
+    def test_concurrent_migration_equals_serial_replay(self):
+        """Satellite: ingest, migration, checkpointing, and a failing-
+        then-healed breaker run CONCURRENTLY against one pool; the
+        delivered rows and final per-tenant state must equal a serial
+        replay of the same traffic bit-exactly — no lost or duplicated
+        rows anywhere."""
+        from siddhi_tpu import (InMemoryErrorStore,
+                                InMemoryPersistenceStore)
+        chunks = {f"t{i}": [_chunk(8, 10 * i + j,
+                                   1_000_000 * (i + 1) + 100 * j)
+                            for j in range(6)] for i in range(4)}
+
+        def mk(name):
+            mgr = SiddhiManager()
+            mgr.set_persistence_store(InMemoryPersistenceStore())
+            mgr.set_error_store(InMemoryErrorStore())
+            pool = _pool(name, qos={"breaker_failures": 3,
+                                    "breaker_reset_ms": 50},
+                         mgr=mgr)
+            got = {}
+            healed = {"on": False}
+
+            def flaky(events):
+                if not healed["on"]:
+                    raise RuntimeError("t3 sink down (injected)")
+                got["t3"].extend(events)
+
+            for tid in chunks:
+                pool.add_tenant(tid, {"lo": 0.0})
+                got[tid] = []
+                pool.add_callback(
+                    tid, flaky if tid == "t3" else got[tid].extend)
+            return pool, got, healed
+
+        def drain(pool, healed):
+            import time
+            healed["on"] = True
+            time.sleep(0.08)              # breaker cooldown elapses
+            for _ in range(40):
+                pool.flush()
+                replayed = sum(pool.replay_errors().values())
+                if replayed == 0 and not any(
+                        pool._pending_rows.get(t, 0) for t in chunks):
+                    break
+
+        # -- concurrent run ------------------------------------------
+        pool, got, healed = mk("soakc")
+        stop = threading.Event()
+
+        def ingest(tid):
+            for ts, cols in chunks[tid]:
+                while True:
+                    try:
+                        pool.send(tid, ts, cols)
+                        break
+                    except AdmissionError:
+                        stop.wait(0.002)
+
+        def migrate():
+            flips = 0
+            while not stop.is_set() and flips < 10:
+                try:
+                    d = pool._device_of_slot(pool._tenants["t0"])
+                    pool.migrate_tenant("t0", 1 - d, cause="soak")
+                    flips += 1
+                except (ValueError, KeyError):
+                    pass
+                stop.wait(0.001)
+
+        def checkpoint():
+            while not stop.is_set():
+                pool.persist()
+                stop.wait(0.002)
+
+        def pump():
+            while not stop.is_set():
+                pool.pump()
+                stop.wait(0.001)
+
+        threads = [threading.Thread(target=ingest, args=(tid,))
+                   for tid in chunks]
+        threads += [threading.Thread(target=migrate),
+                    threading.Thread(target=checkpoint),
+                    threading.Thread(target=pump)]
+        for t in threads:
+            t.start()
+        for t in threads[:4]:
+            t.join(timeout=60)
+        stop.set()
+        for t in threads[4:]:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads)
+        drain(pool, healed)
+
+        # -- serial replay of the same traffic -------------------------
+        ser, got_s, healed_s = mk("soaks")
+        for tid in chunks:
+            for ts, cols in chunks[tid]:
+                ser.send(tid, ts, cols)
+            ser.flush()
+        drain(ser, healed_s)
+
+        def rows(acc):
+            return sorted((e.timestamp, e.data[1]) for e in acc)
+
+        for tid in chunks:
+            a, b = rows(got[tid]), rows(got_s[tid])
+            assert a == b, f"{tid}: {len(a)} vs {len(b)} rows"
+            assert len(a) == len(set(a)), f"{tid}: duplicate rows"
+            sa, sb = _snap(pool, tid), _snap(ser, tid)
+            assert all(np.array_equal(x, y) for x, y in zip(sa, sb)), \
+                f"{tid}: final state diverged from the serial replay"
+        assert pool.statistics()["mesh"]["migrations"] >= 1
+        pool.shutdown()
+        ser.shutdown()
+
+
+class TestZeroRecompile:
+    def test_fifty_migrations_trace_nothing(self, monkeypatch):
+        """Tentpole guard: a warmed sharded pool survives 50 live
+        migrations (with traffic in between) without a single new
+        trace — the flip is an .at[].set on the placed arrays, never
+        a recompile (the counting-jit idiom of test_mesh.py)."""
+        pool = _pool("recomp")
+        for i in range(3):
+            pool.add_tenant(f"t{i}", {"lo": 0.0})
+        ts, cols = _chunk(16, 9, 1_000)
+        pool.send("t0", ts, cols)
+        pool.flush()                       # warm every program
+
+        real_jit = jax.jit
+        traces = [0]
+
+        def counting_jit(f, *a, **kw):
+            @functools.wraps(f)
+            def wrapped(*args, **kwargs):
+                traces[0] += 1
+                return f(*args, **kwargs)
+            return real_jit(wrapped, *a, **kw)
+
+        monkeypatch.setattr(jax, "jit", counting_jit)
+        for i in range(50):
+            d = pool._device_of_slot(pool._tenants["t0"])
+            pool.request_migration("t0", 1 - d, cause="guard")
+            pool.send("t0", *_chunk(8, i, 10_000 + 100 * i))  # parks
+            pool.flush()                   # flip + dispatch
+        assert traces[0] == 0, \
+            f"{traces[0]} retraces across 50 live migrations"
+        assert pool.statistics()["mesh"]["migrations"] == 50
+        pool.shutdown()

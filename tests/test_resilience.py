@@ -16,8 +16,10 @@ from siddhi_tpu.core import io as sio
 from siddhi_tpu.resilience.errorstore import replay
 from siddhi_tpu.resilience.scenarios import (
     run_corrupt_snapshot_fallback, run_disorder_equivalence,
-    run_pool_breaker_trip_recover, run_pool_hot_tenant_flood,
-    run_pool_kill_mid_round, run_sink_outage_crash_recovery, run_soak)
+    run_mesh_hot_tenant_skew, run_mesh_kill_device,
+    run_mesh_rebalance_flap_guard, run_pool_breaker_trip_recover,
+    run_pool_hot_tenant_flood, run_pool_kill_mid_round,
+    run_sink_outage_crash_recovery, run_soak)
 
 PLAYBACK = "@app:playback "
 
@@ -481,6 +483,82 @@ class TestPoolChaos:
         b = run_pool_kill_mid_round(seed=21)
         assert a["replayed"] == b["replayed"]
         assert a["stored_backlog"] == b["stored_backlog"]
+
+
+class TestMeshChaos:
+    """Sharded-pool scenarios (tools/chaos.py --mesh runs the same
+    functions): hot-tenant skew healed by a live migration, device
+    loss healed by checkpoint evacuation, and the rebalancer's
+    flap guard + kill switch (ISSUE 17 acceptance)."""
+
+    @staticmethod
+    def _needs_mesh():
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("mesh scenarios need >= 2 devices")
+
+    def test_hot_tenant_skew_migration_restores_p99(self):
+        """Acceptance: the colocated starved tenant's p99 blows out
+        under the skew, one live migration (flight-recorded with
+        cause + before/after placement) moves the hot tenant off the
+        device bit-identically, and the starved p99 lands back within
+        the 2x-of-fair bound with zero rows lost or duplicated."""
+        self._needs_mesh()
+        res = run_mesh_hot_tenant_skew(seed=7)
+        assert res["same_device_before"], res
+        assert res["migration_logged"], res
+        assert res["bit_identical"], res
+        assert res["p99_restored"] and res["p99_improved"], res
+        assert res["hot_delivered"] == res["hot_sent"], res
+        assert res["lost"] == 0 and res["duplicates"] == 0, res
+        assert res["migration_pause_ms"] is not None \
+            and res["migration_pause_ms"] >= 0
+
+    def test_kill_device_evacuates_bit_identical_zero_loss(self):
+        """Acceptance: survivors keep serving while the device is
+        down, victims restore bit-identically from the newest pool
+        checkpoint onto the survivors, the error backlog replays in
+        original-ts order, the retained queues drain, and recovery
+        age + evacuation count surface in statistics()['mesh']."""
+        self._needs_mesh()
+        res = run_mesh_kill_device(seed=7)
+        assert res["victims"] == ["a", "c"], res
+        assert res["survivor_kept_serving"], res
+        assert res["degraded_lost_devices"], res
+        assert res["evacuated"] == ["a", "c"], res
+        assert res["evacuated_from_revision"], res
+        assert res["victims_bit_identical"], res
+        assert res["replayed"] > 0 and res["replay_in_ts_order"], res
+        assert not any(res["lost"].values()), res
+        assert not any(res["duplicates"].values()), res
+        assert res["late_admitted_on_survivor"], res
+        assert res["mesh_lost_devices"] == [res["faults"][0]["device"]]
+        assert res["evacuations"] == 2, res
+        assert res["evacuation_age_ms"] is not None \
+            and res["evacuation_age_ms"] >= 0
+
+    def test_rebalancer_flap_guard_and_kill_switch(self):
+        """Acceptance: oscillating load never migrates (hysteresis),
+        sustained skew migrates exactly once then cools down, and
+        SIDDHI_TPU_REBALANCE=0 disables the loop."""
+        self._needs_mesh()
+        res = run_mesh_rebalance_flap_guard(seed=7)
+        assert res["flap_migrations"] == 0, res
+        assert res["flap_confirming_seen"], res
+        assert res["migrated_once"], res
+        assert res["cause_rebalance"], res
+        assert res["cooldown_seen"], res
+        assert res["kill_switch_start_refused"], res
+        assert res["kill_switch_step_noop"], res
+
+    def test_mesh_scenarios_deterministic_per_seed(self):
+        self._needs_mesh()
+        a = run_mesh_kill_device(seed=21)
+        b = run_mesh_kill_device(seed=21)
+        assert a["replayed"] == b["replayed"]
+        assert a["victims"] == b["victims"]
+        assert a["stored_backlog"] == b["stored_backlog"]
+
 
 
 # ---------------------------------------------------------------------------

@@ -14,6 +14,10 @@ Usage (from anywhere):
     python tools/chaos.py --soak 25        # + 25 soak rounds (slow)
     python tools/chaos.py --pool           # tenant-pool QoS/recovery
                                            # scenarios (serving/)
+    python tools/chaos.py --mesh           # sharded-pool scenarios:
+                                           # skew->migration, device
+                                           # loss->evacuation,
+                                           # rebalancer flap guard
 
 Exits nonzero when any scenario loses an event or fails to fall back to
 a good checkpoint. Failed scenarios dump a flight-recorder artifact and
@@ -39,13 +43,28 @@ def run(argv=None) -> int:
     ap.add_argument("--pool", action="store_true",
                     help="run the tenant-pool scenarios (QoS fairness, "
                          "breaker trip/recover, kill-pool-mid-round)")
+    ap.add_argument("--mesh", action="store_true",
+                    help="run the sharded-pool scenarios (hot-tenant "
+                         "skew -> live migration, kill-device -> "
+                         "evacuation, rebalancer flap guard)")
     args = ap.parse_args(argv)
+
+    if args.mesh and "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # the mesh scenarios need >= 2 devices; on the CPU shim that
+        # means forcing virtual devices BEFORE jax first imports (the
+        # scenario imports below trigger it)
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8").strip()
 
     from siddhi_tpu.resilience.scenarios import (
         failure_artifact, run_corrupt_snapshot_fallback,
         run_disorder_equivalence, run_pool_breaker_trip_recover,
         run_pool_hot_tenant_flood, run_pool_kill_mid_round,
-        run_sink_outage_crash_recovery, run_soak)
+        run_mesh_hot_tenant_skew, run_mesh_kill_device,
+        run_mesh_rebalance_flap_guard, run_sink_outage_crash_recovery,
+        run_soak)
 
     failures = 0
 
@@ -116,6 +135,44 @@ def run(argv=None) -> int:
                f"replayed={res['replayed']} "
                f"bit_identical={res['survivors_bit_identical']} "
                f"age={res['recovery_age_ms']}ms", res)
+
+    if args.mesh:
+        res = run_mesh_hot_tenant_skew(seed=args.seed)
+        report("mesh-hot-tenant-skew",
+               res["same_device_before"] and res["migration_logged"]
+               and res["bit_identical"] and res["p99_restored"]
+               and res["lost"] == 0 and res["duplicates"] == 0,
+               f"p99 {res['starved_p99_ms_before']}ms -> "
+               f"{res['starved_p99_ms_after']}ms "
+               f"(fair {res['starved_p99_ms_fair']}ms) "
+               f"pause={res['migration_pause_ms']}ms "
+               f"lost={res['lost']} duplicates={res['duplicates']}",
+               res)
+
+        res = run_mesh_kill_device(seed=args.seed)
+        report("mesh-kill-device",
+               res["survivor_kept_serving"]
+               and res["evacuated_from_revision"]
+               and res["victims_bit_identical"]
+               and res["replay_in_ts_order"]
+               and not any(res["lost"].values())
+               and not any(res["duplicates"].values()),
+               f"victims={res['victims']} "
+               f"evacuated={res['evacuated']} "
+               f"replayed={res['replayed']} "
+               f"bit_identical={res['victims_bit_identical']} "
+               f"age={res['evacuation_age_ms']}ms", res)
+
+        res = run_mesh_rebalance_flap_guard(seed=args.seed)
+        report("mesh-rebalance-flap-guard",
+               res["flap_migrations"] == 0 and res["migrated_once"]
+               and res["cause_rebalance"]
+               and res["kill_switch_start_refused"]
+               and res["kill_switch_step_noop"],
+               f"flap={res['flap_migrations']} "
+               f"sustained={res['sustained_migrations']} "
+               f"kill_switch_ok="
+               f"{res['kill_switch_start_refused']}", res)
 
     if args.soak:
         for i, r in enumerate(run_soak(seed=args.seed,
