@@ -168,6 +168,22 @@ def _plan_block(rt_or_pool):
         return {"error": f"{type(e).__name__}: {e}"}
 
 
+def _audit_block(rt_or_pool):
+    """Compiled-program audit block for the per-config JSON line
+    (analysis/programs.py): {programs, bytes_est_total, findings} — the
+    artifact records that every program measured was statically clean
+    (donation aliased, no host callbacks, strong dtypes) at the jaxpr
+    level, with zero extra executions or compiles. `store=False`: the
+    bench line is the artifact; don't mutate the service telemetry
+    after the measured stats were snapshotted."""
+    try:
+        rep = rt_or_pool.audit_programs(store=False)
+        return {k: rep[k] for k in ("programs", "bytes_est_total",
+                                    "findings")}
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail a run
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _stage_breakdown(rt, send):
     """Per-step cost attribution (obs/costmodel.py), run AFTER the timed
     reps — every sampled chunk serializes the pipeline, so it must never
@@ -343,9 +359,10 @@ def bench_filter(n=1_000_000):
         _drain(outs)))
     met = _metrics_snapshot(rt)
     plan = _plan_block(rt)
+    audit = _audit_block(rt)
     rt.shutdown()
     extra = {"ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
-             "plan": plan, "stage_breakdown": sb, **cinfo}
+             "plan": plan, "audit": audit, "stage_breakdown": sb, **cinfo}
     if dis is not None:
         extra["disorder"] = dis
     return _entry("filter", n, dt, extra=extra)
@@ -397,6 +414,7 @@ def _run_chain3(n: int, fused: bool):
                 outs.drain()))
         cinfo["metrics"] = _metrics_snapshot(rt)
         cinfo["plan"] = _plan_block(rt)
+        cinfo["audit"] = _audit_block(rt)
         rt.shutdown()
         return dt, ttfr, cinfo
     finally:
@@ -497,6 +515,7 @@ def _run_fanout(n: int, chunk: int, optimized: bool):
             cinfo["stage_breakdown"] = _stage_breakdown(rt, send)
         cinfo["metrics"] = _metrics_snapshot(rt)
         cinfo["plan"] = _plan_block(rt)
+        cinfo["audit"] = _audit_block(rt)
         rt.shutdown()
         return dt, ttfr, cinfo
     finally:
@@ -582,9 +601,11 @@ def _run_tenant_pool(n_tenants: int, rows: int, batch_max: int):
     stats = pool.statistics()
     comp = stats["compile"]
     plan = _plan_block(pool)
+    audit = _audit_block(pool)
     pool.shutdown()
     return {
         "plan": plan,
+        "audit": audit,
         "eps": round(n_tenants * rows / dt, 1),
         "seconds": round(dt, 3),
         "compile_ms": wu["compile_ms"],
@@ -832,6 +853,7 @@ def bench_tenants():
     sep = _run_tenant_separate(min(sep_n, min(n_list)), rows)
     per_n = {}
     plan = None
+    audit = None
     for n in n_list:
         pooled = _run_tenant_pool(n, rows, batch_max)
         assert pooled["program_sets"] == 1 and \
@@ -839,6 +861,7 @@ def bench_tenants():
         # ONE template plan regardless of N (pools of one template
         # share the plan_hash — slot counts are live facts, not plan)
         plan = pooled.get("plan") or plan
+        audit = pooled.get("audit") or audit
         per_n[n] = {
             "eps_pooled": pooled["eps"],
             # flat extrapolation of the measured separate-runtimes
@@ -869,6 +892,7 @@ def bench_tenants():
         "separate": sep,
         "tenants": {str(n): per_n[n] for n in n_list},
         "plan": plan,
+        "audit": audit,
         "slo": slo_arm,
         "fairness": fairness,
         "rebalance": rebalance,
@@ -907,10 +931,11 @@ def bench_window_agg(n=1_000_000):
         _drain(outs)))
     met = _metrics_snapshot(rt)
     plan = _plan_block(rt)
+    audit = _audit_block(rt)
     rt.shutdown()
     return _entry("window_agg", n, dt, extra={
         "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
-        "plan": plan, "stage_breakdown": sb, **cinfo})
+        "plan": plan, "audit": audit, "stage_breakdown": sb, **cinfo})
 
 
 def _run_join(n_symbols: int, chunk: int, join_pairs: int, n_side: int,
@@ -1016,6 +1041,7 @@ def _run_join_inner(n_symbols, chunk, join_pairs, n_side, frontier):
             rt, lambda: send_pair(2048))
     cinfo["metrics"] = _metrics_snapshot(rt)
     cinfo["plan"] = _plan_block(rt)
+    cinfo["audit"] = _audit_block(rt)
     # which kernel actually ran (grid vs banded probe) + the planner's
     # reason — the acceptance artifact must name it
     kernels = rt.statistics().get("compile", {}).get("join_kernels", {})
@@ -1129,10 +1155,11 @@ def bench_seq2(n=262_144, chunk=65_536):
                                        _drain(outs)))
     met = _metrics_snapshot(rt)
     plan = _plan_block(rt)
+    audit = _audit_block(rt)
     rt.shutdown()
     return _entry("seq2", 2 * n_chunks * chunk, dt, extra={
         "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
-        "plan": plan, "stage_breakdown": sb, **cinfo})
+        "plan": plan, "audit": audit, "stage_breakdown": sb, **cinfo})
 
 
 def bench_kleene(n=262_144, chunk=65_536):
@@ -1181,10 +1208,11 @@ def bench_kleene(n=262_144, chunk=65_536):
                                        _drain(outs)))
     met = _metrics_snapshot(rt)
     plan = _plan_block(rt)
+    audit = _audit_block(rt)
     rt.shutdown()
     return _entry("kleene", 2 * n_chunks * chunk, dt, extra={
         "ttfr_ms": round(ttfr * 1000.0, 1), "metrics": met,
-        "plan": plan, "stage_breakdown": sb, **cinfo})
+        "plan": plan, "audit": audit, "stage_breakdown": sb, **cinfo})
 
 
 SEQ5_APP = """
@@ -1295,12 +1323,13 @@ def bench_seq5(n=1_048_576, chunk=65_536):
                                        _drain(outs)))
     met = _metrics_snapshot(rt)
     plan = _plan_block(rt)
+    audit = _audit_block(rt)
     rt.shutdown()
     lat_ms = np.array(lat) * 1000.0
     lat1k_ms = np.array(lat1k) * 1000.0
     return _entry("seq5", n_chunks * chunk, dt, extra={
         **({"disorder": dis} if dis is not None else {}),
-        "metrics": met, "plan": plan,
+        "metrics": met, "plan": plan, "audit": audit,
         "frontier": fr, "stage_breakdown": sb,
         "p50_ms": round(float(np.percentile(lat_ms, 50)), 1),
         "p99_ms": round(float(np.percentile(lat_ms, 99)), 1),

@@ -41,10 +41,37 @@ register_meta(
     "a `# lint: disable=` pragma or baseline entry no longer suppresses "
     "anything — prune it so dead suppressions cannot mask future bugs")
 
+# compiled-program audit rules (programs.py / tools/audit.py): findings
+# are synthesized from the lowered artifact, not a source AST, so they
+# register as metadata like the driver ids above
+register_meta(
+    "program-donation-aliasing", ERROR,
+    "a donate_argnums buffer is missing from the lowered program's "
+    "input-output alias table — the 'in-place' state update silently "
+    "copies on every dispatch")
+register_meta(
+    "program-host-boundary", ERROR,
+    "a pure_callback/io_callback/debug_callback op is baked into a "
+    "jitted hot-path program — every chunk round-trips to Python")
+register_meta(
+    "program-dtype-drift", WARNING,
+    "a compiled program emits weak-typed outputs from strongly-typed "
+    "inputs — Python-scalar promotion destabilizes jit cache keys and "
+    "widens dtypes downstream (docs/compile_cache.md)")
+register_meta(
+    "program-memory-budget", ERROR,
+    "the program set's static live-buffer estimate exceeds the app's "
+    "@app:cap(program.mb=) dial")
+
+from .programs import (AuditReport, ProgramAudit, audit_pool,  # noqa: E402
+                       audit_runtime, audit_spec, audit_specs)
+
 __all__ = [
     "ERROR", "WARNING", "Finding", "ModuleContext",
     "lint_file", "lint_paths", "lint_source",
     "all_rules", "get_rule", "rule_names",
     "Schema", "aggregator_result_type",
     "ProjectContext", "build_project", "lint_project",
+    "AuditReport", "ProgramAudit",
+    "audit_spec", "audit_specs", "audit_runtime", "audit_pool",
 ]

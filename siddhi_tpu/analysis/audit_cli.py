@@ -1,0 +1,355 @@
+"""Command-line driver behind tools/audit.py — the compiled-program
+auditor (analysis/programs.py, docs/tpu_hygiene.md "Compiled-program
+audit").
+
+Where tools/lint.py verifies Python *source* and ``--plan`` verifies
+the query AST, this driver verifies what XLA would actually *compile*:
+it parses each SiddhiQL app, enumerates every step program the runtime
+can dispatch, lowers each with abstract arguments (zero executions,
+zero device work, zero new compiles) and checks donation aliasing,
+host-boundary callbacks, dtype drift and the ``@app:cap(program.mb=)``
+memory budget.
+
+Inputs:
+
+- default (no paths): the curated repo suite ``tools/audit_suite/``;
+- explicit ``.siddhi`` files or directories (``--app f.siddhi`` is an
+  alias for a single positional path); template sources (``${...}``)
+  audit through a real TenantPool — bind structural parameters with
+  repeatable ``--bind name=value``;
+- explicit ``.py`` fixture modules exposing ``specs() -> list`` (and
+  optionally ``BUDGET_MB``) — how tests/lint_fixtures seed the four
+  hazard shapes;
+- ``--corpus``: sweep the reference corpus (tests/ref_corpus/*.json),
+  deduplicated by structural app class so the ~400 extracted cases
+  audit as ~200 distinct plans;
+- ``--changed``: only git-modified/untracked ``.siddhi`` files under
+  ``--root`` (renames followed, like the linter).
+
+File-scope suppression inside ``.siddhi`` sources uses the linter's
+pragma: ``-- lint: disable=program-dtype-drift``. Findings flow through
+the shared baseline machinery (``tools/audit_baseline.json`` ships
+EMPTY and must stay empty) and ``--sarif`` emits SARIF 2.1.0 for
+code-scanning UIs. Exit codes: 0 clean (or baselined), 1 any fresh
+finding or stale baseline entry, 2 usage/configuration error.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+from typing import Optional
+
+from . import baseline as baseline_mod
+from .cli import _SIDDHI_PRAGMA, iter_siddhi_files
+from .findings import Finding, ERROR
+from .programs import PROGRAM_RULES, audit_specs
+
+# one app text per structural class: literals collapse so the corpus's
+# hundreds of near-identical extracted cases audit once per distinct
+# plan shape (the PR 16 sweep discipline)
+_LITERAL_RE = re.compile(r"('[^']*'|\b\d+(\.\d+)?\b)")
+
+
+def struct_class(app_text: str) -> str:
+    return _LITERAL_RE.sub("#", app_text)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="audit.py",
+        description="static compiled-program auditor for SiddhiQL apps")
+    p.add_argument("paths", nargs="*", default=None,
+                   help=".siddhi files/directories or .py fixture "
+                        "modules (default: the tools/audit_suite/ repo "
+                        "program set)")
+    p.add_argument("--app", default=None, metavar="FILE",
+                   help="audit one .siddhi app (alias for a positional "
+                        "path)")
+    p.add_argument("--corpus", action="store_true",
+                   help="sweep the reference corpus "
+                        "(tests/ref_corpus/*.json), struct-deduplicated")
+    p.add_argument("--changed", action="store_true",
+                   help="audit only git-modified/untracked .siddhi "
+                        "files under --root")
+    p.add_argument("--bind", action="append", default=None,
+                   metavar="NAME=VALUE",
+                   help="bind a template's structural ${NAME} "
+                        "placeholder (repeatable); template sources "
+                        "audit through a TenantPool")
+    p.add_argument("--buckets", default=None,
+                   help="comma-separated ingest buckets to enumerate "
+                        "programs for (default: SIDDHI_TPU_WARM_BUCKETS "
+                        "else 1024)")
+    p.add_argument("--budget-mb", type=float, default=None,
+                   help="memory budget override (else the app's "
+                        "@app:cap(program.mb=) dial)")
+    p.add_argument("--root", default=None,
+                   help="directory findings paths are made relative to "
+                        "(default: cwd)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON of grandfathered findings")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline; report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from current findings")
+    p.add_argument("--sarif", default=None, metavar="PATH",
+                   help="also write the new findings as SARIF 2.1.0")
+    p.add_argument("--json", default=None, metavar="PATH",
+                   help="write per-app audit summaries as JSON ('-' for "
+                        "stdout)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the program-audit rule table and exit")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the summary line")
+    return p
+
+
+def _pragma_disabled(text: str) -> set:
+    disabled: set = set()
+    for m in _SIDDHI_PRAGMA.finditer(text):
+        disabled |= {r.strip() for r in m.group("rules").split(",")
+                     if r.strip()}
+    return disabled
+
+
+def audit_app_text(text: str, rel: str, *, buckets=None,
+                   budget_mb=None, bind=None):
+    """Audit one SiddhiQL source: plain apps through an (unstarted)
+    SiddhiAppRuntime, templates through a real TenantPool so the
+    vmapped tenant-axis programs are the audited artifact. Returns an
+    AuditReport, or a parse/instantiation failure as a synthesized
+    ERROR finding inside one."""
+    from .programs import AuditReport, audit_pool, audit_runtime
+    disabled = _pragma_disabled(text)
+    try:
+        if "${" in text:
+            from ..serving.pool import TenantPool
+            from ..serving.template import Template
+            tpl = Template(text, name=f"audit_{abs(hash(rel)) & 0xffff}")
+            pool = TenantPool(tpl, shared=dict(bind or {}))
+            return audit_pool(pool, path=rel, budget_mb=budget_mb,
+                              disabled=disabled, store=False)
+        from ..core.manager import SiddhiManager
+        rt = SiddhiManager().create_siddhi_app_runtime(text)
+        return audit_runtime(rt, buckets=buckets, path=rel,
+                             budget_mb=budget_mb, disabled=disabled,
+                             store=False)
+    except Exception as e:  # noqa: BLE001 — an unbuildable app is the
+        # audit verdict for that file, not a driver crash
+        rep = AuditReport(rel, [], disabled=disabled)
+        rep.findings.append(Finding(
+            rule="parse-error", severity=ERROR, path=rel, line=1, col=0,
+            message=f"{type(e).__name__}: {e}"))
+        return rep
+
+
+def audit_fixture_module(path: str, rel: str, *, budget_mb=None):
+    """Audit a .py fixture exposing ``specs() -> list[CompileSpec]``
+    (and optionally ``BUDGET_MB``) — the hook tests/lint_fixtures uses
+    to seed doctored programs without a SiddhiQL surface for them."""
+    name = f"_audit_fixture_{pathlib.Path(path).stem}"
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    if budget_mb is None:
+        budget_mb = getattr(mod, "BUDGET_MB", None)
+    return audit_specs(mod.specs(), path=rel, budget_mb=budget_mb)
+
+
+def corpus_reports(corpus_dir: str, *, buckets=None, budget_mb=None,
+                   progress=None) -> list:
+    """Struct-deduplicated audit of every compilable corpus app."""
+    from ..lang.tokens import SiddhiParserException
+    from ..ops.expr import CompileError
+    reports, seen = [], set()
+    for f in sorted(pathlib.Path(corpus_dir).glob("*.json")):
+        for i, case in enumerate(json.loads(f.read_text())["cases"]):
+            if case.get("expect_error"):
+                continue
+            text = "@app:playback " + case["app"]
+            cls = struct_class(text)
+            if cls in seen:
+                continue
+            seen.add(cls)
+            rel = f"{f.stem}#{i}"
+            try:
+                rep = audit_app_text(text, rel, buckets=buckets,
+                                     budget_mb=budget_mb)
+            except (CompileError, SiddhiParserException):
+                continue
+            # apps the runtime itself refuses are out of audit scope
+            # (the sweep contract: every COMPILABLE app audits clean)
+            rep.findings = [x for x in rep.findings
+                            if x.rule != "parse-error"]
+            reports.append(rep)
+            if progress:
+                progress(len(reports), rel)
+    return reports
+
+
+def changed_siddhi_files(root: str) -> Optional[list[str]]:
+    """Git-modified (vs HEAD, renames followed) + untracked .siddhi
+    files under `root`; None when git is unavailable."""
+    files: set[str] = set()
+    try:
+        res = subprocess.run(
+            ["git", "-C", root, "diff", "--name-status", "-M",
+             "HEAD", "--"],
+            capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    for line in res.stdout.splitlines():
+        parts = line.split("\t")
+        if len(parts) < 2 or not parts[0] or parts[0][0] == "D":
+            continue
+        files.add(parts[2] if parts[0][0] in "RC" and len(parts) > 2
+                  else parts[1])
+    try:
+        res = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    files.update(x.strip() for x in res.stdout.splitlines()
+                 if x.strip())
+    return [os.path.join(root, f) for f in sorted(files)
+            if f.endswith(".siddhi") and "lint_fixtures" not in f
+            and os.path.exists(os.path.join(root, f))]
+
+
+def main(argv: Optional[list[str]] = None, stdout=None) -> int:
+    out = stdout or sys.stdout
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        from .registry import get_rule
+        for name in PROGRAM_RULES:
+            r = get_rule(name)
+            print(f"{r.name:28} {r.severity:8} {r.rationale}", file=out)
+        return 0
+
+    root = os.path.abspath(args.root or os.getcwd())
+    buckets = None
+    if args.buckets:
+        buckets = tuple(int(b) for b in args.buckets.split(",") if b)
+    bind = {}
+    for b in args.bind or ():
+        if "=" not in b:
+            print(f"--bind expects NAME=VALUE, got {b!r}", file=out)
+            return 2
+        k, _, v = b.partition("=")
+        bind[k.strip()] = v.strip()
+
+    paths = list(args.paths or ())
+    if args.app:
+        paths.append(args.app)
+    if args.changed:
+        changed = changed_siddhi_files(root)
+        if changed is None:
+            print("--changed requires a git checkout at --root",
+                  file=out)
+            return 2
+        if not changed and not paths and not args.corpus:
+            if not args.quiet:
+                print("no changed .siddhi files; nothing to audit",
+                      file=out)
+            return 0
+        paths += changed
+    if not paths and not args.corpus:
+        suite = os.path.join(root, "tools", "audit_suite")
+        if not os.path.isdir(suite):
+            print(f"no default program suite at {suite} — pass paths, "
+                  f"--app, --corpus or --changed", file=out)
+            return 2
+        paths = [suite]
+
+    reports = []
+    for p in paths:
+        if p.endswith(".py"):
+            rel = os.path.relpath(os.path.abspath(p), root) \
+                .replace(os.sep, "/")
+            reports.append(audit_fixture_module(
+                p, rel, budget_mb=args.budget_mb))
+            continue
+        for f in iter_siddhi_files([p]):
+            rel = os.path.relpath(os.path.abspath(f), root) \
+                .replace(os.sep, "/")
+            with open(f, encoding="utf-8") as fh:
+                text = fh.read()
+            reports.append(audit_app_text(
+                text, rel, buckets=buckets, budget_mb=args.budget_mb,
+                bind=bind))
+    if args.corpus:
+        corpus = os.path.join(root, "tests", "ref_corpus")
+        if not os.path.isdir(corpus):
+            print(f"no reference corpus at {corpus}", file=out)
+            return 2
+        reports += corpus_reports(corpus, buckets=buckets,
+                                  budget_mb=args.budget_mb)
+
+    findings = [f for rep in reports for f in rep.findings]
+
+    if args.update_baseline:
+        if not args.baseline:
+            print("--update-baseline requires --baseline PATH", file=out)
+            return 2
+        baseline_mod.save(args.baseline, findings)
+        if not args.quiet:
+            print(f"baseline updated: {len(findings)} finding(s) -> "
+                  f"{args.baseline}", file=out)
+        return 0
+
+    bl = {}
+    if args.baseline and not args.no_baseline:
+        try:
+            bl = baseline_mod.load(args.baseline)
+        except ValueError as e:
+            print(str(e), file=out)
+            return 2
+    fresh, n_baselined = baseline_mod.filter_new(findings, bl)
+    stale = baseline_mod.stale_keys(findings, bl)
+    if stale:
+        bl_rel = os.path.relpath(os.path.abspath(args.baseline), root) \
+            .replace(os.sep, "/")
+        for k in stale:
+            from .findings import WARNING
+            fresh.append(Finding(
+                rule="stale-pragma", severity=WARNING, path=bl_rel,
+                line=1, col=0,
+                message=("baseline entry no longer matches any finding "
+                         f"— prune it: {k}")))
+
+    for f in fresh:
+        print(f.render(), file=out)
+    if args.sarif:
+        from .sarif import write_sarif
+        write_sarif(args.sarif, fresh, root_uri=root)
+        if not args.quiet:
+            print(f"sarif written: {args.sarif} "
+                  f"({len(fresh)} result(s))", file=out)
+    if args.json:
+        doc = {
+            "programs": sum(len(r.programs) for r in reports),
+            "apps": [{"path": r.path, **r.summary()} for r in reports],
+            "findings": len(fresh),
+        }
+        if args.json == "-":
+            json.dump(doc, out, indent=1, sort_keys=True)
+            out.write("\n")
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                json.dump(doc, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+    if not args.quiet:
+        n_prog = sum(len(r.programs) for r in reports)
+        print(f"{len(reports)} app(s), {n_prog} program(s) audited: "
+              f"{len(fresh)} new finding(s), {n_baselined} baselined, "
+              f"{len(stale)} stale baseline entr(ies)", file=out)
+    return 1 if fresh else 0

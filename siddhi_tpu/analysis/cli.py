@@ -130,19 +130,38 @@ def plan_findings(paths, root: Optional[str] = None) -> list[Finding]:
 
 def changed_python_files(root: str) -> Optional[list[str]]:
     """Git-modified (vs HEAD) + untracked .py files under `root`; None
-    when git is unavailable. Lint fixtures are excluded — they seed
+    when git is unavailable. Renames are followed (``-M``): a moved
+    file lints at its NEW path instead of silently dropping out of the
+    changed set (``--name-only`` reports the old, now-nonexistent path
+    for an ``R`` entry). Lint fixtures are excluded — they seed
     antipatterns on purpose."""
     files: set[str] = set()
-    for cmd in (["git", "-C", root, "diff", "--name-only", "HEAD", "--"],
-                ["git", "-C", root, "ls-files", "--others",
-                 "--exclude-standard"]):
-        try:
-            res = subprocess.run(cmd, capture_output=True, text=True,
-                                 check=True)
-        except (OSError, subprocess.CalledProcessError):
-            return None
-        files.update(x.strip() for x in res.stdout.splitlines()
-                     if x.strip())
+    try:
+        res = subprocess.run(
+            ["git", "-C", root, "diff", "--name-status", "-M",
+             "HEAD", "--"],
+            capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    for line in res.stdout.splitlines():
+        parts = line.split("\t")
+        if len(parts) < 2 or not parts[0]:
+            continue
+        status = parts[0][0]  # R087 -> R, C100 -> C
+        if status == "D":
+            continue  # deleted: nothing to lint
+        # renames/copies list "R<score>\told\tnew" — lint the new path
+        files.add(parts[2] if status in "RC" and len(parts) > 2
+                  else parts[1])
+    try:
+        res = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, check=True)
+    except (OSError, subprocess.CalledProcessError):
+        return None
+    files.update(x.strip() for x in res.stdout.splitlines()
+                 if x.strip())
     out = []
     for f in sorted(files):
         if not f.endswith(".py") or "lint_fixtures" in f:

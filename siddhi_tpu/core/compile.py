@@ -42,6 +42,7 @@ and at DETAIL stats level the per-step timing list.
 """
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import threading
@@ -53,7 +54,8 @@ import jax
 import jax.numpy as jnp
 
 from .event import EventBatch, StreamSchema
-from .ingest import initial_encoding, encoding_for_sample, zero_packed_buffer
+from .ingest import (initial_encoding, encoding_for_sample, layout,
+                     zero_packed_buffer)
 
 # -- persistent-cache hit/miss counters --------------------------------------
 # jax.monitoring events are process-global; one listener feeds every
@@ -106,20 +108,96 @@ def _workers_from_env() -> int:
 
 
 # -- zero-argument builders ---------------------------------------------------
+#
+# Two modes, selected by `abstract_spec_args()`:
+#
+# - concrete (default): real zero device buffers — what warmup() calls
+#   the jitted steps with (the call donates its arguments, so the
+#   builders allocate fresh buffers, never the runtime's own state).
+# - abstract: `jax.ShapeDtypeStruct` leaves — what the compiled-program
+#   auditor (analysis/programs.py) traces/lowers the same specs with.
+#   Even a trivial `jnp.zeros` dispatches a fill program through the
+#   persistent compile cache, so the audit's zero-device-work /
+#   zero-new-compiles contract requires that NO concrete array is ever
+#   built on the audit path.
+
+_ABSTRACT_SPECS = threading.local()
+
+
+def _abstract() -> bool:
+    return getattr(_ABSTRACT_SPECS, "on", False)
+
+
+@contextlib.contextmanager
+def abstract_spec_args():
+    """Within this context every spec builder emits
+    `jax.ShapeDtypeStruct` argument leaves instead of zero device
+    buffers. Thread-local: a concurrent warmup on another thread still
+    materializes real buffers."""
+    _ABSTRACT_SPECS.on = True
+    try:
+        yield
+    finally:
+        _ABSTRACT_SPECS.on = False
+
+
+def spec_args_abstract() -> bool:
+    """True inside `abstract_spec_args()` — spec builders that cannot
+    route every allocation through the helpers below (mesh placement in
+    serving/pool.py needs concrete buffers) branch on this."""
+    return _abstract()
+
+
+def _sds(x) -> jax.ShapeDtypeStruct:
+    return jax.ShapeDtypeStruct(tuple(jnp.shape(x)), jnp.result_type(x))
+
+
+def zeros_array(shape, dtype):
+    """`jnp.zeros` twin that respects abstract-spec mode (the serving
+    pool's vmapped spec builders construct their stacked-slot arguments
+    through this so pool programs audit without device work)."""
+    if _abstract():
+        return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
+    return jnp.zeros(shape, dtype)
 
 
 def _zeros_like_tree(tree):
-    """Zero device arrays shaped like a live state pytree. Fresh buffers,
-    never the runtime's own state: the warm call donates its arguments."""
+    if _abstract():
+        return jax.tree_util.tree_map(_sds, tree)
     return jax.tree_util.tree_map(
         lambda x: jnp.zeros(jnp.shape(x), jnp.result_type(x)), tree)
 
 
 def _zero_batch(schema: StreamSchema, capacity: int) -> EventBatch:
+    if _abstract():
+        from .types import SET_LANES, AttrType, np_dtype
+
+        def col(t):
+            if t is AttrType.OBJECT:
+                return jax.ShapeDtypeStruct((capacity, 1 + SET_LANES),
+                                            jnp.int64)
+            return jax.ShapeDtypeStruct((capacity,),
+                                        jnp.dtype(np_dtype(t)))
+        return EventBatch(
+            ts=jax.ShapeDtypeStruct((capacity,), jnp.int64),
+            cols=tuple(col(t) for t in schema.types),
+            nulls=tuple(jax.ShapeDtypeStruct((capacity,), jnp.bool_)
+                        for _ in schema.types),
+            kind=jax.ShapeDtypeStruct((capacity,), jnp.int32),
+            valid=jax.ShapeDtypeStruct((capacity,), jnp.bool_))
     return EventBatch.empty(schema, capacity)
 
 
+def _zero_packed(schema: StreamSchema, enc: tuple, capacity: int):
+    if _abstract():
+        _, _, total = layout(len(schema.types), enc, capacity)
+        return jax.ShapeDtypeStruct((total,), jnp.uint8)
+    return zero_packed_buffer(schema, enc, capacity)
+
+
 def _zero_now():
+    if _abstract():
+        return jax.ShapeDtypeStruct((), jnp.int64)
     return jnp.asarray(0, dtype=jnp.int64)
 
 
@@ -151,6 +229,14 @@ class CompileService:
         self.cache_hits = 0
         self.cache_misses = 0
         self.warmups = 0
+        # keys already compiled by THIS service: repeat warmups (pool
+        # re-warm after restore, overlapping cap lists) skip them —
+        # identical (step, shape-bucket) specs lower exactly once
+        self._warmed_keys: set[str] = set()
+        # last compiled-program audit summary (analysis/programs.py):
+        # a live view — rides statistics()['compile'] and
+        # ExplainReport.programs, never the plan hash
+        self.audit: Optional[dict] = None
         self._lock = threading.Lock()
         # in-flight warmups: while > 0 the app is compiling and must not
         # be marked ready (service GET /ready load-balancer semantics)
@@ -339,9 +425,9 @@ class CompileService:
             if fused:
                 return (tuple(_zeros_like_tree(m.states)
                               for m in q.queries),
-                        tuple(jnp.asarray(0, jnp.int64)
+                        tuple(_zero_now()
                               for _ in q.queries))
-            return (_zeros_like_tree(q.states), jnp.asarray(0, jnp.int64))
+            return (_zeros_like_tree(q.states), _zero_now())
 
         head = q.head if fused else q
         row_caps = sorted({min(c, head.max_step_capacity or c)
@@ -362,7 +448,7 @@ class CompileService:
                         states, emitted = states_zero()
                         fn = q._packed_step_for(enc, cap)
                         return fn, (states, tstates_zero(), emitted,
-                                    zero_packed_buffer(schema, enc, cap))
+                                    _zero_packed(schema, enc, cap))
                     add(f"{name}/packed/{cap}/{','.join(enc)}", build)
 
     def _fanout_specs(self, add, group, schema, caps, packed_ok,
@@ -397,7 +483,7 @@ class CompileService:
                         states, emitted = states_zero()
                         fn = group._packed_step_for(enc, cap)
                         return fn, (states, tstates_zero(), emitted,
-                                    zero_packed_buffer(schema, enc, cap))
+                                    _zero_packed(schema, enc, cap))
                     add(f"{name}/packed/{cap}/{','.join(enc)}", build)
 
     def _pattern_specs(self, add, q, stream_id, schema, caps, packed_ok,
@@ -414,7 +500,7 @@ class CompileService:
                 fn = q._step_for_stream(stream_id)
                 return fn, (_zeros_like_tree(q.nfa_state),
                             _zeros_like_tree(q.states), tstates_zero(),
-                            jnp.asarray(0, jnp.int64),
+                            _zero_now(),
                             _zero_batch(schema, cap), _zero_now())
             add(f"{q.name}/pattern/{stream_id}/row/{cap}", build)
         if packed_ok:
@@ -425,8 +511,8 @@ class CompileService:
                         return fn, (_zeros_like_tree(q.nfa_state),
                                     _zeros_like_tree(q.states),
                                     tstates_zero(),
-                                    jnp.asarray(0, jnp.int64),
-                                    zero_packed_buffer(schema, enc, cap))
+                                    _zero_now(),
+                                    _zero_packed(schema, enc, cap))
                     add(f"{q.name}/pattern/{stream_id}/packed/{cap}/"
                         f"{','.join(enc)}", build)
 
@@ -447,7 +533,7 @@ class CompileService:
                 fn = q._step_for_side(side)
                 return fn, (side_zero(side), side_zero(opp),
                             _zeros_like_tree(q.states), tstates_zero(),
-                            jnp.asarray(0, jnp.int64),
+                            _zero_now(),
                             _zero_batch(schema, cap), _zero_now())
             add(f"{q.name}/join/{side}/row/{cap}", build)
         if packed_ok:
@@ -458,8 +544,8 @@ class CompileService:
                         return fn, (side_zero(side), side_zero(opp),
                                     _zeros_like_tree(q.states),
                                     tstates_zero(),
-                                    jnp.asarray(0, jnp.int64),
-                                    zero_packed_buffer(schema, enc, cap))
+                                    _zero_now(),
+                                    _zero_packed(schema, enc, cap))
                     add(f"{q.name}/join/{side}/packed/{cap}/"
                         f"{','.join(enc)}", build)
 
@@ -506,7 +592,7 @@ class CompileService:
                 fn = q._timer_step_for()
                 return fn, (_zeros_like_tree(q.nfa_state),
                             _zeros_like_tree(q.states),
-                            jnp.asarray(0, jnp.int64), _zero_now())
+                            _zero_now(), _zero_now())
             add(f"{q.name}/pattern/timer", build_timer)
 
             def build_due():
@@ -529,7 +615,7 @@ class CompileService:
                         _zeros_like_tree(q.states),
                         {t: _zeros_like_tree(app.tables[t].state)
                          for t in q.table_deps},
-                        jnp.asarray(0, jnp.int64),
+                        _zero_now(),
                         _zero_batch(schema, timer_cap), _zero_now())
                 add(f"{q.name}/join/{side}/row/{timer_cap}", build)
             return
@@ -543,11 +629,11 @@ class CompileService:
                 if fused:
                     states = (tuple(_zeros_like_tree(m.states)
                                     for m in target.queries),
-                              tuple(jnp.asarray(0, jnp.int64)
+                              tuple(_zero_now()
                                     for _ in target.queries))
                 else:
                     states = (_zeros_like_tree(q.states),
-                              jnp.asarray(0, jnp.int64))
+                              _zero_now())
                 st, emitted = states
                 fn = target._step_for() if fused \
                     else target._step_for(timer_cap)
@@ -598,6 +684,24 @@ class CompileService:
         records: list[dict] = []
         errors: list[dict] = []
         cancelled: list[str] = []
+
+        # dedupe: drop duplicate keys within this batch AND keys this
+        # service already compiled (externally-built lists — the pool's
+        # template-keyed specs — carry no specs()-style key dict, and a
+        # re-warm with overlapping caps must not lower the same program
+        # twice). Failed/cancelled specs are NOT remembered: they retry
+        # on the next warmup.
+        with self._lock:
+            seen = set(self._warmed_keys)
+        deduped = 0
+        todo = []
+        for s in specs:
+            if s.key in seen:
+                deduped += 1
+                continue
+            seen.add(s.key)
+            todo.append(s)
+        specs = todo
 
         def run(spec: CompileSpec) -> None:
             if self._cancel.is_set():
@@ -650,7 +754,10 @@ class CompileService:
             result["errors"] = errors
         if cancelled:
             result["cancelled"] = len(cancelled)
+        if deduped:
+            result["deduped"] = deduped
         with self._lock:
+            self._warmed_keys.update(r["step"] for r in records)
             self.warmups += 1
             self.programs += result["programs"]
             self.sharded_programs += n_sharded
@@ -670,6 +777,8 @@ class CompileService:
                 "cache_hits": self.cache_hits,
                 "cache_misses": self.cache_misses,
             }
+            if self.audit is not None:
+                out["audit"] = dict(self.audit)
             if detail:
                 out["steps"] = sorted(self.records,
                                       key=lambda r: -r["ms"])

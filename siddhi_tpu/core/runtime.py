@@ -2237,11 +2237,12 @@ class SiddhiAppRuntime:
                     "device").set(slots_per_dev[d])
             report["mesh"] = mesh_rep
             flat[f"{p}.mesh.n_devices"] = n
-        # AOT compile telemetry (only once a warmup ran): program count,
-        # compile wall ms, persistent-cache hits/misses; DETAIL level
-        # adds the per-step timing list (view only)
+        # AOT compile telemetry (once a warmup ran OR the static program
+        # auditor stored its summary): program count, compile wall ms,
+        # persistent-cache hits/misses; DETAIL level adds the per-step
+        # timing list (view only)
         comp: dict = {}
-        if self.compile_service.warmups:
+        if self.compile_service.warmups or self.compile_service.audit:
             comp = self.compile_service.summary(
                 detail=self.stats_level >= 2)
             for k in ("warmups", "programs", "compile_ms", "cache_hits",
@@ -2355,6 +2356,18 @@ class SiddhiAppRuntime:
         return self.compile_service.warmup(buckets=buckets,
                                            samples=samples,
                                            workers=workers)
+
+    def audit_programs(self, buckets=None, samples=None, **kw) -> dict:
+        """Static audit of every step program warmup() would compile
+        (analysis/programs.py): abstract-trace each spec and verify
+        donation aliasing, host-callback freedom, dtype stability and
+        the @app:cap(program.mb=) budget — ZERO executions, zero device
+        work, zero new compiles. The summary is stored on the compile
+        service and rides statistics()['compile']['audit'] and the
+        explain report's programs section (never hashed)."""
+        from ..analysis.programs import audit_runtime
+        return audit_runtime(self, buckets=buckets,
+                             samples=samples, **kw).summary()
 
     def warmup_async(self, buckets=None, samples=None, workers=None):
         """warmup() on a daemon thread; readiness (`self.ready`,

@@ -415,8 +415,10 @@ def _runtime_live(rt) -> dict:
 
 def _programs_section(compile_service) -> dict:
     """AOT program inventory: every warmed step with its compile ms,
-    plus the persistent-cache hit/miss story (core/compile.py). Live
-    telemetry — compile wall time must never move the plan hash."""
+    plus the persistent-cache hit/miss story (core/compile.py). When
+    the static program auditor ran (analysis/programs.py), its summary
+    block rides here too under ``audit``. Live telemetry — compile wall
+    time and audit results must never move the plan hash."""
     summary = compile_service.summary(detail=True)
     steps = summary.pop("steps", [])
     summary["steps"] = [{"step": r["step"], "compile_ms": r["ms"],

@@ -449,6 +449,28 @@ def describe_decisions(rt) -> dict:
     return derive(rt)[0]
 
 
+def program_attribution(rt) -> dict:
+    """Map each GROUPED program's spec-key prefix to the member queries
+    it serves, for the compiled-program auditor's reports
+    (analysis/programs.py). Fan-out specs compile under the junction's
+    stream id (``fanout:<sid>/row/<cap>``) which says nothing about who
+    runs inside; fused chains at least concatenate member names, but the
+    explicit list keeps audit output greppable by query name either
+    way. Installed artifacts only — call after ``_build_fused_chains``
+    (the audit entry points do)."""
+    attr: dict = {}
+    for j in rt.junctions.values():
+        group = getattr(j, "fanout", None)
+        if group is not None:
+            attr[f"fanout:{group.name}"] = [q.name for q in
+                                            group.queries]
+    for q in rt.queries.values():
+        ch = getattr(q, "_fused_chain", None)
+        if ch is not None and ch.name not in attr:
+            attr[ch.name] = [m.name for m in ch.queries]
+    return attr
+
+
 def build_plan(rt) -> dict:
     """Derive and install: fused chains (with pushdown schedules and
     cost-picked chunk caps) on their head queries, fan-out groups on

@@ -35,6 +35,7 @@ Isolation:
 """
 from __future__ import annotations
 
+import hashlib
 import logging
 import math
 import os
@@ -1183,15 +1184,34 @@ class TenantPool:
 
     # -- AOT warmup (one program set per template) ------------------------
 
-    def warmup(self, caps=None, workers: Optional[int] = None) -> dict:
-        """Compile the pool's vmapped step programs through the
-        prototype's PR 5 CompileService (parallel lowering + persistent
-        cache + telemetry) BEFORE the first tenant's traffic: telemetry
-        lands in statistics()['compile'] exactly once per pool no matter
-        how many tenants deploy."""
-        from ..core.compile import CompileSpec
+    def _spec_key_base(self) -> str:
+        """Content-addressed spec key prefix: template hash + shared
+        structural bindings + mesh width. Two pools instantiating the
+        same (template, shared) pair produce byte-identical programs, so
+        their specs must carry IDENTICAL keys — the CompileService key
+        dedupe and the persistent compile cache both line up on it
+        (a pool's display name never reaches the key)."""
+        base = f"tpl:{self.template.key}"
+        if self.shared:
+            blob = repr(sorted(self.shared.items()))
+            base += "+" + hashlib.sha256(blob.encode()).hexdigest()[:8]
+        if self.n_devices > 1:
+            base += f"@mesh{self.n_devices}"
+        return base
+
+    def _warm_spec_list(self, caps=None) -> list:
+        """The pool's vmapped step specs for the given row caps — the
+        list warmup() compiles and the compiled-program auditor
+        (analysis/programs.py audit_pool) traces abstractly. Builders
+        route every allocation through core/compile.py's mode-aware
+        helpers; mesh placement only happens on the concrete (warmup)
+        path — placing needs real buffers, and the audit never builds
+        any (it sees the single-device twin of each program)."""
+        from ..core.compile import (CompileSpec, spec_args_abstract,
+                                    zeros_array)
         caps = sorted({bucket_capacity(min(int(c), self.batch_max))
                        for c in (caps or (self.batch_max,))})
+        base = self._spec_key_base()
         specs = []
         with self._lock:
             slots = self.slots
@@ -1200,18 +1220,19 @@ class TenantPool:
                     def build(qname=qname, cap=cap):
                         fn = self._vstep_for(qname, cap)
                         states = _tree_zeros(self._states[qname])
-                        emitted = jnp.zeros((slots,), jnp.int64)
+                        emitted = zeros_array((slots,), jnp.int64)
                         schema = self.proto.queries[qname].in_schema
                         N = slots
                         batch = EventBatch(
-                            ts=jnp.zeros((N, cap), jnp.int64),
-                            cols=tuple(jnp.zeros((N, cap), np_dtype(t))
+                            ts=zeros_array((N, cap), jnp.int64),
+                            cols=tuple(zeros_array((N, cap), np_dtype(t))
                                        for t in schema.types),
-                            nulls=tuple(jnp.zeros((N, cap), jnp.bool_)
+                            nulls=tuple(zeros_array((N, cap), jnp.bool_)
                                         for _ in schema.types),
-                            kind=jnp.zeros((N, cap), jnp.int32),
-                            valid=jnp.zeros((N, cap), jnp.bool_))
-                        if self.mesh is not None:
+                            kind=zeros_array((N, cap), jnp.int32),
+                            valid=zeros_array((N, cap), jnp.bool_))
+                        if self.mesh is not None and \
+                                not spec_args_abstract():
                             # warm SHARDED programs: the example args
                             # must carry the runtime placement or the
                             # AOT compile lands on a different (and
@@ -1226,13 +1247,32 @@ class TenantPool:
                             emitted = placed["emitted"][qname]
                             batch = self._place_batch(batch)
                         return fn, (states, emitted, batch,
-                                    jnp.asarray(0, jnp.int64))
+                                    zeros_array((), jnp.int64))
                     specs.append(CompileSpec(
-                        f"{self.name}/{qname}/v{slots}x{cap}", build))
-        result = self.proto.compile_service.warm_specs(specs,
-                                                       workers=workers)
+                        f"{base}/{qname}/v{slots}x{cap}", build))
+        return specs
+
+    def warmup(self, caps=None, workers: Optional[int] = None) -> dict:
+        """Compile the pool's vmapped step programs through the
+        prototype's PR 5 CompileService (parallel lowering + persistent
+        cache + telemetry) BEFORE the first tenant's traffic: telemetry
+        lands in statistics()['compile'] exactly once per pool no matter
+        how many tenants deploy. Specs are keyed by template content
+        (not pool name) and the service skips keys it already compiled,
+        so re-warms with overlapping cap lists lower only the NEW
+        shapes."""
+        result = self.proto.compile_service.warm_specs(
+            self._warm_spec_list(caps), workers=workers)
         self._warmed = True
         return result
+
+    def audit_programs(self, caps=None, **kw) -> dict:
+        """Static audit of the pool's vmapped programs (zero
+        executions/compiles — analysis/programs.py): donation aliasing,
+        host callbacks, dtype drift, @app:cap(program.mb=) budget. The
+        summary rides statistics()['compile']['audit']."""
+        from ..analysis.programs import audit_pool
+        return audit_pool(self, caps=caps, **kw).summary()
 
     @property
     def ready(self) -> bool:

@@ -1,0 +1,30 @@
+"""Audit fixture: a host callback baked into a jitted step program.
+
+``jax.debug.print`` lowers to a ``debug_callback`` op INSIDE the
+compiled artifact — every dispatched chunk round-trips to Python, which
+is the silent 1000x ``program-host-boundary`` exists to catch. The
+plain arithmetic next to it must stay quiet.
+
+Loaded by tools/audit.py (and tests/test_program_audit.py) through the
+``specs()`` hook; never imported by the runtime.
+"""
+import jax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.compile import CompileSpec, zeros_array
+
+
+@jax.jit
+def _step(state, batch):
+    total = state + batch.sum()
+    jax.debug.print("processed {x} rows", x=batch.shape[0])
+    return total
+
+
+def _build():
+    return _step, (zeros_array((), jnp.int64),
+                   zeros_array((1024,), jnp.int64))
+
+
+def specs():
+    return [CompileSpec("fixture/io_callback/row/1024", _build)]

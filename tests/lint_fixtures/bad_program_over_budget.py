@@ -1,0 +1,32 @@
+"""Audit fixture: a program set whose static live-buffer estimate
+blows the app's memory budget.
+
+One step holds an 8 MB float64 window state against a declared 1 MB
+``BUDGET_MB`` (the fixture-module spelling of the
+``@app:cap(program.mb=)`` dial) — ``program-memory-budget`` must fire
+and name this step among the top offenders.
+
+Loaded by tools/audit.py (and tests/test_program_audit.py) through the
+``specs()`` hook; never imported by the runtime.
+"""
+import jax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.compile import CompileSpec, zeros_array
+
+BUDGET_MB = 1
+
+
+@jax.jit
+def _step(state, batch):
+    return state.at[0].add(batch.sum()), state.sum()
+
+
+def _build():
+    # 1024 x 1024 float64 = 8 MB of window state
+    return _step, (zeros_array((1024, 1024), jnp.float64),
+                   zeros_array((1024,), jnp.float64))
+
+
+def specs():
+    return [CompileSpec("fixture/over_budget/row/1024", _build)]

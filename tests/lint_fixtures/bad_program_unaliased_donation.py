@@ -1,0 +1,48 @@
+"""Audit fixture: a DONATED buffer the compiled program cannot alias.
+
+``step`` donates its state argument, but the output it corresponds to
+has a different shape (the concatenate grows it), so XLA drops the
+donation and the "in-place" update silently copies 256 KiB on every
+dispatch — exactly the hazard ``program-donation-aliasing`` exists to
+catch. The second, well-shaped state argument DOES alias and must stay
+quiet: the rule fires per unusable buffer, not per donated program.
+
+Loaded by tools/audit.py (and tests/test_program_audit.py) through the
+``specs()`` hook; never imported by the runtime.
+"""
+import jax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.compile import CompileSpec, zeros_array
+
+# 512 x 64 float64 = 256 KiB — comfortably above the audit's
+# donate_min_bytes floor (64 KiB), so the copy is a finding, not a
+# counter
+_ROWS, _COLS = 512, 64
+
+
+@jax.jit
+def _aliased_ok(state, batch):
+    # donation-friendly: same shape in, same shape out
+    return state + batch.sum(), state * 2.0
+
+
+_step = jax.jit(
+    lambda state, good, batch: (
+        # state grows by one row -> shapes differ -> XLA cannot alias
+        jnp.concatenate([state, batch[None, :]], axis=0),
+        good + 1.0,
+    ),
+    donate_argnums=(0, 1),
+)
+
+
+def _build():
+    state = zeros_array((_ROWS, _COLS), jnp.float64)
+    good = zeros_array((_ROWS, _COLS), jnp.float64)
+    batch = zeros_array((_COLS,), jnp.float64)
+    return _step, (state, good, batch)
+
+
+def specs():
+    return [CompileSpec("fixture/unaliased_donation/row/1024", _build)]

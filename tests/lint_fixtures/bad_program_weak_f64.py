@@ -1,0 +1,30 @@
+"""Audit fixture: a weak-typed float64 output from strongly-typed
+inputs.
+
+The step returns a bare Python scalar alongside its real output; with
+x64 enabled it lands in the artifact as a WEAK float64 — a
+Python-scalar promotion that destabilizes jit cache keys and widens
+dtypes downstream (``program-dtype-drift``). The strongly-typed int64
+output next to it must stay quiet.
+
+Loaded by tools/audit.py (and tests/test_program_audit.py) through the
+``specs()`` hook; never imported by the runtime.
+"""
+import jax
+import jax.numpy as jnp
+
+from siddhi_tpu.core.compile import CompileSpec, zeros_array
+
+
+@jax.jit
+def _step(state, batch):
+    return state + batch.sum(), 1.5  # the scalar leaks out weak
+
+
+def _build():
+    return _step, (zeros_array((), jnp.int64),
+                   zeros_array((1024,), jnp.int64))
+
+
+def specs():
+    return [CompileSpec("fixture/weak_f64/row/1024", _build)]

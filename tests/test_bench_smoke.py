@@ -60,6 +60,21 @@ def _assert_plan(d: dict):
     assert "window_compaction" in plan["decisions"]
 
 
+def _assert_audit(d: dict):
+    """Every app-backed config's JSON line carries the compiled-program
+    audit block: {programs, bytes_est_total, findings} — the artifact
+    records that what was measured is statically clean at the jaxpr
+    level (donation aliased, no host callbacks, strong dtypes; see
+    analysis/programs.py and docs/tpu_hygiene.md "Compiled-program
+    audit"). A finding here means the bench measured a hazardous
+    program set."""
+    audit = d["audit"]
+    assert "error" not in audit, audit
+    assert audit["programs"] > 0
+    assert audit["bytes_est_total"] > 0
+    assert audit["findings"] == 0, audit
+
+
 def test_bench_filter_quick_parses():
     d = _run_config("filter")
     assert d["unit"] == "events/s"
@@ -74,6 +89,7 @@ def test_bench_filter_quick_parses():
     assert isinstance(d["metrics"], dict)
     assert any(k.startswith("siddhi.") for k in d["metrics"])
     _assert_plan(d)
+    _assert_audit(d)
 
 
 def test_bench_chain3_quick_parses_fused_vs_unfused():
@@ -89,6 +105,7 @@ def test_bench_chain3_quick_parses_fused_vs_unfused():
     assert any(k.startswith("siddhi.") for k in d["metrics"])
     # the plan block must record the fused segment (what was measured)
     _assert_plan(d)
+    _assert_audit(d)
     segs = d["plan"]["decisions"]["fusion"]["segments"]
     assert segs and segs[0]["members"] == ["q1", "q2", "q3"]
     # cost attribution of the fused run: ONE chain center, members named
@@ -125,6 +142,7 @@ def test_bench_seq5_quick_parses_frontier_and_breakdown():
     assert d["value"] > 0
     assert d["p99_ms"] > 0 and d["p99_ms_1k"] > 0
     _assert_plan(d)
+    _assert_audit(d)
     _assert_frontier(d)
     _assert_breakdown(d, top_kind="pattern")
 
@@ -147,6 +165,7 @@ def test_bench_join_quick_parses_frontier_and_breakdown():
     assert d["join_kernel"] == "probe"
     # plan block: the kernel decision rides the artifact with a cause
     _assert_plan(d)
+    _assert_audit(d)
     jk = d["plan"]["decisions"]["join_kernels"]
     assert jk["q.left"]["kernel"] == "probe"
     assert jk["q.left"]["cause"]
@@ -200,6 +219,7 @@ def test_bench_tenants_quick_parses():
     # skewed-traffic SLO arm (obs/slo.py): measured p50/p99 attainment
     # vs the configured objective must parse with burn-rate state
     _assert_plan(d)   # the pool's template plan block
+    _assert_audit(d)  # ...and its template-keyed program audit
     slo = d["slo"]
     assert slo["objective_p99_ms"] > 0
     assert slo["samples"] > 0, slo
@@ -256,6 +276,7 @@ def test_bench_fanout_quick_parses():
     assert d["subscribers"] == 4
     assert d["compile_ms"] > 0 and d["ttfr_ms"] > 0
     _assert_plan(d)
+    _assert_audit(d)
     # the plan block records WHAT the optimizer did: the fused group
     # with its cause slug, and the shared-prefix classes
     fan = d["plan"]["decisions"]["optimizer"]["fanout"]["S"]

@@ -154,3 +154,30 @@ def test_changed_mode_lints_only_modified_files(tmp_path):
     rc = lint_main(["--changed", "--root", str(tmp_path)], stdout=out)
     assert rc == 1
     assert "fresh.py" in out.getvalue()
+
+
+def test_changed_mode_follows_renames(tmp_path):
+    """A `git mv` + edit must lint the file at its NEW path: the old
+    ``--name-only`` diff reported only the old (now-nonexistent) path
+    for an R entry, silently dropping renamed files from the changed
+    set."""
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "config", "user.email", "ci@local")
+    _git(tmp_path, "config", "user.name", "ci")
+    old = tmp_path / "module_a.py"
+    old.write_text("import jax.numpy as jnp\n\n\ndef f():\n"
+                   "    return jnp.zeros((2,))\n")
+    _git(tmp_path, "add", ".")
+    _git(tmp_path, "commit", "-qm", "seed")
+
+    _git(tmp_path, "mv", "module_a.py", "module_b.py")
+    moved = tmp_path / "module_b.py"
+    # a small edit keeps git's similarity detection classifying the
+    # change as a rename (R9x) while introducing a fresh finding
+    moved.write_text(moved.read_text() + "X = jnp.zeros((4,))\n")
+    out = io.StringIO()
+    rc = lint_main(["--changed", "--root", str(tmp_path)], stdout=out)
+    assert rc == 1, out.getvalue()
+    assert "module_b.py" in out.getvalue()
+    assert "module_a.py" not in out.getvalue(), \
+        "the pre-rename path must not be linted (it no longer exists)"

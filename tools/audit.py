@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Compiled-program audit CLI — thin wrapper over
+siddhi_tpu.analysis.audit_cli.
+
+Where tools/lint.py checks the Python *source* and ``--plan`` checks
+the query AST, this tool checks what XLA would actually *compile*: it
+abstract-traces every step program an app can dispatch (zero
+executions, zero device work, zero new compiles) and verifies donation
+aliasing, host-callback freedom, dtype stability and the
+``@app:cap(program.mb=)`` memory budget — see docs/tpu_hygiene.md
+"Compiled-program audit".
+
+Usage (from anywhere; relative paths resolve against the repo root):
+
+    python tools/audit.py                   # the curated repo suite
+                                            # (tools/audit_suite/)
+    python tools/audit.py --app my.siddhi   # one app
+    python tools/audit.py apps/ more.siddhi # files / directories
+    python tools/audit.py fixture.py        # a specs() fixture module
+    python tools/audit.py --corpus          # ref-corpus sweep
+                                            # (struct-deduplicated)
+    python tools/audit.py --changed         # only git-modified .siddhi
+    python tools/audit.py --sarif out.sarif # + SARIF 2.1.0 for CI
+    python tools/audit.py --json -          # per-app JSON summaries
+    python tools/audit.py --bind thr=10.0 --app tpl.siddhi  # template
+    python tools/audit.py --list-rules
+
+Exits 1 on any non-baselined finding; the checked-in baseline
+(tools/audit_baseline.json) ships EMPTY and must stay empty — this is
+the CI gate (tests/test_program_audit.py runs the same check in
+tier-1).
+"""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "audit_baseline.json")
+
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from siddhi_tpu.analysis.audit_cli import main  # noqa: E402
+
+
+def _resolve(arg: str) -> str:
+    """Resolve a non-flag argument against the repo root when it does
+    not exist relative to the cwd."""
+    if arg.startswith("-") or os.path.isabs(arg) or os.path.exists(arg):
+        return arg
+    rooted = os.path.join(REPO_ROOT, arg)
+    return rooted if os.path.exists(rooted) else arg
+
+
+def run(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--baseline" not in argv and "--no-baseline" not in argv:
+        argv += ["--baseline", DEFAULT_BASELINE]
+    if "--root" not in argv:
+        argv += ["--root", REPO_ROOT]
+    return main([_resolve(a) for a in argv])
+
+
+if __name__ == "__main__":
+    sys.exit(run())
