@@ -614,3 +614,82 @@ def bare_gauge_family(ctx: ModuleContext) -> Iterator[Finding]:
             "labeled_gauge(...) without a HELP string — pass help= (or "
             "describe() the family) so the metric family is "
             "self-documenting in /metrics scrapes")
+
+
+# ---------------------------------------------------------------------
+# rule: per-row-encode-hazard
+# ---------------------------------------------------------------------
+
+_INGEST_VERBS = ("send", "encode", "ingest", "dispatch", "publish",
+                 "flush", "emit")
+
+
+def _ingest_fn_name(ctx: ModuleContext, node: ast.AST):
+    """Name of the nearest enclosing function IF it sits on an ingest
+    path (name carries an ingest verb); None otherwise. The name gate
+    keeps row-oriented decode/callback helpers (`_decode_rows`, sink
+    adapters) out of scope — those are the row API, not the hot path."""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            low = anc.name.lower()
+            if any(v in low for v in _INGEST_VERBS):
+                return anc.name
+            return None
+    return None
+
+
+_COMP_NODES = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _loop_iter_exprs(node: ast.AST):
+    if isinstance(node, (ast.For, ast.AsyncFor)):
+        yield node.iter
+    elif isinstance(node, _COMP_NODES):
+        for gen in node.generators:
+            yield gen.iter
+
+
+@register(
+    "per-row-encode-hazard", WARNING,
+    "a Python-level per-row loop over event columns on an ingest path "
+    "serializes the encoder at interpreter speed (~1e6 rows/s ceiling); "
+    "keep the hot path columnar — numpy slicing and whole-lane bitcasts "
+    "(core/ingest.py PackedEncoder), never per-row tuples")
+def per_row_encode_hazard(ctx: ModuleContext) -> Iterator[Finding]:
+    """Flags loops/comprehensions on ingest-path functions (send/encode/
+    ingest/dispatch/publish/flush/emit in the name) whose ITERATION
+    SOURCE materializes rows from columns: ``zip(*cols)`` transposes
+    columns into per-row tuples, ``arr.tolist()`` boxes every element.
+    Iterating columns per-COLUMN (``for c in cols``) stays clean — only
+    the row-major blowup is the hazard."""
+    for node in ctx.nodes:
+        fn_name = None
+        for it in _loop_iter_exprs(node):
+            reason = None
+            for sub in ast.walk(it):
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Name) \
+                        and sub.func.id == "zip" \
+                        and any(isinstance(a, ast.Starred)
+                                for a in sub.args):
+                    reason = f"'{_src(sub)}' transposes columns into " \
+                             "per-row tuples"
+                    break
+                if isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == "tolist" and not sub.args:
+                    reason = f"'{_src(sub)}' boxes every element into " \
+                             "a Python object"
+                    break
+            if reason is None:
+                continue
+            if fn_name is None:
+                fn_name = _ingest_fn_name(ctx, node)
+            if fn_name is None:
+                break  # not an ingest-path function
+            yield _finding(
+                "per-row-encode-hazard", WARNING, ctx, it,
+                f"per-row iteration in ingest-path '{fn_name}': {reason} "
+                "— keep the encode columnar (numpy slices / vectorized "
+                "ops) so chunk cost stays O(columns), not O(rows)")
+            break  # one finding per loop

@@ -55,6 +55,7 @@ import jax.numpy as jnp
 
 from .event import EventBatch, StreamSchema
 from .ingest import (initial_encoding, encoding_for_sample, layout,
+                     pipeline_enabled, pipeline_split_cap,
                      zero_packed_buffer)
 
 # -- persistent-cache hit/miss counters --------------------------------------
@@ -320,6 +321,7 @@ class CompileService:
                               PatternStreamReceiver, QueryRuntime,
                               bucket_capacity)
         from ..parallel.partition import BlockStreamReceiver
+        from ..resilience.ordering import ring_enabled
         app = self.app
         buckets = tuple(sorted({bucket_capacity(int(b)) for b in buckets}))
         timer_cap = BATCH_BUCKETS[0]
@@ -365,6 +367,15 @@ class CompileService:
                 if pc:
                     jcap = min(jcap, pc)
             caps = sorted({bucket_capacity(min(B, jcap)) for B in buckets})
+            if packed_ok and pipeline_enabled():
+                # pipelined dispatch splits oversized sends into
+                # pipeline_split_cap()-row sub-chunks (core/ingest.py) —
+                # warm those shapes so the overlap path hits no compiles
+                sub = pipeline_split_cap()
+                extra = {bucket_capacity(min(B, jcap, sub))
+                         for B in buckets if B > sub}
+                if extra - set(caps):
+                    caps = sorted(set(caps) | extra)
             if fanout is not None:
                 # ONE fused fan-out program covers every grouped
                 # subscriber; members keep their timer-batch specs below
@@ -390,6 +401,11 @@ class CompileService:
                 elif isinstance(r, BlockStreamReceiver):
                     self._partition_specs(add, r.block, sid, j.schema,
                                           caps)
+            buf = getattr(app, "_reorder", {}).get(sid)
+            if (buf is not None and ring_enabled()
+                    and buf.ring_eligible()):
+                self._ring_specs(add, sid, j, receivers, fanout,
+                                 fused_members, samples)
 
         # -- named windows: fed by InsertIntoWindowHandler at the feeding
         # query's batch capacity (approximated by the ingest buckets)
@@ -485,6 +501,78 @@ class CompileService:
                         return fn, (states, tstates_zero(), emitted,
                                     _zero_packed(schema, enc, cap))
                     add(f"{name}/packed/{cap}/{','.join(enc)}", build)
+
+    def _ring_specs(self, add, sid, j, receivers, fanout, fused_members,
+                    samples):
+        """Device reorder-ring step (resilience/ordering.py) plus the
+        consumer programs its releases dispatch. The ring emits
+        EventBatches of capacity 2*C which each receiver's
+        process_batch slices at max_step_capacity — warm the ring sort
+        AND those row shapes so the opt-in ring costs zero steady-state
+        compiles and its programs join the compiled-program audit."""
+        from .runtime import (JoinStreamReceiver, PatternStreamReceiver,
+                              QueryRuntime)
+        from ..parallel.partition import BlockStreamReceiver
+        from ..resilience.ordering import ring_step_for
+        from .types import np_dtype
+        schema = j.schema
+        buf = self.app._reorder[sid]
+        C = buf.ring_capacity()
+        R = 2 * C
+
+        def build():
+            fn = ring_step_for(schema.types, C)
+            sts = zeros_array((C,), jnp.int64)
+            scols = tuple(zeros_array((C,), np_dtype(t))
+                          for t in schema.types)
+            in_ts = zeros_array((C,), jnp.int64)
+            in_cols = tuple(zeros_array((C,), np_dtype(t))
+                            for t in schema.types)
+
+            def sc(dt):
+                if _abstract():
+                    return jax.ShapeDtypeStruct((), jnp.dtype(dt))
+                return jnp.asarray(0, dtype=dt)
+
+            return fn, (sts, scols, in_ts, in_cols, sc(jnp.int32),
+                        sc(jnp.int32), sc(jnp.int64), sc(jnp.int32),
+                        sc(jnp.bool_))
+        add(f"ring:{sid}/{C}", build)
+
+        def split_caps(ms):
+            # split_batch slices the 2C release into ms-row chunks plus
+            # one R%ms-row tail — exactly the shapes dispatch will hit
+            if not ms or R <= ms:
+                return [R]
+            out = {ms}
+            if R % ms:
+                out.add(R % ms)
+            return sorted(out)
+
+        if fanout is not None:
+            self._fanout_specs(add, fanout, schema,
+                               split_caps(fanout.max_step_capacity),
+                               packed_ok=False, samples=samples)
+        for r in receivers:
+            if fanout is not None and fanout.covers(r):
+                continue
+            ms = getattr(r, "max_step_capacity", None)
+            caps = split_caps(ms)
+            if isinstance(r, QueryRuntime):
+                if id(r) in fused_members:
+                    continue
+                target = r._fused_chain or r
+                self._query_specs(add, target, schema, caps,
+                                  packed_ok=False, samples=samples)
+            elif isinstance(r, PatternStreamReceiver):
+                self._pattern_specs(add, r.runtime, r.stream_id,
+                                    schema, caps, packed_ok=False,
+                                    samples=samples)
+            elif isinstance(r, JoinStreamReceiver):
+                self._join_specs(add, r.runtime, r.side, schema, caps,
+                                 packed_ok=False, samples=samples)
+            elif isinstance(r, BlockStreamReceiver):
+                self._partition_specs(add, r.block, sid, schema, caps)
 
     def _pattern_specs(self, add, q, stream_id, schema, caps, packed_ok,
                        samples):

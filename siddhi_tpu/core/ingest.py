@@ -29,6 +29,7 @@ costs one device_put per chunk and zero per-batch host round-trips.
 """
 from __future__ import annotations
 
+import os
 from typing import Sequence
 
 import jax
@@ -119,23 +120,82 @@ def _int_code(span: int) -> str:
 
 class PackedEncoder:
     """Per-stream sticky encoding chooser: codes only widen across chunks
-    (each distinct encoding tuple is a separate XLA compile)."""
+    (each distinct encoding tuple is a separate XLA compile).
+
+    The encode path is zero-copy where the wire format allows it: a
+    caller column that already matches the lane dtype and C layout is
+    bitcast-viewed straight into the packed buffer (no ``np.asarray``
+    round trip, no defensive copy); coercions and per-lane copies are
+    counted in ``stats`` and surface in ``statistics()['ingest']`` so
+    regressions are visible. Host staging buffers rotate (up to three
+    per layout size) instead of reallocating per chunk — except on the
+    CPU backend, where ``jax.device_put`` may zero-copy alias the
+    numpy buffer for the device array's lifetime and rewriting it
+    would corrupt a live array."""
 
     def __init__(self, schema: StreamSchema):
         self.schema = schema
         self._ts_code = "aff"
         self._col_codes = ["c"] * len(schema.types)
+        self.stats = {"chunks": 0, "rows": 0, "coerced_arrays": 0,
+                      "view_lanes": 0, "copied_lanes": 0,
+                      "staging_reuse": 0}
+        self._staging: dict = {}
+        self._reuse = jax.default_backend() != "cpu"
 
     def _widen(self, cur: str, cand: str) -> str:
         return cand if _RANK[cand] > _RANK[cur] else cur
+
+    def _conform(self, arr, want) -> np.ndarray:
+        """Zero-copy fast path: an already-conformant numpy column
+        (dtype + C-contiguity match) passes through untouched; anything
+        else pays one counted coercion copy."""
+        if isinstance(arr, np.ndarray) and arr.dtype == want and \
+                arr.flags.c_contiguous:
+            return arr
+        self.stats["coerced_arrays"] += 1
+        return np.ascontiguousarray(arr, dtype=want)
+
+    def _buffer(self, total: int):
+        """-> (host staging buffer, fresh). Fresh buffers are all-zero
+        (calloc); pooled buffers are reused only once their previous
+        device transfer reports ready, so a rewrite can never race an
+        in-flight H2D copy (double-buffered dispatch keeps at most two
+        transfers outstanding; the pool holds three buffers)."""
+        if self._reuse:
+            pool = self._staging.setdefault(total, [])
+            for ent in pool:
+                dev = ent[1]
+                if dev is None or getattr(dev, "is_ready",
+                                          lambda: False)():
+                    ent[1] = None
+                    self.stats["staging_reuse"] += 1
+                    return ent[0], False
+            if len(pool) < 3:
+                buf = np.zeros((total,), np.uint8)
+                pool.append([buf, None])
+                return buf, True
+        return np.zeros((total,), np.uint8), True
+
+    def note_transfer(self, buf: np.ndarray, dev) -> None:
+        """Record the device array a pooled staging buffer fed — the
+        reuse gate in _buffer waits on it."""
+        if not self._reuse:
+            return
+        for ent in self._staging.get(buf.nbytes, ()):
+            if ent[0] is buf:
+                ent[1] = dev
+                return
 
     def encode(self, ts: np.ndarray, cols: Sequence, capacity: int,
                now: int):
         """-> (buf np.uint8[total], enc tuple, n)."""
         assert capacity % 8 == 0, capacity
-        ts = np.ascontiguousarray(ts, dtype=np.int64)
+        ts = self._conform(ts, np.int64)
         n = int(ts.shape[0])
         types = self.schema.types
+        self.stats["chunks"] += 1
+        self.stats["rows"] += n
 
         # --- choose codes -------------------------------------------------
         if n >= 2:
@@ -159,29 +219,27 @@ class PackedEncoder:
         ncols = []
         bases = []
         for i, t in enumerate(types):
-            c = np.ascontiguousarray(np.asarray(cols[i]))
             if t in _INT_FAMILY:
                 want = np.int64 if t is AttrType.LONG else np.int32
-                if c.dtype != want:
-                    c = c.astype(want)
+                c = self._conform(cols[i], want)
                 lo = int(c.min()) if n else 0
                 hi = int(c.max()) if n else 0
                 cand = "c" if lo == hi else _int_code(hi - lo)
                 base = lo
             elif t is AttrType.FLOAT:
-                c = c.astype(np.float32) if c.dtype != np.float32 else c
+                c = self._conform(cols[i], np.float32)
                 u = c.view(np.uint32)
                 cand = "c" if (n and (u == u[0]).all()) or n == 0 else "f32"
                 base = int(np.int64(np.float64(c[0]).view(np.int64))) \
                     if (cand == "c" and n) else 0
             elif t is AttrType.DOUBLE:
-                c = c.astype(np.float64) if c.dtype != np.float64 else c
+                c = self._conform(cols[i], np.float64)
                 u = c.view(np.uint64)
                 cand = "c" if (n and (u == u[0]).all()) or n == 0 else "f64"
                 base = int(c[:1].view(np.int64)[0]) if (cand == "c" and n) \
                     else 0
             elif t is AttrType.BOOL:
-                c = c.astype(np.bool_) if c.dtype != np.bool_ else c
+                c = self._conform(cols[i], np.bool_)
                 if n and (c == c[0]).all():
                     cand, base = "c", int(c[0])
                 elif n == 0:
@@ -201,7 +259,7 @@ class PackedEncoder:
 
         # --- assemble the single buffer ----------------------------------
         H, offs, total = layout(len(types), enc, capacity)
-        buf = np.zeros((total,), np.uint8)
+        buf, fresh = self._buffer(total)
         hdr = buf[:H].view(np.int64)
         hdr[0] = n
         hdr[1] = base_ts
@@ -210,38 +268,57 @@ class PackedEncoder:
         for i, b in enumerate(bases):
             hdr[4 + i] = b
 
-        def put(o: int, arr: np.ndarray):
+        stats = self.stats
+
+        def put(o: int, arr: np.ndarray, lane: int, view: bool):
+            """Write one lane; ``view`` marks a direct bitcast view of
+            the (conformed) caller array — no intermediate temp."""
             raw = arr.view(np.uint8)
-            buf[o:o + raw.nbytes] = raw
+            end = o + raw.nbytes
+            buf[o:end] = raw
+            if not fresh:
+                # pooled buffer: pad rows must decode exactly like a
+                # fresh zeroed buffer
+                buf[end:o + lane] = 0
+            stats["view_lanes" if view else "copied_lanes"] += 1
 
         # ts lane
-        if ts_code == "d8":
-            put(offs[0], (ts - base_ts).astype(np.uint8))
-        elif ts_code == "d16":
-            put(offs[0], (ts - base_ts).astype(np.uint16))
-        elif ts_code == "d32":
-            put(offs[0], (ts - base_ts).astype(np.uint32))
-        elif ts_code == "raw64":
-            put(offs[0], ts)
+        ts_lane = _pad8(_lane_bytes(ts_code, capacity))
+        if ts_code == "raw64":
+            put(offs[0], ts, ts_lane, view=True)
+        elif ts_code != "aff":
+            dt = {"d8": np.uint8, "d16": np.uint16,
+                  "d32": np.uint32}[ts_code]
+            put(offs[0], (ts - base_ts).astype(dt), ts_lane, view=False)
 
         for i, ((code, c), base) in enumerate(zip(ncols, bases)):
             o = offs[1 + i]
             if code == "c":
                 continue
+            lane = _pad8(_lane_bytes(code, capacity))
             if code == "b1":
                 bits = np.zeros((capacity,), np.bool_)
                 bits[:n] = c
-                put(o, np.packbits(bits, bitorder="little"))
-            elif code == "f32":
-                put(o, c)
-            elif code == "f64":
-                put(o, c)
+                put(o, np.packbits(bits, bitorder="little"), lane,
+                    view=False)
+            elif code in ("f32", "f64"):
+                put(o, c, lane, view=True)
             elif code == "raw64":
-                put(o, c.astype(np.int64))
+                if c.dtype == np.int64:
+                    put(o, c, lane, view=True)
+                else:
+                    put(o, c.astype(np.int64), lane, view=False)
             else:  # d8/d16/d32 deltas
                 dt = {"d8": np.uint8, "d16": np.uint16,
                       "d32": np.uint32}[code]
-                put(o, (c.astype(np.int64) - base).astype(dt))
+                if _CODE_BYTES[code] < c.dtype.itemsize:
+                    # the span fits the column's native dtype (e.g. d16
+                    # from int32): subtract without the int64 temp
+                    put(o, (c - c.dtype.type(base)).astype(dt), lane,
+                        view=False)
+                else:
+                    put(o, (c.astype(np.int64) - base).astype(dt), lane,
+                        view=False)
         return buf, enc, n
 
 
@@ -347,5 +424,108 @@ class PackedChunk:
     def build(cls, encoder: PackedEncoder, ts, cols, capacity: int,
               now: int):
         buf, enc, n = encoder.encode(ts, cols, capacity, now)
-        return cls(jax.device_put(buf), enc, capacity, n, int(ts[-1]),
+        dev = jax.device_put(buf)
+        encoder.note_transfer(buf, dev)
+        return cls(dev, enc, capacity, n, int(ts[-1]),
                    ts_min=int(ts.min()) if len(ts) else None)
+
+
+# -- double-buffered ingest pipeline -----------------------------------------
+
+PIPELINE_SPLIT_DEFAULT = 262144
+
+
+def pipeline_enabled() -> bool:
+    """``SIDDHI_TPU_INGEST_PIPELINE=0`` kill switch (default on) for
+    the double-buffered encode/dispatch overlap."""
+    return os.environ.get("SIDDHI_TPU_INGEST_PIPELINE", "1").lower() \
+        not in ("0", "off", "false")
+
+
+def pipeline_split_cap() -> int:
+    """Sub-chunk size the pipeline cuts oversized sends into
+    (``SIDDHI_TPU_INGEST_PIPELINE_CHUNK`` overrides; must be a bucket
+    from BATCH_BUCKETS to keep jit caches warm)."""
+    raw = os.environ.get("SIDDHI_TPU_INGEST_PIPELINE_CHUNK", "")
+    try:
+        v = int(raw)
+    except ValueError:
+        v = 0
+    return v if v > 0 else PIPELINE_SPLIT_DEFAULT
+
+
+def pipeline_chunk_cap(n: int, max_cap: int) -> int:
+    """Effective per-chunk cap under the pipeline: a send larger than
+    the split cap is cut into sub-chunks so encode of chunk N+1 can
+    overlap device work of chunk N even for one huge send_arrays call.
+    The compile service mirrors this (core/compile.py specs) so warmed
+    programs match what dispatch produces."""
+    sub = pipeline_split_cap()
+    return min(max_cap, sub) if n > sub else max_cap
+
+
+class IngestPipeline:
+    """Double-buffered ingest for one input handler: a single worker
+    thread encodes chunk N+1 (pure numpy — the heavy ufuncs drop the
+    GIL) while the caller thread dispatches chunk N, whose H2D copy and
+    compute ride JAX async dispatch. The bounded futures window is the
+    backpressure: the producer blocks in ``result()`` until the oldest
+    encode lands, so at most DEPTH chunks are in flight and nothing
+    queues beyond the encoder's rotating staging buffers —
+    admission/429 decisions stay upstream (serving/qos.py).
+
+    Donation-safe by construction: packed steps donate their state
+    buffers (argnums 0-2) but never the packed chunk argument, so a
+    chunk whose transfer is still in flight cannot be invalidated by
+    the step consuming its predecessor."""
+
+    DEPTH = 2
+
+    def __init__(self, stream_id: str):
+        from concurrent.futures import ThreadPoolExecutor
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"ingest-{stream_id}")
+        self.stats = {"sends": 0, "chunks": 0, "encode_s": 0.0,
+                      "dispatch_s": 0.0, "wall_s": 0.0, "overlap_s": 0.0}
+
+    def run(self, n_chunks: int, encode, dispatch) -> None:
+        """``encode(i) -> chunk`` on the worker thread; ``dispatch(i,
+        chunk)`` on the caller thread, overlapped one chunk ahead."""
+        from collections import deque
+        from time import perf_counter
+        t0 = perf_counter()
+        enc_s = disp_s = 0.0
+
+        def timed_encode(i):
+            e0 = perf_counter()
+            return encode(i), perf_counter() - e0
+
+        futs = deque([self._pool.submit(timed_encode, 0)])
+        try:
+            for i in range(n_chunks):
+                if i + 1 < n_chunks:
+                    futs.append(self._pool.submit(timed_encode, i + 1))
+                chunk, dt = futs.popleft().result()
+                enc_s += dt
+                d0 = perf_counter()
+                dispatch(i, chunk)
+                disp_s += perf_counter() - d0
+        finally:
+            while futs:  # dispatch failed: drain the lookahead encode
+                f = futs.popleft()
+                if not f.cancel():
+                    try:
+                        f.result(timeout=60)
+                    except Exception:  # noqa: BLE001 — the dispatch
+                        pass           # error already propagates
+            wall = perf_counter() - t0
+            st = self.stats
+            st["sends"] += 1
+            st["chunks"] += n_chunks
+            st["encode_s"] += enc_s
+            st["dispatch_s"] += disp_s
+            st["wall_s"] += wall
+            st["overlap_s"] += max(0.0, enc_s + disp_s - wall)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=True)

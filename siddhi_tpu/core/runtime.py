@@ -2178,6 +2178,17 @@ class SiddhiAppRuntime:
                 flat[f"{sbase}.reorder.depth"] = buf.depth
                 for k, v in buf.counters.items():
                     flat[f"{sbase}.reorder.{k}"] = v
+            # ingest-path zero-copy + pipeline-overlap counters
+            # (core/stream.py InputHandler.ingest_stats): coercion
+            # copies and encode/device overlap are regressions/wins the
+            # bench gates on (tools/bench_diff.py)
+            h = self.input_handlers.get(sid)
+            ing = h.ingest_stats() if h is not None else None
+            if ing:
+                report.setdefault("ingest", {})[sid] = ing
+                for k, v in ing.items():
+                    if isinstance(v, (int, float)):
+                        flat[f"{sbase}.ingest.{k}"] = v
         if self._reorder:
             report["reorder"] = {
                 sid: {"watermark": b.watermark, "lag_ms": b.lag_ms,
@@ -2745,6 +2756,8 @@ class SiddhiAppRuntime:
             logging.getLogger("siddhi_tpu.runtime").error(
                 "app '%s': async streams did not drain cleanly on "
                 "shutdown: %s", self.name, flush_errors)
+        for h in self.input_handlers.values():
+            h.close()  # join ingest pipeline workers
         self._resolve_dues()
         for s in self.sources:
             s.disconnect()

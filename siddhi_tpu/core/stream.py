@@ -350,6 +350,11 @@ class InputHandler:
         self.junction = junction
         self.app = app_runtime
         self._encoder = None  # lazy sticky PackedEncoder (core/ingest.py)
+        self._pipeline = None  # lazy IngestPipeline (double-buffering)
+        # serializes columnar sends per stream: the sticky encoder and
+        # the pipeline worker are single-writer; ordering is always
+        # _ingest_lock -> app.barrier (never the reverse)
+        self._ingest_lock = threading.RLock()
 
     def send(self, data) -> None:
         if not self.app.running:
@@ -434,26 +439,31 @@ class InputHandler:
         if n == 0:
             return
         self.app._columnar = True
-        buf = self.app._reorder.get(self.stream_id)
-        if buf is not None:
-            # columnar reorder buffer: the chunk lands in numpy segments;
-            # the watermark-driven flush re-emits sorted chunks through
-            # _dispatch_arrays (same bucketed capacities, zero new jits)
-            self.junction.mark_ingest(n)
-            with maybe_span(self.app, "ingest", self.stream_id,
-                            rows=n, buffered=1), self.app.barrier:
-                buf.ingest_columns(ts, cols)
-            return
-        self._dispatch_arrays(ts, cols)
+        with self._ingest_lock:
+            buf = self.app._reorder.get(self.stream_id)
+            if buf is not None:
+                # columnar reorder buffer: the chunk lands in numpy
+                # segments; the watermark-driven flush re-emits sorted
+                # chunks through _dispatch_arrays (same bucketed
+                # capacities, zero new jits)
+                self.junction.mark_ingest(n)
+                with maybe_span(self.app, "ingest", self.stream_id,
+                                rows=n, buffered=1), self.app.barrier:
+                    buf.ingest_columns(ts, cols)
+                return
+            self._dispatch_arrays(ts, cols)
 
     def _dispatch_arrays(self, ts, cols, mark: bool = True) -> None:
         """Columnar publish body: chunk to bucketed capacities and
         dispatch. Direct ingest and reorder-buffer releases share this
         path; releases pass mark=False (ingest throughput was already
-        marked at arrival)."""
-        from .event import batch_from_columns
-        from .ingest import PackedChunk, PackedEncoder
-        from .runtime import BATCH_BUCKETS, bucket_capacity
+        marked at arrival). When every receiver is packed-capable and
+        the pipeline kill switch is on, multi-chunk sends run double-
+        buffered: the pipeline worker encodes chunk N+1 while this
+        thread dispatches chunk N (core/ingest.py IngestPipeline)."""
+        from .ingest import (PackedEncoder, pipeline_chunk_cap,
+                             pipeline_enabled)
+        from .runtime import BATCH_BUCKETS
         n = len(ts)
         packed_ok = all(getattr(r, "supports_packed", False)
                         for r in self.junction.receivers)
@@ -487,56 +497,177 @@ class InputHandler:
             pc = getattr(r, "preferred_ingest_cap", None)
             if pc:
                 max_cap = min(max_cap, pc)
-        slo = self.app.slo
+        if packed_ok and self._encoder is None:
+            self._encoder = PackedEncoder(self.junction.schema)
+        pipelined = packed_ok and pipeline_enabled()
+        if pipelined:
+            max_cap = pipeline_chunk_cap(n, max_cap)
+        if pipelined and n > max_cap:
+            self._dispatch_packed_pipelined(ts, cols, max_cap, mark)
+            return
         for start in range(0, n, max_cap):
             t = ts[start:start + max_cap]
             c = [col[start:start + max_cap] for col in cols]
-            last_ts = int(t[-1])
-            if mark:
-                self.junction.mark_ingest(len(t))
-            # sampled ingest->emit span per device chunk (obs/slo.py)
-            tok = slo.ingest_begin(self.stream_id) if slo is not None \
-                else None
-            try:
-                with maybe_span(self.app, "ingest", self.stream_id,
-                                rows=len(t)), self.app.barrier:
-                    # columnar fast path: fire only dues STRICTLY BEFORE
-                    # the chunk's span now — in-span window expiry happens
-                    # inside the chunk's own step at exact per-row points,
-                    # so firing intermediate timers first only adds
-                    # dispatches (the post-publish advance_to below
-                    # catches up the rest)
-                    self.app.on_ingest_span(int(t[0]), last_ts)
-                    if packed_ok:
-                        if self._encoder is None:
-                            self._encoder = PackedEncoder(
-                                self.junction.schema)
+            self._dispatch_chunk(t, c, packed_ok, mark)
+
+    def _dispatch_chunk(self, t, c, packed_ok: bool, mark: bool,
+                        chunk=None) -> None:
+        """Dispatch ONE bucketed chunk (serial and pipelined branches
+        share this body; the pipelined branch passes a pre-encoded
+        ``chunk``)."""
+        from .event import batch_from_columns
+        from .ingest import PackedChunk
+        from .runtime import bucket_capacity
+        last_ts = int(t[-1])
+        if mark:
+            self.junction.mark_ingest(len(t))
+        # sampled ingest->emit span per device chunk (obs/slo.py)
+        slo = self.app.slo
+        tok = slo.ingest_begin(self.stream_id) if slo is not None \
+            else None
+        try:
+            with maybe_span(self.app, "ingest", self.stream_id,
+                            rows=len(t)), self.app.barrier:
+                # columnar fast path: fire only dues STRICTLY BEFORE
+                # the chunk's span now — in-span window expiry happens
+                # inside the chunk's own step at exact per-row points,
+                # so firing intermediate timers first only adds
+                # dispatches (the post-publish advance_to below
+                # catches up the rest)
+                self.app.on_ingest_span(int(t[0]), last_ts)
+                if packed_ok:
+                    if chunk is None:
                         chunk = PackedChunk.build(
                             self._encoder, t, c, bucket_capacity(len(t)),
                             now=self.app.current_time())
-                        fanout_done = False
-                        for r in list(self.junction.receivers):
-                            if fanout is not None and fanout.covers(r):
-                                # fused fan-out: one program for every
-                                # grouped subscriber (plan/optimizer.py)
-                                if not fanout_done:
-                                    fanout_done = True
-                                    fanout.process_packed(chunk)
-                                continue
-                            r.process_packed(chunk)
-                    else:
-                        batch = batch_from_columns(
-                            self.junction.schema, t, c,
-                            capacity=bucket_capacity(len(t)))
-                        self.junction.publish_batch(batch, last_ts)
-                    if self.app._playback:
-                        # catch up timers the chunk's own steps did not
-                        # subsume (multi-boundary batch flushes, absent
-                        # deadlines past the span)
-                        self.app.scheduler.advance_to(last_ts)
-            finally:
-                if tok is not None:
-                    slo.ingest_end(tok)
+                    self._publish_packed(chunk)
+                else:
+                    batch = batch_from_columns(
+                        self.junction.schema, t, c,
+                        capacity=bucket_capacity(len(t)))
+                    self.junction.publish_batch(batch, last_ts)
+                if self.app._playback:
+                    # catch up timers the chunk's own steps did not
+                    # subsume (multi-boundary batch flushes, absent
+                    # deadlines past the span)
+                    self.app.scheduler.advance_to(last_ts)
+        finally:
+            if tok is not None:
+                slo.ingest_end(tok)
+
+    def _publish_packed(self, chunk) -> None:
+        fanout = self.junction.fanout
+        fanout_done = False
+        for r in list(self.junction.receivers):
+            if fanout is not None and fanout.covers(r):
+                # fused fan-out: one program for every grouped
+                # subscriber (plan/optimizer.py)
+                if not fanout_done:
+                    fanout_done = True
+                    fanout.process_packed(chunk)
+                continue
+            r.process_packed(chunk)
+
+    def _dispatch_packed_pipelined(self, ts, cols, max_cap: int,
+                                   mark: bool) -> None:
+        """Double-buffered columnar dispatch: the pipeline worker
+        encodes chunk N+1 (pure numpy — the heavy ufuncs release the
+        GIL) while this thread dispatches chunk N (H2D + compute via
+        JAX async dispatch). Playback ``now`` per chunk is precomputed
+        host-side to the exact value the serial path's on_ingest_span
+        would install, so both pipeline settings stay bit-identical
+        (tests/test_ingest_pipeline.py)."""
+        from .ingest import IngestPipeline, PackedChunk
+        from .runtime import bucket_capacity
+        app = self.app
+        n = len(ts)
+        slices = [(ts[s:s + max_cap], [col[s:s + max_cap]
+                                       for col in cols])
+                  for s in range(0, n, max_cap)]
+        if app._playback:
+            nows = []
+            cur = app._playback_time
+            reorder = bool(app._reorder)
+            for t, _ in slices:
+                last = int(t[-1])
+                cur = max(last, cur) if (reorder and cur is not None) \
+                    else last
+                nows.append(cur)
+        else:
+            nows = [None] * len(slices)
+        if self._pipeline is None:
+            self._pipeline = IngestPipeline(self.stream_id)
+        enc = self._encoder
+
+        def encode(i):
+            t, c = slices[i]
+            now = nows[i]
+            return PackedChunk.build(
+                enc, t, c, bucket_capacity(len(t)),
+                now=app.current_time() if now is None else now)
+
+        def dispatch(i, chunk):
+            t, c = slices[i]
+            self._dispatch_chunk(t, c, True, mark, chunk=chunk)
+
+        st = self._pipeline.stats
+        before = (st["wall_s"], st["overlap_s"])
+        with maybe_span(app, "ingest_pipeline", self.stream_id,
+                        chunks=len(slices), rows=n) as sp:
+            self._pipeline.run(len(slices), encode, dispatch)
+            # overlap attribution on the span itself: how much of this
+            # send's encode ran concurrently with H2D/compute
+            sp.set(wall_s=round(st["wall_s"] - before[0], 6),
+                   overlap_s=round(st["overlap_s"] - before[1], 6))
+
+    def _dispatch_device_batch(self, batch, first_ts: int,
+                               last_ts: int) -> None:
+        """Publish a device-resident EventBatch (reorder-ring release,
+        resilience/ordering.py) under the same clock/timer contract as
+        _dispatch_chunk — no host column transfer, no re-encode. The
+        caller holds the app barrier (ring flushes run inside the
+        ingest barrier section; the barrier is reentrant)."""
+        slo = self.app.slo
+        tok = slo.ingest_begin(self.stream_id) if slo is not None \
+            else None
+        try:
+            with maybe_span(self.app, "ingest", self.stream_id,
+                            rows=int(batch.capacity)), self.app.barrier:
+                self.app.on_ingest_span(int(first_ts), int(last_ts))
+                self.junction.publish_batch(batch, int(last_ts))
+                if self.app._playback:
+                    self.app.scheduler.advance_to(int(last_ts))
+        finally:
+            if tok is not None:
+                slo.ingest_end(tok)
+
+    def ingest_stats(self) -> Optional[dict]:
+        """Zero-copy + pipeline counters for ``statistics()['ingest']``
+        (core/runtime.py _collect_observability)."""
+        out: dict = {}
+        enc = self._encoder
+        if enc is not None and enc.stats["chunks"]:
+            out.update(enc.stats)
+        p = self._pipeline
+        if p is not None and p.stats["sends"]:
+            st = p.stats
+            busy = st["encode_s"] + st["dispatch_s"]
+            out["pipeline_sends"] = st["sends"]
+            out["pipeline_chunks"] = st["chunks"]
+            out["encode_s"] = round(st["encode_s"], 6)
+            out["dispatch_s"] = round(st["dispatch_s"], 6)
+            out["wall_s"] = round(st["wall_s"], 6)
+            out["overlap_s"] = round(st["overlap_s"], 6)
+            out["overlap_frac"] = round(st["overlap_s"] / busy, 4) \
+                if busy > 0 else 0.0
+        return out or None
+
+    def close(self) -> None:
+        """Join the ingest pipeline worker (runtime shutdown)."""
+        with self._ingest_lock:
+            if self._pipeline is not None:
+                self._pipeline.close()
+                self._pipeline = None
 
 
 class StreamCallback(Receiver):

@@ -34,6 +34,9 @@ class _NoopSpan:
     def __exit__(self, *exc):
         return False
 
+    def set(self, **args):
+        """Post-hoc arg attribution (no-op when tracing is off)."""
+
 
 NOOP_SPAN = _NoopSpan()
 
@@ -56,6 +59,12 @@ class _Span:
         self.tracer.record(self.name, self.cat, self.t0 // 1000, dur_us,
                            self.args)
         return False
+
+    def set(self, **args):
+        """Attach args measured INSIDE the span before it records —
+        e.g. the ingest pipeline's encode/dispatch overlap attribution
+        (core/stream.py), which only exists after the chunks drain."""
+        self.args = {**dict(self.args), **args}
 
 
 class ChunkTracer:

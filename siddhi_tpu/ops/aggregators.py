@@ -571,10 +571,14 @@ class SlidingMinMaxAgg(AggSpec):
         ltree = tree[slots]  # [B, 2W] per-row gather of the key's tree
 
         def rmq(a, b):
-            res = jnp.full((B,), self._ident(), dtype=self.dtype)
-            li = (a + W).astype(jnp.int32)
-            ri = (b + W).astype(jnp.int32)
-            for _ in range(_tree_levels(W) + 1):
+            # rolled as a fori_loop, NOT a Python loop: unrolling the
+            # log2(W)+1 levels of data-dependent gathers makes XLA:CPU's
+            # LLVM codegen blow up super-linearly (a single jit_chain
+            # with a few of these aggregators never finishes compiling);
+            # the rolled While compiles in seconds and runs the same
+            # per-level ops bit-identically.
+            def level(_, carry):
+                res, li, ri = carry
                 open_ = li < ri
                 take_l = open_ & ((li & 1) == 1)
                 vl = jnp.take_along_axis(
@@ -589,8 +593,12 @@ class SlidingMinMaxAgg(AggSpec):
                     axis=1)[:, 0]
                 res = jnp.where(take_r, lane.combine(res, vr), res)
                 ri = jnp.where(take_r, ri - 1, ri)
-                li = li >> 1
-                ri = ri >> 1
+                return res, li >> 1, ri >> 1
+
+            res, _, _ = jax.lax.fori_loop(
+                0, _tree_levels(W) + 1, level,
+                (jnp.full((B,), self._ident(), dtype=self.dtype),
+                 (a + W).astype(jnp.int32), (b + W).astype(jnp.int32)))
             return res
 
         res = lane.combine(rmq(a1, b1), rmq(a2, b2))

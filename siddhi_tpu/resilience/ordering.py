@@ -59,12 +59,25 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import re
 from typing import Optional, Sequence
 
 import numpy as np
 
 log = logging.getLogger("siddhi_tpu.resilience")
+
+INT64_MAX = np.iinfo(np.int64).max
+
+RING_MAX_CAPACITY = 65536
+
+
+def ring_enabled() -> bool:
+    """``SIDDHI_TPU_REORDER_RING=1`` opts watermarked columnar streams
+    into the device-resident reorder ring (sort + watermark-prefix
+    release as one jitted step) instead of the host lexsort flush."""
+    return os.environ.get("SIDDHI_TPU_REORDER_RING", "0").lower() in (
+        "1", "on", "true")
 
 LATE_POLICIES = ("DROP", "PROCESS", "STREAM", "STORE")
 
@@ -204,10 +217,21 @@ class ReorderBuffer:
         self._pend_cols: list[list[np.ndarray]] = []
         self._pend_rows: list = []
         self.depth = 0
+        # sorted-run tracking: True while the pending columnar segments
+        # form ONE globally ascending run (each appended chunk passed
+        # the cheap bit-equality sortedness check and started at or
+        # after the previous segment's tail) — the flush then releases
+        # a pure prefix slice with no lexsort and no gather
+        self._sorted_run = True
+        # device reorder ring (SIDDHI_TPU_REORDER_RING=1): activated on
+        # the first disordered columnar chunk, deactivated when drained
+        self._ring: Optional[DeviceReorderRing] = None
+        self._ring_wm: Optional[int] = None
         self.counters = {
             "late": 0, "late_dropped": 0, "late_processed": 0,
             "late_streamed": 0, "late_stored": 0,
             "duplicates": 0, "forced": 0, "released": 0,
+            "sorted_fast": 0, "ring_steps": 0,
         }
 
     # -- watermark -------------------------------------------------------
@@ -245,11 +269,29 @@ class ReorderBuffer:
                                                              mx)
             if self._lane == "rows":
                 self._pend_rows.extend(self._decode_rows(ts, cols))
+                self.depth += len(ts)
             else:
+                n = len(ts)
+                chunk_sorted = n < 2 or bool((ts[1:] >= ts[:-1]).all())
+                if self._ring is None and not self._pend_ts:
+                    self._sorted_run = chunk_sorted
+                else:
+                    self._sorted_run = bool(
+                        self._sorted_run and chunk_sorted
+                        and self._ring is None
+                        and int(ts[0]) >= int(self._pend_ts[-1][-1]))
                 self._lane = "cols"
-                self._pend_ts.append(ts)
-                self._pend_cols.append(cols)
-            self.depth += len(ts)
+                self.depth += n
+                if self._ring is not None or (
+                        not self._sorted_run and ring_enabled()
+                        and self.ring_eligible()):
+                    # device ring lane: sort + release on device; the
+                    # append itself performs the watermark release, so
+                    # the flush below is a no-op unless forced/final
+                    self._ring_ingest(ts, cols)
+                else:
+                    self._pend_ts.append(ts)
+                    self._pend_cols.append(cols)
         self._flush_and_advance()
 
     def ingest_rows(self, events) -> None:
@@ -266,11 +308,18 @@ class ReorderBuffer:
             if self._lane == "cols" and self.depth:
                 # lane coercion: decode pending columnar segments so one
                 # stable sort covers everything (mixed ingest is rare)
-                self._pend_rows = [
-                    e for t, cs in zip(self._pend_ts, self._pend_cols)
-                    for e in self._decode_rows(t, cs)]
+                if self._ring is not None:
+                    t_host, c_host = self._ring_host_cols()
+                    self._pend_rows = self._decode_rows(t_host, c_host)
+                    self._ring = None
+                    self._ring_wm = None
+                else:
+                    self._pend_rows = [
+                        e for t, cs in zip(self._pend_ts, self._pend_cols)
+                        for e in self._decode_rows(t, cs)]
                 self._pend_ts, self._pend_cols = [], []
             self._lane = "rows"
+            self._sorted_run = False
             self._pend_rows.extend(events)
             self.depth += len(events)
         self._flush_and_advance()
@@ -291,10 +340,14 @@ class ReorderBuffer:
         that many oldest events out ahead of the watermark (capacity
         overflow — counted as ``forced``, never silent). Returns the
         number of events released."""
+        if self._ring is not None:
+            return self._flush_ring(min_release, final)
         if self.depth == 0:
             return 0
         wm = self.watermark
         if self._lane == "cols":
+            if self._sorted_run and not (self._pend_rows):
+                return self._flush_cols_sorted(wm, min_release, final)
             return self._flush_cols(wm, min_release, final)
         return self._flush_rows(wm, min_release, final)
 
@@ -323,6 +376,79 @@ class ReorderBuffer:
             ts_all, np.ones(ts_all.shape[0], dtype=bool), xp=np)
         return order, sorted_ts
 
+    def _flush_cols_sorted(self, wm, min_release: int,
+                           final: bool) -> int:
+        """Sorted-prefix short-circuit (the common in-order-traffic
+        path): the pending segments already form one globally ascending
+        run — verified by cheap bit-equality comparisons at ingest — so
+        the stable sort is the identity and the watermark release is a
+        pure prefix of the segment list. No lexsort, no gather; slice
+        views except one concatenate when the release spans segments.
+        Bit-equal to _flush_cols by construction (for a sorted run,
+        sorted_key_view's order is arange)."""
+        total = self.depth
+        if final:
+            cut = total
+        else:
+            cut = 0
+            if wm is not None:
+                for seg in self._pend_ts:
+                    if int(seg[0]) > wm:
+                        break
+                    if int(seg[-1]) <= wm:
+                        cut += len(seg)
+                    else:
+                        cut += int(np.searchsorted(seg, wm,
+                                                   side="right"))
+                        break
+            if min_release > cut:
+                self.counters["forced"] += min_release - cut
+                log.warning(
+                    "stream '%s': reorder buffer over capacity (%d); "
+                    "force-releasing %d event(s) ahead of the watermark",
+                    self.stream_id, self.conf.cap, min_release - cut)
+                cut = min(min_release, total)
+        if cut == 0:
+            return 0
+        rel_t, rel_c, new_t, new_c = [], [], [], []
+        k = cut
+        for seg, cs in zip(self._pend_ts, self._pend_cols):
+            if k <= 0:
+                new_t.append(seg)
+                new_c.append(cs)
+            elif k >= len(seg):
+                rel_t.append(seg)
+                rel_c.append(cs)
+                k -= len(seg)
+            else:
+                rel_t.append(seg[:k])
+                rel_c.append([c[:k] for c in cs])
+                new_t.append(seg[k:])
+                new_c.append([c[k:] for c in cs])
+                k = 0
+        if len(rel_t) == 1:
+            rel_ts, rel_cols = rel_t[0], list(rel_c[0])
+        else:
+            rel_ts = np.concatenate(rel_t)
+            rel_cols = [np.concatenate([p[j] for p in rel_c])
+                        for j in range(len(rel_c[0]))]
+        if self.conf.dedup and cut > 1:
+            keep = _dedup_keep_mask(rel_ts, rel_cols)
+            ndup = int(cut - keep.sum())
+            if ndup:
+                self.counters["duplicates"] += ndup
+                rel_ts = rel_ts[keep]
+                rel_cols = [c[keep] for c in rel_cols]
+        self._pend_ts, self._pend_cols = new_t, new_c
+        if not new_t:
+            self._lane = None
+            self._sorted_run = True
+        self.depth -= cut
+        self.counters["released"] += int(rel_ts.shape[0])
+        self.counters["sorted_fast"] += 1
+        self._emit_cols(rel_ts, rel_cols, wm)
+        return cut
+
     def _flush_cols(self, wm, min_release: int, final: bool) -> int:
         ts_all = self._pend_ts[0] if len(self._pend_ts) == 1 \
             else np.concatenate(self._pend_ts)
@@ -332,7 +458,7 @@ class ReorderBuffer:
             return 0
         cols_all = [seg[0] if len(self._pend_cols) == 1
                     else np.concatenate(seg)
-                    for seg in zip(*self._pend_cols)]
+                    for seg in zip(*self._pend_cols)]  # lint: disable=per-row-encode-hazard (per-COLUMN segment transpose: #cols iterations, not #rows)
         rel_idx = order[:cut]
         rel_ts = ts_all[rel_idx]
         rel_cols = [c[rel_idx] for c in cols_all]
@@ -350,6 +476,7 @@ class ReorderBuffer:
         else:
             self._pend_ts, self._pend_cols = [], []
             self._lane = None
+            self._sorted_run = True  # drained: restart run tracking
         self.depth -= cut
         self.counters["released"] += int(rel_ts.shape[0])
         self._emit_cols(rel_ts, rel_cols, wm)
@@ -378,10 +505,152 @@ class ReorderBuffer:
         self._pend_rows = [rows[i] for i in np.sort(order[cut:])]
         if not self._pend_rows:
             self._lane = None
+            self._sorted_run = True  # drained: restart run tracking
         self.depth -= cut
         self.counters["released"] += len(rel)
         self._emit_rows(rel, wm)
         return cut
+
+    # -- device reorder ring ---------------------------------------------
+    def ring_capacity(self) -> int:
+        """Compiled ring capacity: the buffer cap rounded to a batch
+        bucket (the ring step's static shape)."""
+        from ..core.runtime import bucket_capacity
+        return bucket_capacity(max(8, int(self.conf.cap)))
+
+    def ring_eligible(self) -> bool:
+        """Device-ring preconditions: packable primitive columns, no
+        dedup (host-only policy), and a cap small enough to compile a
+        2x-capacity sort program."""
+        from ..core.types import AttrType
+        if self.conf.dedup:
+            return False
+        ok = (AttrType.INT, AttrType.LONG, AttrType.FLOAT,
+              AttrType.DOUBLE, AttrType.BOOL, AttrType.STRING)
+        if not all(t in ok for t in self.schema.types):
+            return False
+        return self.ring_capacity() <= RING_MAX_CAPACITY
+
+    def _ring_ingest(self, ts, cols) -> None:
+        """Append a columnar chunk through the device ring: each
+        C-sized slice runs one jitted step that sorts (ring + slice),
+        releases the watermark prefix as a device EventBatch and
+        compacts the retained rows back in arrival order. The caller
+        already counted the rows into ``depth``."""
+        if self._ring is None:
+            self._ring = DeviceReorderRing(self.schema,
+                                           self.ring_capacity())
+            self._ring_wm = None
+            # absorb pending host segments first (arrival order)
+            pend = list(zip(self._pend_ts, self._pend_cols))
+            self._pend_ts, self._pend_cols = [], []
+            for t, cs in pend:
+                self._ring_append(t, cs)
+        self._ring_append(ts, cols)
+
+    def _ring_append(self, ts, cols) -> None:
+        ring = self._ring
+        from ..core.types import np_dtype
+        cols = [c if c.dtype == np_dtype(t) else c.astype(np_dtype(t))
+                for t, c in zip(self.schema.types, cols)]
+        C = ring.C
+        cap = min(int(self.conf.cap), C)
+        for s in range(0, len(ts), C):
+            t = ts[s:s + C]
+            cs = [c[s:s + C] for c in cols]
+            over = ring.count + len(t) - cap
+            self._ring_step(t, cs, min_release=max(0, over),
+                            final=False)
+
+    def _ring_step(self, ts, cols, min_release: int,
+                   final: bool) -> int:
+        """Run one device ring step; returns rows released. The only
+        host<->device sync is a 4-scalar (cut, wm_cut, first, last)
+        fetch — watermark math, forced-overflow accounting and late
+        policy all stay host-side."""
+        import jax
+        ring = self._ring
+        C = ring.C
+        step = ring_step_for(self.schema.types, C)
+        if ring.state is None:
+            ring.state = ring.zero_state()
+        k = 0 if ts is None else len(ts)
+        in_ts = np.zeros((C,), np.int64)
+        in_cols = [np.zeros((C,), dt) for dt in ring.np_dtypes]
+        if k:
+            in_ts[:k] = ts
+            for b, c in zip(in_cols, cols):
+                b[:k] = c
+        wm = self.watermark
+        wm_v = np.int64(-(2 ** 62)) if wm is None else np.int64(wm)
+        sts, scols = ring.state
+        new_state, batch, meta = step(
+            sts, scols, jax.device_put(in_ts),
+            tuple(jax.device_put(c) for c in in_cols),
+            np.int32(ring.count), np.int32(k), wm_v,
+            np.int32(max(0, min_release)), np.bool_(bool(final)))
+        ring.state = new_state
+        self.counters["ring_steps"] += 1
+        cut, wm_cut, first, last = (int(x)
+                                    for x in jax.device_get(meta))
+        self._ring_wm = wm
+        if min_release > wm_cut and not final:
+            self.counters["forced"] += min_release - wm_cut
+            log.warning(
+                "stream '%s': reorder buffer over capacity (%d); "
+                "force-releasing %d event(s) ahead of the watermark",
+                self.stream_id, self.conf.cap, min_release - wm_cut)
+        ring.count = ring.count + k - cut
+        self.depth -= cut
+        if cut:
+            self.counters["released"] += cut
+            self._emit_ring(batch, first, last, cut, wm)
+        return cut
+
+    def _flush_ring(self, min_release: int, final: bool) -> int:
+        ring = self._ring
+        if ring.count == 0:
+            released = 0
+        elif final or min_release > 0 or \
+                self.watermark != self._ring_wm:
+            released = self._ring_step(None, None,
+                                       min_release=min_release,
+                                       final=final)
+        else:
+            # the appends already released to the current watermark
+            released = 0
+        if ring.count == 0 and (final or self.depth == 0):
+            # drained: drop back to the host lane (in-order traffic
+            # resumes the sorted-prefix fast path; the ring's jit cache
+            # stays warm for the next disorder burst)
+            self._ring = None
+            self._ring_wm = None
+            self._lane = None
+            self._sorted_run = True
+        return released
+
+    def _ring_host_cols(self):
+        """Device ring state -> host (ts, cols) in arrival order
+        (snapshots and rows-lane coercion)."""
+        import jax
+        ring = self._ring
+        if ring is None or ring.count == 0 or ring.state is None:
+            return (np.zeros((0,), np.int64),
+                    [np.zeros((0,), dt) for dt in
+                     (ring.np_dtypes if ring else [])])
+        sts, scols = jax.device_get(ring.state)
+        k = ring.count
+        return (np.asarray(sts[:k]),
+                [np.asarray(c[:k]) for c in scols])
+
+    def _emit_ring(self, batch, first_ts: int, last_ts: int, cut: int,
+                   wm) -> None:
+        from ..obs.tracing import maybe_span
+        with maybe_span(self.handler.app, "reorder", self.stream_id,
+                        watermark=-1 if wm is None else int(wm),
+                        released=cut, depth=self.depth, ring=1):
+            self.handler._dispatch_device_batch(batch, first_ts,
+                                                last_ts)
 
     def _emit_cols(self, ts, cols, wm) -> None:
         from ..obs.tracing import maybe_span
@@ -461,12 +730,20 @@ class ReorderBuffer:
     # -- checkpoint ------------------------------------------------------
     def snapshot_state(self) -> dict:
         """Pure-data snapshot (numpy + tuples only — the restricted
-        snapshot unpickler admits nothing else)."""
+        snapshot unpickler admits nothing else). Device ring state
+        lands as one extra host columnar segment in arrival order, so
+        ring and host snapshots restore interchangeably."""
+        cols_segs = [(t, list(cs)) for t, cs in
+                     zip(self._pend_ts, self._pend_cols)]
+        lane = self._lane
+        if self._ring is not None and self._ring.count:
+            t_host, c_host = self._ring_host_cols()
+            cols_segs.append((t_host, list(c_host)))
+            lane = "cols"
         return {
-            "lane": self._lane,
+            "lane": lane,
             "max_ts": self.max_ts,
-            "cols": [(t, list(cs)) for t, cs in
-                     zip(self._pend_ts, self._pend_cols)],
+            "cols": cols_segs,
             "rows": [(e.timestamp, tuple(e.data), e.is_expired)
                      for e in self._pend_rows],
             "counters": dict(self.counters),
@@ -485,3 +762,114 @@ class ReorderBuffer:
         self.depth = sum(len(t) for t in self._pend_ts) + \
             len(self._pend_rows)
         self.counters.update(snap.get("counters", {}))
+        self._ring = None
+        self._ring_wm = None
+        # re-derive the sorted-run flag honestly from the restored
+        # segments (cheap one-pass bit-equality check)
+        run = self._lane != "rows"
+        prev = None
+        for seg in self._pend_ts:
+            if not len(seg):
+                continue
+            if (prev is not None and int(seg[0]) < prev) or \
+                    not bool((seg[1:] >= seg[:-1]).all()):
+                run = False
+                break
+            prev = int(seg[-1])
+        self._sorted_run = run
+
+
+class DeviceReorderRing:
+    """Per-stream device-resident ring state: ``ts``/column arrays of
+    one static bucket capacity C plus a host-tracked live count. Rows
+    [0:count] are live, compacted in arrival order (the jitted step
+    maintains that invariant), so snapshotting is a plain device_get
+    slice."""
+
+    def __init__(self, schema, C: int):
+        from ..core.types import np_dtype
+        self.schema = schema
+        self.C = int(C)
+        self.np_dtypes = [np_dtype(t) for t in schema.types]
+        self.count = 0
+        self.state = None  # (ts, cols) device tuple, lazily zeroed
+
+    def zero_state(self):
+        # jnp.zeros, NOT device_put(np.zeros(...)): on CPU device_put may
+        # zero-copy alias the numpy buffer, and the ring step donates the
+        # state — donating an aliased buffer double-frees it.
+        import jax.numpy as jnp
+        ts = jnp.zeros((self.C,), jnp.int64)
+        cols = tuple(jnp.zeros((self.C,), dt) for dt in self.np_dtypes)
+        return (ts, cols)
+
+
+_RING_STEPS: dict = {}
+
+
+def ring_step_for(types, C: int):
+    """Cached jitted ring step for (schema types, ring capacity)."""
+    key = (tuple(types), int(C))
+    fn = _RING_STEPS.get(key)
+    if fn is None:
+        fn = _build_ring_step(tuple(types), int(C))
+        _RING_STEPS[key] = fn
+    return fn
+
+
+def _build_ring_step(types, C: int):
+    """One jitted step = sort (ring + incoming slice) + watermark-
+    prefix release + arrival-order compaction of the retained rows.
+
+    The sort reproduces the exact ops/table.py sorted_key_view
+    contract (stable timestamp sort, arrival-position tiebreak, pads
+    keyed to INT64_MAX and pushed last), so ring releases are
+    bit-identical to the host lexsort flush. Ring state is donated —
+    the new state aliases the old buffers like any operator state."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.event import EventBatch
+
+    R = 2 * C
+
+    def step(sts, scols, in_ts, in_cols, count, n_in, wm, min_rel,
+             final):
+        rows = jnp.arange(R, dtype=jnp.int32)
+        live = jnp.concatenate([
+            jnp.arange(C, dtype=jnp.int32) < count,
+            jnp.arange(C, dtype=jnp.int32) < n_in])
+        ts_all = jnp.concatenate([sts, in_ts])
+        keyed = jnp.where(live, ts_all, jnp.int64(INT64_MAX))
+        order = jnp.lexsort((rows, keyed, (~live).astype(jnp.int8)))
+        sorted_ts = keyed[order]
+        n_live = (count + n_in).astype(jnp.int32)
+        wm_cut = jnp.minimum(
+            jnp.searchsorted(sorted_ts, wm, side="right").astype(
+                jnp.int32), n_live)
+        cut = jnp.maximum(wm_cut, jnp.minimum(min_rel, n_live))
+        cut = jnp.where(final, n_live, cut).astype(jnp.int32)
+        cols_all = [jnp.concatenate([s, c])
+                    for s, c in zip(scols, in_cols)]
+        rel_valid = rows < cut
+        rel_ts_raw = ts_all[order]
+        first = jnp.where(cut > 0, rel_ts_raw[0], jnp.int64(0))
+        last = jnp.where(cut > 0,
+                         rel_ts_raw[jnp.maximum(cut - 1, 0)],
+                         jnp.int64(0))
+        batch = EventBatch(
+            ts=jnp.where(rel_valid, rel_ts_raw, first),
+            cols=tuple(c[order] for c in cols_all),
+            nulls=tuple(jnp.zeros((R,), jnp.bool_) for _ in cols_all),
+            kind=jnp.zeros((R,), jnp.int32),
+            valid=rel_valid,
+        )
+        # retained rows, compacted back to arrival order (stable sort
+        # on the keep flag; arange tiebreak preserves arrival rank)
+        rank = jnp.zeros((R,), jnp.int32).at[order].set(rows)
+        keep = live & (rank >= cut)
+        perm = jnp.lexsort((rows, (~keep).astype(jnp.int8)))
+        new_ts = ts_all[perm][:C]
+        new_cols = tuple(c[perm][:C] for c in cols_all)
+        return (new_ts, new_cols), batch, (cut, wm_cut, first, last)
+
+    return jax.jit(step, donate_argnums=(0, 1))

@@ -1,0 +1,38 @@
+"""Seeded antipattern: per-row Python loops over event columns on
+ingest-path functions (per-row-encode-hazard)."""
+import numpy as np
+
+
+def send_rows(ts, cols):
+    out = []
+    for t, vals in zip(ts.tolist(), zip(*cols)):   # line 8: row transpose
+        out.append((t, tuple(vals)))
+    return out
+
+
+def _encode_chunk(cols):
+    return [tuple(row) for row in zip(*cols)]      # line 14: zip(*cols)
+
+
+def ingest_scalars(ts):
+    total = 0
+    for t in ts.tolist():                          # line 19: .tolist() iter
+        total += t
+    return total
+
+
+def _decode_rows(ts, cols):
+    # row API, NOT the encode hot path: the ingest-verb name gate keeps
+    # decode helpers out of scope
+    return [(t, vals) for t, vals in zip(ts.tolist(), zip(*cols))]
+
+
+def send_arrays(ts, cols):
+    # per-COLUMN iteration is the blessed columnar shape — stays clean
+    return [np.ascontiguousarray(c) for c in cols]
+
+
+def dispatch_chunks(chunks):
+    # chunk-granular loops are fine; only row-materializing sources flag
+    for ts, cols in chunks:
+        send_arrays(ts, cols)

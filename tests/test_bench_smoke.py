@@ -289,6 +289,53 @@ def test_bench_fanout_quick_parses():
     assert d["stage_breakdown"]["steps"][0]["step"] == "fanout/S"
 
 
+def test_bench_ingest_quick_parses():
+    """The pipelined-ingest arm: the JSON line must carry the
+    `ingest_overlap` block — encode vs dispatch wall time, overlap
+    fraction, pipeline-vs-serial events/s — and the zero-copy counters
+    must show no defensive copies on conformant columns."""
+    d = _run_config("ingest")
+    assert d["unit"] == "events/s"
+    assert d["value"] > 0 and d["events"] > 0
+    ov = d["ingest_overlap"]
+    assert ov["chunks_per_send"] >= 2, \
+        "smoke must split into multiple pipeline chunks"
+    for k in ("encode_s", "dispatch_s", "wall_s", "overlap_s"):
+        assert isinstance(ov[k], (int, float)) and ov[k] >= 0, (k, ov)
+    assert 0.0 <= ov["overlap_frac"] <= 1.0
+    assert ov["eps_pipeline"] > 0 and ov["eps_serial"] > 0
+    # conformant numpy columns must encode with ZERO coercion copies
+    assert ov["zero_copy"]["coerced_arrays"] == 0, ov
+    assert ov["serial_zero_copy"]["coerced_arrays"] == 0, ov
+    assert ov["zero_copy"]["view_lanes"] > 0, ov
+
+
+def test_bench_diff_gates_overlap_drop(tmp_path):
+    """Losing the encode/device overlap (ingest_overlap.overlap_frac
+    dropping > 0.25 absolute) fails the bench_diff gate even when
+    events/s held — the pipeline silently degrading to serial is a
+    regression the throughput number can hide on small runs."""
+    if str(TOOLS) not in sys.path:
+        sys.path.insert(0, str(TOOLS))
+    import bench_diff
+    base = {"config": "ingest", "value": 1000.0, "unit": "events/s",
+            "ingest_overlap": {"overlap_frac": 0.6}}
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps(base) + "\n")
+    assert bench_diff.main([str(a), str(a)]) == 0
+    dropped = copy.deepcopy(base)
+    dropped["ingest_overlap"]["overlap_frac"] = 0.1
+    b = tmp_path / "b.json"
+    b.write_text(json.dumps(dropped) + "\n")
+    assert bench_diff.main([str(a), str(b)]) == 1
+    # small jitter stays under the 0.25 absolute band: clean
+    jitter = copy.deepcopy(base)
+    jitter["ingest_overlap"]["overlap_frac"] = 0.45
+    c = tmp_path / "c.json"
+    c.write_text(json.dumps(jitter) + "\n")
+    assert bench_diff.main([str(a), str(c)]) == 0
+
+
 def test_bench_diff_gate_on_optimizer_flip(tmp_path):
     """An OPTIMIZER decision flip (SIDDHI_TPU_OPT=0 plan vs the
     measured optimized plan) is a plan change: tools/bench_diff.py

@@ -111,3 +111,73 @@ def test_bool_bitpack_roundtrip():
                             [vals], 64)
     assert e[1] == "b1"
     assert (np.asarray(batch.cols[0])[:64] == vals).all()
+
+
+# ---------------------------------------------------------------------------
+# zero-copy encode contract (pipelined ingest)
+# ---------------------------------------------------------------------------
+
+def test_conformant_columns_encode_with_zero_coercion_copies():
+    """Already-conformant numpy columns (right dtype, C-contiguous) must
+    flow into the packed buffer without a defensive np.asarray copy —
+    the `coerced_arrays` counter is the regression guard."""
+    schema = StreamSchema("S", (
+        Attribute("f", AttrType.FLOAT), Attribute("d", AttrType.DOUBLE),
+        Attribute("l", AttrType.LONG)))
+    enc = PackedEncoder(schema)
+    n = 64
+    ts = np.arange(n, dtype=np.int64)
+    rng = np.random.default_rng(1)
+    cols = [np.linspace(0, 1, n, dtype=np.float32),
+            np.linspace(0, 1, n, dtype=np.float64),
+            rng.integers(-2 ** 62, 2 ** 62, n, dtype=np.int64)]  # raw64
+    enc.encode(ts, cols, n, now=1)
+    assert enc.stats["coerced_arrays"] == 0, enc.stats
+    # float/double/raw64 lanes bitcast straight into the buffer
+    assert enc.stats["view_lanes"] >= 3, enc.stats
+
+
+def test_nonconformant_columns_are_counted_copies():
+    """Wrong-dtype or non-contiguous input still encodes correctly but
+    pays (and COUNTS) a coercion copy per offending array."""
+    schema = StreamSchema("S", (Attribute("f", AttrType.FLOAT),))
+    enc = PackedEncoder(schema)
+    n = 16
+    ts = np.arange(n, dtype=np.int64)
+    f64 = np.linspace(0, 1, n)                      # float64 for a FLOAT col
+    batch, _, e = roundtrip(schema, enc, ts, [f64], n)
+    assert enc.stats["coerced_arrays"] >= 1, enc.stats
+    assert np.allclose(np.asarray(batch.cols[0])[:n],
+                       f64.astype(np.float32))
+    enc2 = PackedEncoder(schema)
+    strided = np.zeros((n, 2), np.float32)[:, 0]    # non-contiguous view
+    enc2.encode(ts, [strided], n, now=1)
+    assert enc2.stats["coerced_arrays"] >= 1, enc2.stats
+
+
+def test_dispatch_arrays_zero_copy_for_conformant_numpy(monkeypatch):
+    """End-to-end regression: send_arrays with conformant columns must
+    not re-coerce them through np.asarray+copy — counted allocations on
+    the encoder stay ZERO across a multi-chunk send (the pre-PR path
+    copied every column of every chunk)."""
+    from siddhi_tpu import SiddhiManager
+
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:playback
+        define stream S (v long, p double);
+        @info(name = 'q') from S[p >= 0.0] select v, p insert into Out;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    n = 4096
+    for i in range(4):
+        ts = 1_000_000 + (i * n + np.arange(n, dtype=np.int64))
+        v = np.random.default_rng(i).integers(
+            -2 ** 62, 2 ** 62, n, dtype=np.int64)       # raw64 lane
+        p = np.linspace(0, 1, n, dtype=np.float64)      # f64 lane
+        h.send_arrays(ts, [v, p])
+    st = h.ingest_stats()
+    rt.shutdown()
+    assert st["coerced_arrays"] == 0, st
+    assert st["view_lanes"] > 0, st

@@ -144,6 +144,31 @@ def test_float64_literal_fires_for_dtype_kw_call_and_string():
     assert all(f.line < 17 for f in fs)
 
 
+def test_per_row_encode_hazard_fires_on_row_materializing_sources():
+    """Ingest-path loops whose iteration source materializes rows from
+    columns (zip(*cols) transpose, arr.tolist()) fire; per-column and
+    chunk-granular loops stay clean, and decode helpers are out of
+    scope via the ingest-verb name gate."""
+    fs = findings_for("bad_row_encode.py")
+    assert lines_of(fs, "per-row-encode-hazard") == [8, 14, 19]
+    f = [x for x in fs if x.rule == "per-row-encode-hazard"][0]
+    assert f.severity == "warning"
+    assert "columnar" in f.message
+    # _decode_rows / send_arrays / dispatch_chunks (>= line 24) are clean
+    assert all(x.line < 24 for x in fs)
+
+
+def test_per_row_encode_hazard_repo_ingest_paths_clean():
+    assert "per-row-encode-hazard" in rule_names()
+    # the packed encoder and dispatch paths must stay columnar
+    import pathlib
+    pkg = pathlib.Path(__file__).parents[1] / "siddhi_tpu"
+    for rel in ("core/ingest.py", "core/stream.py",
+                "resilience/ordering.py"):
+        fs = lint_file(str(pkg / rel), rel_path=f"siddhi_tpu/{rel}")
+        assert [x for x in fs if x.rule == "per-row-encode-hazard"] == [], rel
+
+
 def test_clean_fixture_has_zero_findings():
     assert findings_for("clean_module.py") == []
 

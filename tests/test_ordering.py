@@ -547,6 +547,200 @@ def test_flush_path_steady_state_zero_recompiles(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# sorted-prefix fast path: in-order traffic must skip the lexsort
+# ---------------------------------------------------------------------------
+
+def test_sorted_fast_path_counter_fires_on_in_order_traffic():
+    """Strictly in-order chunks flush through the sorted-prefix
+    short-circuit (no lexsort, no gather) — the `sorted_fast` counter
+    proves the fast path actually ran, and the released sequence is
+    already covered bit-equal by test_in_order_input_bit_equal."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(WINDOW_APP)
+    got = _collect(rt, "Out")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for ts, cols in _mk_chunks(7, 256, 64):
+        h.send_arrays(ts, cols)
+    buf = rt._reorder["S"]
+    assert buf.counters["sorted_fast"] > 0
+    rt.shutdown()
+    assert len(got) > 0
+
+
+def test_sorted_fast_path_mixed_traffic_stays_bit_equal():
+    """A disordered chunk in the middle of in-order traffic degrades to
+    the lexsort path and recovers afterwards — the mixed run must stay
+    bit-equal to the fully ordered run, with BOTH paths exercised."""
+    def run(shuffle_mid):
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(WINDOW_APP)
+        got = _collect(rt, "Out")
+        rt.start()
+        h = rt.get_input_handler("S")
+        rng = np.random.default_rng(3)
+        fast = 0
+        for i, (ts, cols) in enumerate(_mk_chunks(9, 384, 64)):
+            if shuffle_mid and i == 2:
+                ts, cols = _shuffle_within(ts, cols, rng, 48)
+            h.send_arrays(ts, cols)
+        fast = rt._reorder["S"].counters["sorted_fast"]
+        rt.shutdown()
+        return got, fast
+
+    ordered, fast_all = run(False)
+    mixed, fast_mixed = run(True)
+    assert len(ordered) > 0
+    assert mixed == ordered
+    assert fast_all > fast_mixed > 0    # both paths ran in the mixed run
+
+
+# ---------------------------------------------------------------------------
+# device-resident reorder ring (opt-in: SIDDHI_TPU_REORDER_RING=1)
+# ---------------------------------------------------------------------------
+
+RING_APPS = [ql.replace("@app:watermark(lateness='64')",
+                        "@app:watermark(lateness='64', cap='64')")
+             for ql in (WINDOW_APP, LENGTH_BATCH_APP)]
+
+
+@pytest.mark.parametrize("ql", RING_APPS, ids=["time-window",
+                                               "length-batch"])
+def test_ring_disorder_bit_equal_to_host_buffer(ql, monkeypatch):
+    """Disordered chunks through the device ring release the SAME
+    event sequence as the host columnar buffer — sort + watermark cut
+    happen on device, late policy and counters stay host-side."""
+    def run(ring):
+        monkeypatch.setenv("SIDDHI_TPU_REORDER_RING",
+                           "1" if ring else "0")
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(ql)
+        got = _collect(rt, "Out")
+        rt.start()
+        h = rt.get_input_handler("S")
+        rng = np.random.default_rng(17)
+        for ts, cols in _mk_chunks(13, 256, 64):
+            ts, cols = _shuffle_within(ts, cols, rng, 48)
+            h.send_arrays(ts, cols)
+        steps = rt._reorder["S"].counters["ring_steps"]
+        rt.shutdown()
+        return got, steps
+
+    host, steps_off = run(False)
+    ring, steps_on = run(True)
+    assert len(host) > 0
+    assert ring == host
+    assert steps_off == 0 and steps_on > 0
+
+
+def test_ring_snapshot_restore_keeps_buffered_events(monkeypatch):
+    """Ring state snapshots like operator state: the device rows land
+    in the snapshot as one host columnar segment (arrival order), and a
+    restored runtime releases them exactly once, sorted."""
+    monkeypatch.setenv("SIDDHI_TPU_REORDER_RING", "1")
+    ql = """
+        @app:watermark(lateness='100000', cap='32')
+        define stream S (v int);
+        @info(name = 'q') from S select v insert into Out;
+    """
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(5)
+    order = rng.permutation(24)
+    ts = (TS0 + np.arange(24, dtype=np.int64))[order]
+    h.send_arrays(ts, [np.arange(24, dtype=np.int32)[order]])
+    buf = rt._reorder["S"]
+    assert buf._ring is not None                # disorder engaged the ring
+    assert buf.depth == 24
+    snap = rt.snapshot()
+    rt.shutdown()
+
+    rt2 = mgr.create_siddhi_app_runtime(ql)
+    got = _collect(rt2, "Out")
+    rt2.start()
+    rt2.restore(snap)
+    assert rt2._reorder["S"].depth == 24
+    rt2.shutdown()                              # final flush releases all
+    assert sorted(g[1][0] for g in got) == list(range(24))
+    assert [g[0] for g in got] == sorted(g[0] for g in got)
+
+
+def test_ring_forced_overflow_counted_never_silent(monkeypatch):
+    """Capacity pressure on the ring force-releases the sorted prefix
+    with the same accounting as the host buffer: counted, logged,
+    nothing lost."""
+    monkeypatch.setenv("SIDDHI_TPU_REORDER_RING", "1")
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime("""
+        @app:watermark(lateness='100000', cap='32')
+        define stream S (v int);
+        @info(name = 'q') from S select v insert into Out;
+    """)
+    got = _collect(rt, "Out")
+    rt.start()
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(9)
+    order = rng.permutation(96)
+    ts = (TS0 + np.arange(96, dtype=np.int64))[order]
+    h.send_arrays(ts, [np.arange(96, dtype=np.int32)[order]])
+    buf = rt._reorder["S"]
+    assert buf._ring is not None
+    assert buf.depth == 32                      # capped
+    assert buf.counters["forced"] == 64         # counted, not silent
+    assert len(got) == 64                       # sorted prefix released
+    rt.shutdown()
+    assert len(got) == 96                       # nothing lost
+    assert sorted(g[1][0] for g in got) == list(range(96))
+
+
+def test_ring_specs_enumerated_audit_clean_zero_recompiles(monkeypatch):
+    """The ring step joins the AOT spec enumeration and the compiled-
+    program audit (core/compile.py, analysis/programs.py), and steady-
+    state ring traffic triggers ZERO new traces after warmup."""
+    import functools
+
+    import jax
+
+    from siddhi_tpu.analysis.programs import audit_runtime
+
+    monkeypatch.setenv("SIDDHI_TPU_REORDER_RING", "1")
+    real_jit = jax.jit
+    traces = [0]
+
+    def counting_jit(f, *a, **kw):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            traces[0] += 1
+            return f(*args, **kwargs)
+        return real_jit(wrapped, *a, **kw)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(RING_APPS[0])
+    got = _collect(rt, "Out")
+    rt.start()
+    keys = [s.key for s in rt.compile_service.specs((64,))]
+    assert any(k.startswith("ring:S/") for k in keys), keys
+    rep = audit_runtime(rt, buckets=(64,))
+    assert rep.summary()["findings"] == 0
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(21)
+    before = None
+    for i, (ts, cols) in enumerate(_mk_chunks(29, 512, 64)):
+        if i == 4:      # ring engaged + release-cut buckets settled
+            before = traces[0]
+        ts, cols = _shuffle_within(ts, cols, rng, 48)
+        h.send_arrays(ts, cols)
+    assert rt._reorder["S"].counters["ring_steps"] > 0
+    assert traces[0] == before, \
+        f"steady-state ring traffic triggered {traces[0] - before} traces"
+    rt.shutdown()
+    assert len(got) > 0
+
+
+# ---------------------------------------------------------------------------
 # ReorderBuffer unit behavior (sorted_key_view reuse on numpy)
 # ---------------------------------------------------------------------------
 

@@ -16,6 +16,10 @@ Gate (exit 1):
 
 - events/s regression beyond ``--threshold`` percent (default 15) on
   any config whose ``value`` is comparable on both sides;
+- ``ingest_overlap.overlap_frac`` dropping more than 0.25 absolute on
+  configs that report it (the ingest config): the double-buffered
+  pipeline silently degrading to serial is a regression throughput
+  numbers can hide on small runs;
 - any ``plan.plan_hash`` change, unless ``--allow-plan-change`` — a
   faster number measured against a DIFFERENT plan is not a comparison,
   it is a confound (the plan block exists so BENCH artifacts record
@@ -69,6 +73,14 @@ def _plan_hash(entry: dict):
     return None
 
 
+def _overlap_frac(entry: dict):
+    ov = entry.get("ingest_overlap")
+    if isinstance(ov, dict):
+        v = ov.get("overlap_frac")
+        return v if isinstance(v, (int, float)) else None
+    return None
+
+
 def _num(entry: dict, key: str):
     v = entry.get(key)
     return v if isinstance(v, (int, float)) else None
@@ -99,6 +111,15 @@ def diff_configs(a: dict, b: dict, threshold_pct: float,
                 regressions.append(name)
         row["p99_a"] = _num(ea, "p99_ms")
         row["p99_b"] = _num(eb, "p99_ms")
+        oa, ob = _overlap_frac(ea), _overlap_frac(eb)
+        if oa is not None and ob is not None:
+            row["overlap_a"], row["overlap_b"] = oa, ob
+            # the ingest config's encode/device overlap is an acceptance
+            # signal, not noise: losing more than 0.25 of the fraction
+            # means the double-buffered pipeline stopped overlapping
+            if ob < oa - 0.25:
+                row["flags"].append("overlap-drop")
+                regressions.append(name)
         ha, hb = _plan_hash(ea), _plan_hash(eb)
         row["plan_a"], row["plan_b"] = ha, hb
         if ha is not None and hb is not None and ha != hb:
