@@ -669,8 +669,10 @@ def _run_tenant_pool(n_tenants: int, rows: int, batch_max: int):
         pool.add_tenant(f"t{i}", _tenant_bindings(i))
     ts, cols = _tenant_data(rows)
     last = _Last()
+    # terminal maps sid -> LIST of device batches (multi-input queries
+    # can emit several per round); keep only the newest alive
     pool.batch_callbacks.append(
-        lambda terminal: last(next(iter(terminal.values()), None)
+        lambda terminal: last(next(iter(terminal.values()))[-1]
                               if terminal else None))
 
     def one_pass():
@@ -697,6 +699,9 @@ def _run_tenant_pool(n_tenants: int, rows: int, batch_max: int):
         "pool_warmups": comp["warmups"],
         "slots": stats["pool"]["slots"],
         "rounds": stats["pool"]["rounds"],
+        "packed_ingest": {k: stats["packed_ingest"][k] for k in
+                          ("transfers_per_round", "rows_packed",
+                           "pad_frac")},
     }
 
 
@@ -913,6 +918,160 @@ def _run_tenant_rebalance(skew: int = 8, starved_rows: int = 64):
     }
 
 
+# operator-class pool arms (docs/serving.md "Poolable operator
+# classes"): the SAME pooled-vs-separate comparison for a pattern
+# (NFA) template and a two-stream equi-join template. These carry NO
+# ${} placeholders — the template-binding rule makes every expression
+# position in a join/pattern query structural (only plain
+# single-stream queries can hold per-tenant parameters), so tenants
+# of these classes differ by per-slot STATE, not by parameters.
+POOL_PATTERN_TEMPLATE = """
+define stream S (k long, v double);
+@info(name='p')
+from every e1=S[v > 800.0] -> e2=S[k == e1.k and v < 100.0]
+within 10 sec
+select e1.k as k, e1.v as v1, e2.v as v2
+insert into Out;
+"""
+
+POOL_JOIN_TEMPLATE = """
+define stream L (k long, v double);
+define stream R (k long, w double);
+@info(name='j')
+from L#window.length(64) as a join R#window.length(64) as b
+  on a.k == b.k
+select a.k as k, a.v as v, b.w as w
+insert into Out;
+"""
+
+CLASS_TEMPLATES = {
+    "pattern_template": (POOL_PATTERN_TEMPLATE, ("S",)),
+    "join_template": (POOL_JOIN_TEMPLATE, ("L", "R")),
+}
+
+
+def _class_feeds(streams, rows: int, seed: int = 17):
+    """Per-stream (ts, cols) feeds for the class templates' (k long,
+    v double) schemas; later streams interleave at +j ms so join sides
+    merge deterministically."""
+    rng = np.random.default_rng(seed)
+    feeds = {}
+    for j, sid in enumerate(streams):
+        ts = TS0 + np.arange(rows, dtype=np.int64) * 4 + j
+        k = rng.integers(0, 32, rows, dtype=np.int64)
+        v = rng.uniform(0, 1000.0, rows)
+        feeds[sid] = (ts, [k, v])
+    return feeds
+
+
+def _run_class_pool(arm: str, n_tenants: int, rows: int,
+                    batch_max: int):
+    """Pooled arm for one operator class: ONE template, N tenants on
+    the vmapped slot axis, every backlogged ingest stream shipped as
+    ONE packed device_put per fair round (docs/performance.md "Packed
+    pool ingest")."""
+    from siddhi_tpu.serving import TemplateRegistry
+    tpl, streams = CLASS_TEMPLATES[arm]
+    reg = TemplateRegistry(SiddhiManager())
+    pool = reg.pool(tpl, warm=False, slots=n_tenants,
+                    max_tenants=n_tenants, batch_max=batch_max,
+                    name=arm)
+    wu = pool.warmup([batch_max])
+    for i in range(n_tenants):
+        pool.add_tenant(f"t{i}", {})
+    feeds = _class_feeds(streams, rows)
+    last = _Last()
+    pool.batch_callbacks.append(
+        lambda terminal: last(next(iter(terminal.values()))[-1]
+                              if terminal else None))
+
+    def one_pass():
+        for i in range(n_tenants):
+            for sid in streams:
+                ts, cols = feeds[sid]
+                pool.send(f"t{i}", ts, cols, stream=sid)
+        pool.flush()
+        last.drain()
+
+    one_pass()   # warm pass: dispatch caches + sticky encoders settle
+    dt = min(_timed(one_pass) for _ in range(REPS))
+    stats = pool.statistics()
+    packed = stats["packed_ingest"]
+    comp = stats["compile"]
+    pool.shutdown()
+    events = n_tenants * rows * len(streams)
+    return {
+        "eps": round(events / dt, 1),
+        "seconds": round(dt, 3),
+        "compile_ms": wu["compile_ms"],
+        "program_sets": comp["program_sets"],
+        "rounds": stats["pool"]["rounds"],
+        "ingest_streams": list(streams),
+        "packed_ingest": {k: packed[k] for k in
+                          ("transfers_per_round", "rows_packed",
+                           "pad_frac")},
+    }
+
+
+def _run_class_separate(arm: str, n_tenants: int, rows: int):
+    """Baseline arm: one full runtime per tenant, serial dispatch
+    (same GENEROUS flat extrapolation as _run_tenant_separate)."""
+    from siddhi_tpu.serving import Template
+    tpl_text, streams = CLASS_TEMPLATES[arm]
+    tpl = Template(tpl_text)
+    mgr = SiddhiManager()
+    feeds = _class_feeds(streams, rows)
+    runtimes = []
+    for i in range(n_tenants):
+        rt = mgr.create_siddhi_app_runtime(tpl.instantiate_static(
+            {}, app_name=f"{arm}_sep_{i}"))
+        outs = _Last()
+        next(iter(rt.queries.values())).batch_callbacks.append(outs)
+        rt.start()
+        handlers = [rt.get_input_handler(sid) for sid in streams]
+        runtimes.append((rt, handlers, outs))
+
+    def one_pass():
+        for _rt, handlers, outs in runtimes:
+            for h, sid in zip(handlers, streams):
+                ts, cols = feeds[sid]
+                h.send_arrays(ts, cols)
+        for _rt, _h, outs in runtimes:
+            outs.drain()
+
+    one_pass()   # first pass pays the per-runtime lazy compiles
+    dt = min(_timed(one_pass) for _ in range(REPS))
+    for rt, _h, _outs in runtimes:
+        rt.shutdown()
+    return {"eps": round(n_tenants * rows * len(streams) / dt, 1),
+            "seconds": round(dt, 3)}
+
+
+def _class_arm(arm: str, n_tenants: int, rows: int, batch_max: int,
+               sep_n: int):
+    """One operator-class pooled-vs-separate block for the tenants
+    config: eps_pooled/eps_separate/speedup + the packed_ingest
+    acceptance numbers."""
+    pooled = _run_class_pool(arm, n_tenants, rows, batch_max)
+    assert pooled["program_sets"] == 1, (arm, pooled)
+    sep_at = min(sep_n, n_tenants)
+    sep = _run_class_separate(arm, sep_at, rows)
+    return {
+        "tenants": n_tenants,
+        "rows_per_tenant": rows,
+        "eps_pooled": pooled["eps"],
+        "eps_separate": sep["eps"],
+        "separate_measured_at": sep_at,
+        "extrapolated": sep_at != n_tenants,
+        "speedup": round(pooled["eps"] / max(sep["eps"], 1e-9), 2),
+        "compile_ms": pooled["compile_ms"],
+        "program_sets": pooled["program_sets"],
+        "rounds": pooled["rounds"],
+        "ingest_streams": pooled["ingest_streams"],
+        "packed_ingest": pooled["packed_ingest"],
+    }
+
+
 def bench_tenants():
     """Multi-tenant serving acceptance (ROADMAP item 2): N tenants of
     ONE filter+window template as a vmapped TenantPool vs N separate
@@ -957,10 +1116,20 @@ def bench_tenants():
             "compile_ms": pooled["compile_ms"],
             "program_sets": pooled["program_sets"],
             "rounds": pooled["rounds"],
+            "packed_ingest": pooled["packed_ingest"],
         }
     slo_arm = _run_tenant_slo(min(n_list), rows, batch_max)
     fairness = _run_tenant_fairness(rows, batch_max)
     rebalance = _run_tenant_rebalance()
+    # operator-class arms (pattern NFA / two-stream equi-join pools):
+    # smaller rows — the per-row work is heavier than the filter chain
+    class_n = min(n_list)
+    class_rows = _scaled(1024, 256)
+    class_arms = {
+        arm: _class_arm(arm, class_n, class_rows, batch_max=256,
+                        sep_n=min(sep_n, 8))
+        for arm in CLASS_TEMPLATES
+    }
     n_max = max(n_list)
     head = per_n[n_max]
     return {
@@ -974,6 +1143,12 @@ def bench_tenants():
         "compile_ms": head["compile_ms"],
         "separate": sep,
         "tenants": {str(n): per_n[n] for n in n_list},
+        # packed pool ingest acceptance (docs/performance.md "Packed
+        # pool ingest"): ONE transfer per ingest stream per round —
+        # bench_diff.py gates on transfers_per_round creeping up
+        "packed_ingest": head["packed_ingest"],
+        "pattern_template": class_arms["pattern_template"],
+        "join_template": class_arms["join_template"],
         "plan": plan,
         "audit": audit,
         "slo": slo_arm,

@@ -317,10 +317,9 @@ class AggregationRuntime(Receiver):
         return step
 
     # -- query side -------------------------------------------------------
-    def materialize(self, duration: str, start: Optional[int],
-                    end: Optional[int]):
-        """-> (schema, buffer dict) of finished+running buckets in the
-        duration's table, filtered to [start, end] (AGG_TIMESTAMP)."""
+    def duration_key(self, duration: str) -> str:
+        """Normalize a `per '...'` duration spelling to the canonical
+        DURATIONS key, validating it against this aggregation."""
         d = duration.lower().rstrip("'\" ")
         alias = {"sec": "seconds", "min": "minutes", "hour": "hours",
                  "day": "days", "month": "months", "year": "years"}
@@ -329,8 +328,24 @@ class AggregationRuntime(Receiver):
             raise CompileError(
                 f"aggregation '{self.aggregation_id}' has no duration "
                 f"'{duration}' (available: {self.durations})")
+        return d
+
+    def materialize(self, duration: str, start: Optional[int],
+                    end: Optional[int]):
+        """-> (schema, buffer dict) of finished+running buckets in the
+        duration's table, filtered to [start, end] (AGG_TIMESTAMP)."""
+        d = self.duration_key(duration)
         with self._lock:
             st = jax.device_get(self.states[d])
+        return self.materialize_from(st, d, start, end)
+
+    def materialize_from(self, st: dict, duration: str,
+                         start: Optional[int], end: Optional[int]):
+        """Materialize from ONE duration's HOST-side state dict (a
+        device_get of `states[d]`, or one tenant's slot slice of a
+        pool's stacked aggregation state — serving/pool.py
+        materialize_tenant)."""
+        self.duration_key(duration)
         import numpy as np
         valid = np.asarray(st["used"]).copy()
         bs = np.asarray(st["bstart"])

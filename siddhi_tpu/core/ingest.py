@@ -187,37 +187,25 @@ class PackedEncoder:
                 ent[1] = dev
                 return
 
-    def encode(self, ts: np.ndarray, cols: Sequence, capacity: int,
-               now: int):
-        """-> (buf np.uint8[total], enc tuple, n)."""
-        assert capacity % 8 == 0, capacity
-        ts = self._conform(ts, np.int64)
+    def _choose_codes(self, ts: np.ndarray, cols: Sequence):
+        """Sticky code-choosing pass over one chunk: widens ``_ts_code``
+        / ``_col_codes`` and returns the conformed columns (so callers
+        never conform twice). Returns (n, conformed cols, ts span code).
+        The span code is returned rather than folded immediately so a
+        ROUND-wide widen (``widen_round``) can fold every chunk's span
+        only once the round's final ts code is known."""
         n = int(ts.shape[0])
         types = self.schema.types
-        self.stats["chunks"] += 1
-        self.stats["rows"] += n
-
-        # --- choose codes -------------------------------------------------
         if n >= 2:
             stride = int(ts[1]) - int(ts[0])
             is_aff = bool(np.all(np.diff(ts) == stride))
         else:
-            stride, is_aff = 0, True
+            is_aff = True
         tmin = int(ts.min()) if n else 0
-        base_ts = int(ts[0]) if is_aff and n else tmin
         span_code = _int_code(int(ts.max()) - tmin) if n else "d8"
         ts_cand = "aff" if is_aff else span_code
         self._ts_code = self._widen(self._ts_code, ts_cand)
-        if self._ts_code != "aff":
-            # once on a delta code, the width must cover THIS chunk's span
-            # even when the chunk itself is affine (offsets would wrap)
-            self._ts_code = self._widen(self._ts_code, span_code)
-        ts_code = self._ts_code
-        if ts_code != "aff":
-            base_ts = tmin  # offsets must be non-negative
-
-        ncols = []
-        bases = []
+        conf = []
         for i, t in enumerate(types):
             if t in _INT_FAMILY:
                 want = np.int64 if t is AttrType.LONG else np.int32
@@ -225,41 +213,118 @@ class PackedEncoder:
                 lo = int(c.min()) if n else 0
                 hi = int(c.max()) if n else 0
                 cand = "c" if lo == hi else _int_code(hi - lo)
-                base = lo
             elif t is AttrType.FLOAT:
                 c = self._conform(cols[i], np.float32)
                 u = c.view(np.uint32)
                 cand = "c" if (n and (u == u[0]).all()) or n == 0 else "f32"
-                base = int(np.int64(np.float64(c[0]).view(np.int64))) \
-                    if (cand == "c" and n) else 0
             elif t is AttrType.DOUBLE:
                 c = self._conform(cols[i], np.float64)
                 u = c.view(np.uint64)
                 cand = "c" if (n and (u == u[0]).all()) or n == 0 else "f64"
-                base = int(c[:1].view(np.int64)[0]) if (cand == "c" and n) \
-                    else 0
             elif t is AttrType.BOOL:
                 c = self._conform(cols[i], np.bool_)
-                if n and (c == c[0]).all():
-                    cand, base = "c", int(c[0])
-                elif n == 0:
-                    cand, base = "c", 0
-                else:
-                    cand, base = "b1", 0
+                cand = "c" if (n == 0 or (c == c[0]).all()) else "b1"
             else:
                 raise TypeError(f"cannot pack column type {t}")
-            code = self._widen(self._col_codes[i], cand)
-            self._col_codes[i] = code
-            if code != "c" and t in _INT_FAMILY:
-                base = lo  # delta base even when chunk is constant
+            self._col_codes[i] = self._widen(self._col_codes[i], cand)
+            conf.append(c)
+        return n, conf, span_code
+
+    @property
+    def encoding(self) -> tuple:
+        """The current sticky encoding tuple (the jit cache key the next
+        assembled chunk will dispatch under)."""
+        return (self._ts_code,) + tuple(self._col_codes)
+
+    def widen_round(self, chunks: Sequence) -> tuple:
+        """Pool-round pre-pass: sticky-widen the shared codes over EVERY
+        slot's (ts, cols) chunk BEFORE any buffer is assembled, so all
+        rows of one packed (slots, total) round buffer share ONE
+        encoding tuple (= one jit cache key, zero recompiles on tenant
+        churn). Folds every chunk's ts span once the round's final ts
+        code is known — chunk A (affine) widened before chunk B flips
+        the code off 'aff' must still ship deltas wide enough for A's
+        span. Returns the settled encoding tuple."""
+        spans = []
+        for ts, cols in chunks:
+            ts = self._conform(np.asarray(ts, np.int64), np.int64)
+            _n, _c, span = self._choose_codes(ts, cols)
+            spans.append(span)
+        if self._ts_code != "aff":
+            for span in spans:
+                self._ts_code = self._widen(self._ts_code, span)
+        return self.encoding
+
+    def encode(self, ts: np.ndarray, cols: Sequence, capacity: int,
+               now: int):
+        """-> (buf np.uint8[total], enc tuple, n)."""
+        assert capacity % 8 == 0, capacity
+        ts = self._conform(ts, np.int64)
+        n, conf, span_code = self._choose_codes(ts, cols)
+        if self._ts_code != "aff":
+            # once on a delta code, the width must cover THIS chunk's span
+            # even when the chunk itself is affine (offsets would wrap)
+            self._ts_code = self._widen(self._ts_code, span_code)
+        enc = self.encoding
+        _H, _offs, total = layout(len(self.schema.types), enc, capacity)
+        buf, fresh = self._buffer(total)
+        self._assemble(ts, conf, capacity, now, buf, fresh)
+        return buf, enc, n
+
+    def encode_into(self, ts: np.ndarray, cols: Sequence, capacity: int,
+                    now: int, out: np.ndarray):
+        """Assemble one chunk into a CALLER-OWNED pre-zeroed buffer (one
+        row of a pool round's (slots, total) stacked buffer) under the
+        CURRENT sticky codes — the caller must have run ``widen_round``
+        over the whole round first, so this never widens. Returns n."""
+        assert capacity % 8 == 0, capacity
+        ts = self._conform(ts, np.int64)
+        return self._assemble(ts, cols, capacity, now, out, fresh=True)
+
+    def _assemble(self, ts: np.ndarray, cols: Sequence, capacity: int,
+                  now: int, buf: np.ndarray, fresh: bool) -> int:
+        """Write header + lanes for one chunk under the CURRENT sticky
+        codes (already wide enough for this chunk's spans). ``cols`` may
+        be raw caller arrays; they are conformed here if needed."""
+        n = int(ts.shape[0])
+        types = self.schema.types
+        self.stats["chunks"] += 1
+        self.stats["rows"] += n
+
+        ts_code = self._ts_code
+        if n >= 2:
+            stride = int(ts[1]) - int(ts[0])
+        else:
+            stride = 0
+        tmin = int(ts.min()) if n else 0
+        base_ts = (int(ts[0]) if n else 0) if ts_code == "aff" else tmin
+
+        ncols = []
+        bases = []
+        for i, t in enumerate(types):
+            code = self._col_codes[i]
+            if t in _INT_FAMILY:
+                want = np.int64 if t is AttrType.LONG else np.int32
+                c = self._conform(cols[i], want)
+                lo = int(c.min()) if n else 0
+                base = lo   # constant value when code == "c", else delta
+            elif t is AttrType.FLOAT:
+                c = self._conform(cols[i], np.float32)
+                base = int(np.int64(np.float64(c[0]).view(np.int64))) \
+                    if (code == "c" and n) else 0
+            elif t is AttrType.DOUBLE:
+                c = self._conform(cols[i], np.float64)
+                base = int(c[:1].view(np.int64)[0]) if (code == "c" and n) \
+                    else 0
+            else:  # BOOL
+                c = self._conform(cols[i], np.bool_)
+                base = int(c[0]) if (code == "c" and n) else 0
             ncols.append((code, c))
             bases.append(base)
 
         enc = (ts_code,) + tuple(code for code, _ in ncols)
-
-        # --- assemble the single buffer ----------------------------------
         H, offs, total = layout(len(types), enc, capacity)
-        buf, fresh = self._buffer(total)
+        assert buf.nbytes == total, (buf.nbytes, total)
         hdr = buf[:H].view(np.int64)
         hdr[0] = n
         hdr[1] = base_ts
@@ -319,7 +384,7 @@ class PackedEncoder:
                 else:
                     put(o, (c.astype(np.int64) - base).astype(dt), lane,
                         view=False)
-        return buf, enc, n
+        return n
 
 
 def _bitcast_lane(buf, offset: int, capacity: int, width: int, dtype):

@@ -505,11 +505,17 @@ class ExplainReport:
             "pool": {
                 "order": list(pool._order),
                 "ingest_stream": pool.ingest_stream,
+                "ingest_streams": list(pool.ingest_streams),
+                # operator class per pooled node (chain / pattern /
+                # join / agg) — plan, not live: it derives from the
+                # template and picks the vmapped step variants
+                "kinds": {qn: pool._kind[qn] for qn in pool._order},
                 "terminal_streams": list(pool._terminal),
                 "batch_max": int(pool.batch_max),
                 "max_tenants": int(pool.max_tenants),
                 "state_quota_bytes": pool.state_quota_bytes,
                 "execution": "vmap-slot-axis",
+                "packed_ingest": bool(pool._packed_on),
             },
             "slo": pool.slo_engine.objective.as_dict()
             if pool.slo_engine.objective is not None else None,

@@ -408,9 +408,10 @@ def _measure_pool(n_mesh: int, tenants: int, rows: int, batch_max: int,
     cols = [rng.uniform(0, 200, rows), rng.integers(
         0, 1 << 20, rows, dtype=np.int64)]
     last = {}
+    # terminal maps sid -> LIST of device batches; keep the newest
     pool.batch_callbacks.append(
         lambda terminal: last.update(out=next(
-            iter(terminal.values()), None) if terminal else None))
+            iter(terminal.values()))[-1] if terminal else None))
 
     def one_pass():
         for i in range(tenants):

@@ -223,6 +223,19 @@ def shard_pytree(tree, mesh: Mesh, rules, axis=None, stats=None):
     return jax.tree_util.tree_map(place, tree, specs)
 
 
+def place_leading(arr, mesh: Mesh, axis=None):
+    """ONE sharded ``jax.device_put`` of a host array (or array
+    pytree) split over ``axis`` on the LEADING dimension — each device
+    receives only its rows, and the transfer is still a single put
+    call. The tenant pool's round inputs route through here: the
+    packed (slots, total) ingest buffer and the stacked EventBatch
+    both place with the identical slot-axis layout the POOL_STATE_RULES
+    give the states they meet inside the vmapped step."""
+    axis = axis or mesh.axis_names[0]
+    return jax.device_put(
+        arr, NamedSharding(mesh, PartitionSpec(axis)))
+
+
 def build_mesh(n_devices=None, axis: str = "shards",
                devices=None) -> Mesh:
     """A 1-D mesh over the first ``n_devices`` local devices (default:

@@ -2,11 +2,32 @@
 
 One pool = one template (+ shared structural bindings) = ONE compiled
 program set. Per-tenant state pytrees stack on a leading tenant axis;
-`jax.vmap` over the standard `_chain_body` trace advances EVERY tenant
-of the template in a single dispatch. Tenant `${name:type}` parameters
-ride the stacked operator state (ops/expr.py tparam machinery), so
-tenant add/remove is pure slot assignment — `.at[slot].set` writes, no
-retrace, no recompile (counting-jit guarded in tests/test_serving.py).
+`jax.vmap` over the standard operator-class traces advances EVERY
+tenant of the template in a single dispatch. Four operator classes
+pool (docs/serving.md "Poolable operator classes"):
+
+- **chain** — filter/window/projection insert-into chains over the
+  `_chain_body` trace (the original pooled class);
+- **pattern** — NFA one-hot transition scans: the pending-match table
+  plus selector states stack per slot, one vmapped step per input
+  stream (ops/nfa.py), plus a vmapped absent-deadline timer step;
+- **join** — banded equi-join probes: both side chains, the selector
+  states and the join-cap overflow counter ride ONE donated state dict
+  per query (the opposite side is read inside and returned unchanged,
+  which keeps whole-dict donation safe), one vmapped step per side;
+- **aggregation** — incremental-aggregation bucket tables stack per
+  slot; `materialize_tenant` slices one tenant's buckets out.
+
+Templates may name N ingest streams (patterns/joins consume several);
+`send(..., stream=)` routes per stream and every fair round ships ONE
+packed (slots, total) uint8 buffer PER INGEST STREAM — the PR 19
+zero-copy columnar encode, widened round-wide so all slots share one
+encoding tuple — slot-routed on device (`SIDDHI_TPU_POOL_PACKED=0`
+falls back to the stacked EventBatch transfer). Tenant `${name:type}`
+parameters ride the stacked operator state (ops/expr.py tparam
+machinery), so tenant add/remove is pure slot assignment —
+`.at[slot].set` writes, no retrace, no recompile (counting-jit guarded
+in tests/test_serving.py).
 
 Capacity model (`@app:cap(tenants=..., tenant.state.kb=...)` dial or
 constructor knobs):
@@ -64,6 +85,12 @@ from ..ops.expr import CompileError
 from .qos import PoolQoS
 
 QOS_ENV = "SIDDHI_TPU_QOS"   # "0" kills the whole QoS layer
+POOL_PACKED_ENV = "SIDDHI_TPU_POOL_PACKED"  # "0" = stacked EventBatch
+
+# _kind slug -> the operator-class name used in quota accounting and
+# the 429 per-class state-bytes breakdown (docs/serving.md matrix)
+_CLASS_NAMES = {"chain": "chain", "pattern": "pattern", "join": "join",
+                "agg": "aggregation"}
 
 log = logging.getLogger("siddhi_tpu.serving")
 
@@ -232,18 +259,37 @@ class TenantPool:
         if mesh is not None:
             self._place_state()   # initial slot-axis placement
         # per-tenant state bytes (quota accounting): one slot's slice of
-        # every query state plus its emitted counter
-        self.state_bytes_per_tenant = 8 * len(self._order) + sum(
-            leaf.nbytes // self.slots
-            for qn in self._order
-            for leaf in jax.tree_util.tree_leaves(self._states[qn]))
+        # every query state plus its emitted counter, accounted PER
+        # OPERATOR CLASS — a join-heavy tenant's window buffers and an
+        # aggregation's bucket tables all count against tenant.state.kb,
+        # and the 429 payload carries the breakdown (docs/serving.md)
+        self.state_bytes_by_class: dict[str, int] = {}
+        total_bytes = 0
+        for qn in self._order:
+            b = 8 + sum(leaf.nbytes // self.slots for leaf in
+                        jax.tree_util.tree_leaves(self._states[qn]))
+            cls = _CLASS_NAMES[self._kind[qn]]
+            self.state_bytes_by_class[cls] = \
+                self.state_bytes_by_class.get(cls, 0) + b
+            total_bytes += b
+        self.state_bytes_per_tenant = total_bytes
 
         self._tenants: dict[str, int] = {}
         self._bindings: dict[str, dict] = {}      # tid -> bound values
         self._tenant_qos_raw: dict[str, dict] = {}  # tid -> qos dials
         self._free = list(range(self.slots - 1, -1, -1))
-        self._pending: dict[str, deque] = {}
+        # tid -> {ingest stream -> deque of (ts, cols, t_arrival)}
+        self._pending: dict[str, dict] = {}
         self._pending_rows: dict[str, int] = {}
+        # packed pool ingest (core/ingest.py): ONE sticky widen-only
+        # encoder per ingest stream — all slots of a round share its
+        # encoding tuple, so the whole round is ONE (slots, total)
+        # uint8 device_put per stream (SIDDHI_TPU_POOL_PACKED=0 falls
+        # back to the stacked EventBatch transfer)
+        self._packed_on = os.environ.get(POOL_PACKED_ENV, "1") != "0"
+        self._encoders: dict[str, object] = {}
+        self._ingest_stats = {"transfers": 0, "rows": 0, "cells": 0,
+                              "bytes": 0, "rounds": 0}
         self._callbacks: dict[str, list[Callable]] = {}
         self._error_counts: dict[str, int] = {}
         self.batch_callbacks: list[Callable] = []
@@ -327,31 +373,66 @@ class TenantPool:
     # -- planning ---------------------------------------------------------
 
     def _plan_topology(self) -> None:
-        """Derive the linear/fan-out query wiring from the prototype's
-        junction graph: ONE ingest stream in, queries in topological
-        order, terminal streams (produced, never consumed) out."""
+        """Derive the query wiring from the prototype's junction graph:
+        N named ingest streams in, queries (and aggregations) in
+        topological order, terminal streams (produced, never consumed)
+        out. Every node gets a ``_kind`` (chain/pattern/join/agg) and a
+        tuple of labeled inputs — chains one ``("*", sid)``, patterns
+        one ``("s:<sid>", sid)`` per distinct engine stream, joins
+        ``("L", sid)``/``("R", sid)`` — the label picks the vmapped
+        step variant at dispatch."""
+        from ..core.runtime import JoinQueryRuntime, PatternQueryRuntime
         p = self.proto
-        self._q_in: dict[str, str] = {}
+        self._q_in: dict[str, tuple] = {}
         self._q_out: dict[str, Optional[str]] = {}
+        self._kind: dict[str, str] = {}
+        self._aggs: dict[str, object] = {}
         produced: set[str] = set()
         consumers: dict[str, list[str]] = {}
         for qn, q in p.queries.items():
-            self._q_in[qn] = q.in_schema.stream_id
-            consumers.setdefault(q.in_schema.stream_id, []).append(qn)
+            if isinstance(q, PatternQueryRuntime):
+                self._kind[qn] = "pattern"
+                ins = tuple(
+                    ("s:" + sid, sid) for sid in
+                    sorted({s.stream_id for s in q.engine.slots}))
+            elif isinstance(q, JoinQueryRuntime):
+                self._kind[qn] = "join"
+                ins = tuple(
+                    (side, q.in_schemas[side].stream_id)
+                    for side in ("L", "R")
+                    if side not in q.side_tables)
+            else:
+                self._kind[qn] = "chain"
+                ins = (("*", q.in_schema.stream_id),)
+            self._q_in[qn] = ins
+            for _lab, sid in ins:
+                consumers.setdefault(sid, []).append(qn)
             out = None
             for h in q.output_handlers:
                 if isinstance(h, InsertIntoStreamHandler):
                     out = h.junction.stream_id
                     produced.add(out)
             self._q_out[qn] = out
+        for aid, ar in p.aggregations.items():
+            if aid in self._q_in:
+                raise CompileError(
+                    f"aggregation '{aid}' collides with a query name")
+            self._kind[aid] = "agg"
+            self._aggs[aid] = ar
+            self._q_in[aid] = (("*", ar.in_schema.stream_id),)
+            self._q_out[aid] = None
+            consumers.setdefault(ar.in_schema.stream_id, []).append(aid)
         ingest = sorted(sid for sid in consumers if sid not in produced)
         self._ingest_streams = ingest
-        # topological order (BFS from the ingest streams)
+        # topological order (BFS from the ingest streams; a node places
+        # once ALL its labeled inputs are available)
         avail = set(ingest)
         order: list[str] = []
-        remaining = dict(self._q_in)
+        remaining = {qn: {sid for _lab, sid in ins}
+                     for qn, ins in self._q_in.items()}
         while remaining:
-            placed = [qn for qn, sid in remaining.items() if sid in avail]
+            placed = [qn for qn, sids in remaining.items()
+                      if sids <= avail]
             if not placed:
                 break   # unreachable/cyclic queries — poolability rejects
             for qn in sorted(placed):
@@ -364,45 +445,91 @@ class TenantPool:
         self._terminal = sorted(
             sid for sid in produced if sid not in consumers)
 
+    # classes that still cannot pool: (proto attr, what, why, nearest
+    # poolable alternative) — each rejection names its reason AND the
+    # closest construct that DOES pool (docs/serving.md matrix)
+    _UNPOOLABLE = (
+        ("partitions", "partitions",
+         "partition state fans out per key value, not per tenant slot",
+         "key by an attribute inside a pooled filter/window chain"),
+        ("named_windows", "named windows",
+         "a named window is one shared instance crossing query (and "
+         "tenant) boundaries",
+         "give each query its own window(...) inside the template"),
+        ("tables", "tables",
+         "table state is shared mutable storage updated by host-side "
+         "index rebuilds",
+         "model reference data as a windowed stream and join it"),
+        ("record_tables", "@Store tables",
+         "external-store I/O runs host callbacks per operation",
+         "pre-join the store data into an ingest stream"),
+        ("triggers", "triggers",
+         "triggers fire on wall-clock schedules outside the fair round "
+         "loop",
+         "drive time with advance_time()/pump() rounds"),
+    )
+
     def _check_poolable(self) -> None:
         p = self.proto
         problems = []
-        for attr, what in (("partitions", "partitions"),
-                           ("aggregations", "incremental aggregations"),
-                           ("named_windows", "named windows"),
-                           ("tables", "tables"),
-                           ("record_tables", "@Store tables"),
-                           ("triggers", "triggers")):
+        for attr, what, why, alt in self._UNPOOLABLE:
             if getattr(p, attr):
-                problems.append(what)
+                problems.append(f"{what} ({why}; nearest poolable "
+                                f"alternative: {alt})")
         if p.sources or p.sinks:
-            problems.append("@source/@sink connectors")
+            problems.append(
+                "@source/@sink connectors (connectors own host I/O "
+                "threads outside pool rounds; nearest poolable "
+                "alternative: pool.send() and per-tenant callbacks at "
+                "the service front door)")
         for qn, q in p.queries.items():
-            if type(q) is not QueryRuntime:
+            if q.table_deps or getattr(q, "side_tables", None):
                 problems.append(
-                    f"query '{qn}' ({type(q).__name__}: joins/patterns)")
-            elif q.table_deps:
-                problems.append(f"query '{qn}' reads tables")
+                    f"query '{qn}' reads tables (shared mutable "
+                    "storage; nearest poolable alternative: join "
+                    "against a windowed stream)")
             elif self._q_out.get(qn) is None:
                 problems.append(
-                    f"query '{qn}' has a non-insert-into output")
-        if len(self._ingest_streams) != 1:
-            problems.append(
-                f"{len(self._ingest_streams)} ingest streams "
-                "(exactly one supported)")
+                    f"query '{qn}' has a non-insert-into output "
+                    "(nearest poolable alternative: insert into a "
+                    "stream and attach per-tenant callbacks)")
+        if not self._ingest_streams:
+            problems.append("no ingest stream (every stream is "
+                            "query-produced)")
         if self._unreachable:
             problems.append(
                 f"unreachable queries {', '.join(self._unreachable)}")
         if problems:
             raise CompileError(
                 f"template '{self.template.name}' is not poolable — "
-                "vmapped tenant execution covers plain filter/window/"
-                "projection insert-into chains on one ingest stream; "
+                "vmapped tenant execution covers filter/window/"
+                "projection chains, patterns, joins, and incremental "
+                "aggregations over named ingest streams; "
                 "found: " + "; ".join(problems))
 
     @property
     def ingest_stream(self) -> str:
+        """First (often only) ingest stream — the single-stream
+        compatibility surface (core/service.py rows endpoint)."""
         return self._ingest_streams[0]
+
+    @property
+    def ingest_streams(self) -> tuple:
+        return tuple(self._ingest_streams)
+
+    def _resolve_stream(self, stream: Optional[str]) -> str:
+        if stream is None:
+            if len(self._ingest_streams) == 1:
+                return self._ingest_streams[0]
+            raise ValueError(
+                f"pool '{self.name}' has {len(self._ingest_streams)} "
+                f"ingest streams {self._ingest_streams} — "
+                "send(..., stream=) must name one")
+        if stream not in self._ingest_streams:
+            raise KeyError(
+                f"'{stream}' is not an ingest stream of pool "
+                f"'{self.name}' (ingest: {self._ingest_streams})")
+        return stream
 
     # -- mesh placement (parallel/sharding.py) ----------------------------
 
@@ -439,9 +566,8 @@ class TenantPool:
         rows — one transfer either way)."""
         if self.mesh is None:
             return jax.device_put(batch)
-        from jax.sharding import NamedSharding, PartitionSpec
-        return jax.device_put(batch, NamedSharding(
-            self.mesh, PartitionSpec(self.mesh_axis)))
+        return self._sharding.place_leading(batch, self.mesh,
+                                            axis=self.mesh_axis)
 
     def _device_loads_locked(self) -> list:
         """Tenants currently placed per device (host-side bookkeeping;
@@ -484,28 +610,72 @@ class TenantPool:
         return self._free.pop(best)
 
     # -- state stacking ---------------------------------------------------
+    # Slot-state layout per operator class (docs/serving.md matrix):
+    #   chain   -> tuple(op state, ...)                (the original)
+    #   pattern -> {"nfa": pending-match table, "sel": tuple(op state)}
+    #   join    -> {"sides": {"L": tuple, "R": tuple},
+    #               "sel": tuple, "ovf": join-cap drop counter}
+    #   agg     -> {duration: bucket-table state dict}
+    # Everything downstream (snapshot/restore, migration, growth,
+    # quota accounting) is generic tree_map over these pytrees.
+
+    def _unstacked_init(self, qname: str):
+        """One tenant's fresh state pytree for one query/aggregation."""
+        kind = self._kind[qname]
+        if kind == "agg":
+            ar = self._aggs[qname]
+            return {d: ar._init_state() for d in ar.durations}
+        q = self.proto.queries[qname]
+        sel = tuple(op.init_state() for op in q.operators)
+        if kind == "pattern":
+            return {"nfa": q.engine.init_state(), "sel": sel}
+        if kind == "join":
+            return {"sides": {s: tuple(op.init_state() for op in ops)
+                              for s, ops in q.side_ops.items()},
+                    "sel": sel, "ovf": jnp.int64(0)}
+        return sel
 
     def _stack_init(self, qname: str, slots: int):
-        init = tuple(op.init_state()
-                     for op in self.proto.queries[qname].operators)
+        # Host-side numpy repeat + one transfer per leaf: a jnp.repeat
+        # here would compile an XLA fill program per distinct leaf shape
+        # at pool CONSTRUCTION time, before warmup ever runs.
         return jax.tree_util.tree_map(
-            lambda x: jnp.repeat(jnp.asarray(x)[None], slots, axis=0),
-            init)
+            lambda x: jnp.asarray(np.repeat(np.asarray(x)[None], slots,
+                                            axis=0)),
+            self._unstacked_init(qname))
 
-    def _tenant_init_states(self, qname: str, vals: dict):
-        """One tenant's fresh (unstacked) state tuple with its bound
-        `${...}` parameter values in place of the zeros."""
+    @staticmethod
+    def _ops_init_with_params(ops, vals: dict):
         states = []
-        for op in self.proto.queries[qname].operators:
+        for op in ops:
             st = op.init_state()
             tps = getattr(op, "tparams", ())
             if tps:
                 st = {"tparams": {
-                    n: jnp.asarray(self._encode_param(vals[n][0], t),
+                    n: jnp.asarray(TenantPool._encode_param(vals[n][0],
+                                                            t),
                                    dtype=np_dtype(t))
                     for n, t in tps}}
             states.append(st)
         return tuple(states)
+
+    def _tenant_init_states(self, qname: str, vals: dict):
+        """One tenant's fresh (unstacked) state pytree with its bound
+        `${...}` parameter values in place of the zeros (value params
+        bind only in chain/selector positions — plan_rules)."""
+        kind = self._kind[qname]
+        if kind == "agg":
+            return self._unstacked_init(qname)
+        q = self.proto.queries[qname]
+        sel = self._ops_init_with_params(q.operators, vals)
+        if kind == "pattern":
+            return {"nfa": q.engine.init_state(), "sel": sel}
+        if kind == "join":
+            return {"sides": {
+                        s: self._ops_init_with_params(ops, vals)
+                        for s, ops in q.side_ops.items()},
+                    "sel": sel, "ovf": jnp.int64(0)}
+        return sel
 
     @staticmethod
     def _encode_param(value, t: AttrType):
@@ -557,10 +727,14 @@ class TenantPool:
         if self.state_quota_bytes is not None:
             need = (len(self._tenants) + 1) * self.state_bytes_per_tenant
             if need > self.state_quota_bytes:
+                per_class = ", ".join(
+                    f"{k}={v}" for k, v in
+                    sorted(self.state_bytes_by_class.items()))
                 return False, (
                     f"pool '{self.name}' per-tenant state quota "
                     f"exhausted ({need} > {self.state_quota_bytes} bytes "
-                    f"at {self.state_bytes_per_tenant} bytes/tenant)"), \
+                    f"at {self.state_bytes_per_tenant} bytes/tenant: "
+                    f"{per_class})"), \
                     "state-quota"
         return True, "", ""
 
@@ -600,7 +774,8 @@ class TenantPool:
         """Current pressure signals (host-side only; caller holds the
         lock): queue age, backlog, round-drain lag, rejection counts."""
         now = time.perf_counter()
-        ages = [now - q[0][2] for q in self._pending.values() if q]
+        ages = [now - q[0][2] for qs in self._pending.values()
+                for q in qs.values() if q]
         pending_total = sum(self._pending_rows.values())
         lag = 0.0
         if pending_total and self._last_pump_wall is not None:
@@ -659,7 +834,11 @@ class TenantPool:
             if not ok:
                 self._reject(cause, reason, tenant=tenant_id,
                              active=len(self._tenants),
-                             max_tenants=self.max_tenants)
+                             max_tenants=self.max_tenants,
+                             state_bytes_per_tenant=
+                             self.state_bytes_per_tenant,
+                             state_bytes_by_class=dict(
+                                 self.state_bytes_by_class))
             vals = check_template_bindings(self.proto.ast,
                                            dict(bindings or {}))
             if self._qos is not None:
@@ -676,7 +855,7 @@ class TenantPool:
             self._tenants[tenant_id] = slot
             self._bindings[tenant_id] = dict(bindings or {})
             self._tenant_qos_raw[tenant_id] = dict(qos or {})
-            self._pending[tenant_id] = deque()
+            self._pending[tenant_id] = self._fresh_queues()
             self._pending_rows[tenant_id] = 0
             self._error_counts[tenant_id] = 0
             self._recompute_placement_locked()
@@ -766,13 +945,20 @@ class TenantPool:
 
     # -- ingest (fair round-robin batching) -------------------------------
 
-    def send(self, tenant_id: str, ts, cols) -> None:
+    def _fresh_queues(self) -> dict:
+        return {sid: deque() for sid in self._ingest_streams}
+
+    def send(self, tenant_id: str, ts, cols,
+             stream: Optional[str] = None) -> None:
         """Queue one columnar chunk for a tenant (numpy ts + columns,
         STRING columns as dictionary codes — the send_arrays contract).
+        ``stream`` routes multi-ingest templates (patterns/joins name
+        several ingest streams); single-stream templates may omit it.
         Every chunk is stamped with its host arrival time (one
         perf_counter read — the queue-age saturation signal and the
         ingest side of the sampled ingest->emit span). Dispatch happens
         in fair rounds via pump()/flush() or the background worker."""
+        sid = self._resolve_stream(stream)
         ts = np.asarray(ts, dtype=np.int64)
         n = int(ts.shape[0])
         if n == 0:
@@ -815,7 +1001,7 @@ class TenantPool:
                         parked_rows=mig["parked_rows"],
                         park_cap=mig["park_cap"],
                         retry_after_ms=self._retry_after_flip_ms())
-                mig["parked"].append((ts, cols, t_arr))
+                mig["parked"].append((sid, ts, cols, t_arr))
                 mig["parked_rows"] += n
                 return
             if self._pending_rows[tenant_id] + n > self.pending_cap:
@@ -829,15 +1015,18 @@ class TenantPool:
                     pending_cap=self.pending_cap,
                     retry_after_ms=self._retry_after_ms(
                         self._pending_rows[tenant_id]))
-            self._pending[tenant_id].append((ts, cols, t_arr))
+            qs = self._pending.setdefault(tenant_id,
+                                          self._fresh_queues())
+            qs[sid].append((ts, cols, t_arr))
             self._pending_rows[tenant_id] += n
             self._work.notify()
 
-    def _take(self, tenant_id: str, limit: int):
-        """Up to `limit` rows off a tenant's pending queue (splitting a
-        chunk re-queues the remainder at the head — order AND arrival
-        stamp preserved). Returns (ts, cols, oldest_arrival)."""
-        q = self._pending.get(tenant_id)
+    def _take(self, tenant_id: str, sid: str, limit: int):
+        """Up to `limit` rows off a tenant's pending queue for ONE
+        ingest stream (splitting a chunk re-queues the remainder at the
+        head — order AND arrival stamp preserved). Returns
+        (ts, cols, oldest_arrival)."""
+        q = self._pending.get(tenant_id, {}).get(sid)
         if not q:
             return None
         ts_parts, col_parts, taken = [], [], 0
@@ -877,14 +1066,17 @@ class TenantPool:
             # take — the moving tenant is never dispatched between its
             # request and its flip, so the move is atomic w.r.t. rounds
             self._apply_migrations_locked()
-            per_slot = {}
+            # sid -> {slot -> (ts, cols)} for this round
+            per_stream: dict[str, dict] = {}
             stamps: dict[str, float] = {}
             taken = 0
             last_ts = self._now
             # per-tenant take limits: the DRR/priority plan when QoS is
             # live (serving/qos.py — all-default dials produce exactly
             # batch_max per backlogged tenant), the fixed fair share
-            # otherwise
+            # otherwise. A tenant's limit spends across its ingest
+            # streams in stream order — the credit is per tenant, not
+            # per (tenant, stream).
             limits = None
             if self._qos is not None:
                 limits = self._qos.plan_round(dict(self._pending_rows),
@@ -903,16 +1095,21 @@ class TenantPool:
                     limit = min(limit, dev_budget[dev])
                 if limit <= 0:
                     continue
-                got = self._take(tid, limit)
-                if got is None:
-                    continue
-                ts_a, cols_a, t_arr = got
-                if dev_budget is not None:
-                    dev_budget[dev] -= len(ts_a)
-                per_slot[slot] = (ts_a, cols_a)
-                stamps[tid] = t_arr
-                taken += len(ts_a)
-                last_ts = max(last_ts, int(ts_a[-1]))
+                for sid in self._ingest_streams:
+                    if limit <= 0:
+                        break
+                    got = self._take(tid, sid, limit)
+                    if got is None:
+                        continue
+                    ts_a, cols_a, t_arr = got
+                    n = len(ts_a)
+                    limit -= n
+                    if dev_budget is not None:
+                        dev_budget[dev] -= n
+                    per_stream.setdefault(sid, {})[slot] = (ts_a, cols_a)
+                    stamps[tid] = min(stamps.get(tid, t_arr), t_arr)
+                    taken += n
+                    last_ts = max(last_ts, int(ts_a[-1]))
             if not taken:
                 self._last_pump_wall = time.perf_counter()
                 return 0
@@ -920,14 +1117,19 @@ class TenantPool:
             if self.mesh is not None:
                 # per-device ingest attribution (host counters only;
                 # the `device=` labeled gauge family)
-                for slot, (ts_a, _c) in per_slot.items():
-                    self._rows_per_device[
-                        self._device_of_slot(slot)] += len(ts_a)
-            cap = bucket_capacity(
-                max(len(r[0]) for r in per_slot.values()))
-            batch = self._stacked_batch(per_slot, cap)
+                for per_slot in per_stream.values():
+                    for slot, (ts_a, _c) in per_slot.items():
+                        self._rows_per_device[
+                            self._device_of_slot(slot)] += len(ts_a)
+            # ONE transfer per ingest stream: the packed (slots, total)
+            # buffer, or the stacked EventBatch fallback — either way a
+            # single device_put per stream per round
+            stream_inputs = {
+                sid: [self._ingest_entry(sid, per_slot)]
+                for sid, per_slot in per_stream.items()}
+            self._ingest_stats["rounds"] += 1
             sampled = self.slo_engine.tick("round")
-            terminal, qtimes = self._dispatch(batch, self._now,
+            terminal, qtimes = self._dispatch(stream_inputs, self._now,
                                               sample=sampled)
             self._rounds += 1
             if self._checkpoint_supervisor is not None:
@@ -976,22 +1178,79 @@ class TenantPool:
             total += n
 
     def advance_time(self, now_ms: int) -> None:
-        """Drive time-based window boundaries with no traffic: one
-        empty-batch dispatch at the given event time (all slots
-        masked invalid — same compiled programs as a tiny round)."""
+        """Drive time-based window boundaries (and pattern absent
+        deadlines) with no traffic: one empty-batch dispatch per ingest
+        stream at the given event time (all slots masked invalid — same
+        compiled programs as a tiny round)."""
         with self._lock:
             self._now = max(self._now, int(now_ms))
-            batch = self._stacked_batch({}, BATCH_BUCKETS[0])
-            terminal, _qt = self._dispatch(batch, self._now)
+            stream_inputs = {
+                sid: [("b", self._stacked_batch({}, BATCH_BUCKETS[0],
+                                                sid))]
+                for sid in self._ingest_streams}
+            terminal, _qt = self._dispatch(stream_inputs, self._now)
         self._deliver(terminal)
 
     # -- dispatch ---------------------------------------------------------
 
-    def _stacked_batch(self, per_slot: dict, cap: int) -> EventBatch:
+    def _ingest_entry(self, sid: str, per_slot: dict):
+        """One ingest stream's round input as a dispatch entry:
+        ``("p", (buf, enc, cap))`` packed (the default — ONE uint8
+        device_put for all slots) or ``("b", EventBatch)`` stacked
+        (SIDDHI_TPU_POOL_PACKED=0). Updates the packed-ingest stats
+        either way (transfers per round, rows vs padded cells)."""
+        cap = bucket_capacity(
+            max(len(r[0]) for r in per_slot.values()))
+        st = self._ingest_stats
+        st["transfers"] += 1
+        st["rows"] += sum(len(t) for t, _c in per_slot.values())
+        st["cells"] += self.slots * cap
+        if not self._packed_on:
+            return ("b", self._stacked_batch(per_slot, cap, sid))
+        return self._pack_round(sid, per_slot, cap)
+
+    def _pack_round(self, sid: str, per_slot: dict, cap: int):
+        """Pack one ingest stream's round into ONE (slots, total) uint8
+        buffer (core/ingest.py wire format, one row per slot) and ship
+        it with a single device_put (mesh: a single SHARDED put — each
+        device receives only its slots' rows).
+
+        The stream's sticky encoder widens ROUND-WIDE first
+        (`widen_round`) so every slot's row assembles under the same
+        final encoding tuple — the enc tuple is part of the jit cache
+        key, so it must be one value per transfer. Empty slots stay
+        all-zero except the `now` header slot: every row carries the
+        round clock, so idle tenants' windows expire on the same clock
+        as active ones (the batch flavor's global `now` twin)."""
+        from ..core.ingest import PackedEncoder, layout
+        enc_ = self._encoders.get(sid)
+        if enc_ is None:
+            schema = self.proto.junctions[sid].schema
+            enc_ = self._encoders[sid] = PackedEncoder(schema)
+        chunks = list(per_slot.values())
+        enc = enc_.widen_round(chunks)
+        _H, _offs, total = layout(len(enc_.schema.types), enc, cap)
+        big = np.zeros((self.slots, total), np.uint8)
+        # round clock into EVERY slot's header (bytes 16:24 = now)
+        big[:, 16:24] = np.frombuffer(np.int64(self._now).tobytes(),
+                                      np.uint8)
+        for slot, (ts_a, cols_a) in per_slot.items():
+            enc_.encode_into(ts_a, cols_a, cap, self._now,
+                             out=big[slot])
+        self._ingest_stats["bytes"] += big.nbytes
+        if self.mesh is None:
+            dev = jax.device_put(big)
+        else:
+            dev = self._sharding.place_leading(big, self.mesh,
+                                               axis=self.mesh_axis)
+        return ("p", (dev, enc, cap))
+
+    def _stacked_batch(self, per_slot: dict, cap: int,
+                       sid: str) -> EventBatch:
         """(slots, cap) stacked EventBatch from per-slot row chunks; one
         device_put for the whole pytree. Slots without rows are
         all-padding (their tenants' states pass through unchanged)."""
-        schema = self.proto.junctions[self.ingest_stream].schema
+        schema = self.proto.junctions[sid].schema
         N = self.slots
         ts = np.zeros((N, cap), np.int64)
         valid = np.zeros((N, cap), np.bool_)
@@ -1009,20 +1268,35 @@ class TenantPool:
             kind=kind, valid=valid)
         return self._place_batch(batch)
 
-    def _vstep_for(self, qname: str, cap: int) -> Callable:
+    def _vstep_for(self, qname: str, label: str, flavor: tuple) \
+            -> Callable:
         # warm_specs builders run on compile-pool threads; the lock keeps
         # concurrent builds from double-creating (and double-compiling)
         # the same jit wrapper
         with self._lock:
-            return self._vstep_for_locked(qname, cap)
+            return self._vstep_for_locked(qname, label, flavor)
 
-    def _vstep_for_locked(self, qname: str, cap: int) -> Callable:
-        key = (qname, cap, self.slots)
-        fn = self._vsteps.get(key)
-        if fn is None:
-            q = self.proto.queries[qname]
+    def _core_body(self, qname: str, label: str) -> Callable:
+        """The per-slot step for one (query, input-label): the same
+        trace the separate runtimes jit per instance (core/runtime.py
+        `_chain_body` / `_step_for_stream` / `_step_for_side`), minus
+        table support (poolability rejects tables). Signature
+        ``(st, emitted, batch, now) -> (st, emitted, out|None)`` —
+        vmapped over the leading slot axis by `_vstep_for_locked`."""
+        kind = self._kind[qname]
+        rewrite = self._q_out.get(qname) is not None
+        if kind == "agg":
+            astep = self._aggs[qname]._make_step()
+
+            def agg_body(st, emitted, batch, now):
+                st = astep(st, batch)
+                emitted = emitted + batch.count().astype(jnp.int64)
+                return st, emitted, None
+            return agg_body
+        q = self.proto.queries[qname]
+        sel_ops = q.operators
+        if kind == "chain":
             chain = _chain_body(q.operators, q._has_timers)
-            rewrite = self._q_out.get(qname) is not None
 
             def body(states, emitted, batch, now):
                 states, _t, emitted, out, _due = chain(
@@ -1032,49 +1306,184 @@ class TenantPool:
                     # like FusedChain hops
                     out = _as_current(out)
                 return states, emitted, out
+            return body
+        if kind == "pattern":
+            nfa_step = q.engine.make_stream_step(label[2:])
 
-            fn = jax.jit(jax.vmap(body, in_axes=(0, 0, 0, None)),
-                         **_donate(0, 1))
+            def pbody(st, emitted, batch, now):
+                nfa_state, match = nfa_step(st["nfa"], batch, now)
+                new_sel = []
+                for op, s in zip(sel_ops, st["sel"]):
+                    s, match = op.step(s, match, now)
+                    new_sel.append(s)
+                emitted = emitted + match.count().astype(jnp.int64)
+                if rewrite:
+                    match = _as_current(match)
+                return ({"nfa": nfa_state, "sel": tuple(new_sel)},
+                        emitted, match)
+            return pbody
+        # join: ONE whole-dict donated state per query — the opposite
+        # side's leaves are read inside and returned unchanged (an
+        # identity alias of the donated input, which is exactly what
+        # donation wants), so L and R steps share one state home
+        from ..ops.join import combined_schema
+        side = label
+        opp = "R" if side == "L" else "L"
+        my_ops = q.side_ops[side]
+        opp_window = q.side_ops[opp][-1] if q.side_ops[opp] else None
+        cross = q.crosses[side]
+        gate_alive = self.proto._columnar
+
+        def jbody(st, emitted, batch, now):
+            sides = st["sides"]
+            new_my = []
+            for op, s in zip(my_ops, sides[side]):
+                s, batch = op.step(s, batch, now)
+                new_my.append(s)
+            if cross is not None:
+                opp_buf = opp_window.findable_buffer(sides[opp][-1])
+                joined, lost = cross.cross(batch, opp_buf,
+                                           gate_alive=gate_alive)
+            else:
+                sch = combined_schema("#j", q.in_schemas["L"],
+                                      q.in_schemas["R"])
+                joined = EventBatch.empty(sch, 16)
+                lost = jnp.int64(0)
+            new_sel = []
+            for op, s in zip(sel_ops, st["sel"]):
+                s, joined = op.step(s, joined, now)
+                new_sel.append(s)
+            emitted = emitted + joined.count().astype(jnp.int64)
+            if rewrite:
+                joined = _as_current(joined)
+            return ({"sides": {side: tuple(new_my),
+                               opp: sides[opp]},
+                     "sel": tuple(new_sel),
+                     "ovf": st["ovf"] + lost},
+                    emitted, joined)
+        return jbody
+
+    def _vstep_for_locked(self, qname: str, label: str,
+                          flavor: tuple) -> Callable:
+        """jit(vmap(...)) step for one (query, input label, flavor):
+        flavor ``("b", cap)`` takes a stacked EventBatch + global now,
+        ``("p", enc, cap)`` unpacks the packed round buffer per slot
+        (core/ingest.py — each slot's header carries the round clock),
+        ``("t",)`` is the pattern absent-deadline timer step. States
+        and emitted donate; the batch/buffer argument never does (a
+        fan-out template dispatches the same entry to several
+        queries)."""
+        key = (qname, label, flavor, self.slots)
+        fn = self._vsteps.get(key)
+        if fn is None:
+            if flavor[0] == "t":
+                q = self.proto.queries[qname]
+                tstep = q.engine.make_timer_step()
+                sel_ops = q.operators
+                rewrite = self._q_out.get(qname) is not None
+
+                def tbody(st, emitted, now):
+                    nfa_state, match = tstep(st["nfa"], now)
+                    new_sel = []
+                    for op, s in zip(sel_ops, st["sel"]):
+                        s, match = op.step(s, match, now)
+                        new_sel.append(s)
+                    emitted = emitted + match.count().astype(jnp.int64)
+                    if rewrite:
+                        match = _as_current(match)
+                    return ({"nfa": nfa_state, "sel": tuple(new_sel)},
+                            emitted, match)
+
+                fn = jax.jit(jax.vmap(tbody, in_axes=(0, 0, None)),
+                             **_donate(0, 1))
+            elif flavor[0] == "p":
+                from ..core.ingest import unpack_buffer
+                _tag, enc, cap = flavor
+                core = self._core_body(qname, label)
+                sid = next(s for lab, s in self._q_in[qname]
+                           if lab == label)
+                schema = self.proto.junctions[sid].schema
+
+                def pk_body(st, emitted, buf):
+                    batch, now = unpack_buffer(schema, enc, cap, buf)
+                    return core(st, emitted, batch, now)
+
+                fn = jax.jit(jax.vmap(pk_body, in_axes=(0, 0, 0)),
+                             **_donate(0, 1))
+            else:
+                core = self._core_body(qname, label)
+                fn = jax.jit(jax.vmap(core, in_axes=(0, 0, 0, None)),
+                             **_donate(0, 1))
             self._vsteps[key] = fn
         return fn
 
-    def _dispatch(self, ingest_batch: EventBatch, now: int,
+    def _run_step(self, qname: str, label: str, entry,
+                  now_dev) -> Optional[EventBatch]:
+        """Advance one query's stacked state over one dispatch entry;
+        returns the stacked out batch (None for aggregations)."""
+        if entry[0] == "p":
+            buf, enc, cap = entry[1]
+            step = self._vstep_for(qname, label, ("p", enc, cap))
+            args = (self._states[qname], self._emitted[qname], buf)
+        else:
+            batch = entry[1]
+            cap = int(batch.ts.shape[1])
+            step = self._vstep_for(qname, label, ("b", cap))
+            args = (self._states[qname], self._emitted[qname], batch,
+                    now_dev)
+        self._states[qname], self._emitted[qname], out = step(*args)
+        self._dispatches += 1
+        return out
+
+    def _dispatch(self, stream_inputs: dict, now: int,
                   sample: bool = False) -> tuple[dict, dict]:
-        """Run the template's query chain over one stacked round;
-        returns ({terminal stream id: stacked out batch} (device),
-        {query: host completion time}). The completion times are only
-        populated when ``sample`` is set: that branch blocks after each
-        vmapped step (``block_until_ready`` — NOT a device_get; the
-        one-device-read-per-pool stats contract is untouched) so the
-        per-query ingest->emit attribution is honest."""
+        """Run the template's query graph over one stacked round;
+        ``stream_inputs`` maps ingest stream -> list of entries
+        (``("b", batch)`` / ``("p", (buf, enc, cap))``). Returns
+        ({terminal stream id: [stacked out batches]} (device),
+        {query: host completion time}). Patterns with absent deadlines
+        additionally run their vmapped timer step every dispatch (the
+        pool's round clock replaces the host scheduler — absent
+        matches fire at round boundaries). The completion times are
+        only populated when ``sample`` is set: that branch blocks after
+        each query's last step (``block_until_ready`` — NOT a
+        device_get; the one-device-read-per-pool stats contract is
+        untouched) so the per-query ingest->emit attribution is
+        honest."""
         now_dev = jnp.asarray(now, dtype=jnp.int64)
-        stream_batches = {self.ingest_stream: ingest_batch}
+        stream_batches = {sid: list(entries)
+                          for sid, entries in stream_inputs.items()}
         terminal: dict = {}
         qtimes: dict = {}
         for qname in self._order:
-            batch = stream_batches.get(self._q_in[qname])
-            if batch is None:
-                continue
-            cap = int(batch.ts.shape[1])
-            step = self._vstep_for(qname, cap)
-            states, emitted, out = step(
-                self._states[qname], self._emitted[qname], batch,
-                now_dev)
-            self._states[qname] = states
-            self._emitted[qname] = emitted
-            self._dispatches += 1
-            if sample:
+            outs = []
+            for label, sid in self._q_in[qname]:
+                for entry in stream_batches.get(sid, ()):
+                    out = self._run_step(qname, label, entry, now_dev)
+                    if out is not None:
+                        outs.append(out)
+            if self._kind[qname] == "pattern" and \
+                    self.proto.queries[qname].engine.has_absent:
+                step = self._vstep_for(qname, "timer", ("t",))
+                self._states[qname], self._emitted[qname], out = step(
+                    self._states[qname], self._emitted[qname], now_dev)
+                self._dispatches += 1
+                outs.append(out)
+            if sample and outs:
                 # sampled branch ONLY (1-in-slo_engine.every rounds):
                 # the sync is the point — per-query ingest->emit
                 # attribution needs the step provably finished
                 # (the PR 7 sampled-probe pattern)
-                jax.block_until_ready(out.valid)  # lint: disable=host-sync-in-loop
+                jax.block_until_ready(outs[-1].valid)  # lint: disable=host-sync-in-loop
                 qtimes[qname] = time.perf_counter()
             tgt = self._q_out[qname]
+            if not outs or tgt is None:
+                continue
             if tgt in self._terminal:
-                terminal[tgt] = out
-            elif tgt is not None:
-                stream_batches[tgt] = out
+                terminal.setdefault(tgt, []).extend(outs)
+            else:
+                stream_batches.setdefault(tgt, []).extend(
+                    ("b", o) for o in outs)
         return terminal, qtimes
 
     def _deliver(self, terminal: dict) -> None:
@@ -1088,9 +1497,13 @@ class TenantPool:
                        for tid, cbs in self._callbacks.items()
                        if tid in self._tenants]
         for tid, slot, cbs in targets:
-            per_sid = [(sid, evs) for sid, evs in
-                       ((sid, self._decode_slot(sid, out, slot))
-                        for sid, out in host.items()) if evs]
+            per_sid = []
+            for sid, outs in host.items():
+                evs = []
+                for out in outs:
+                    evs.extend(self._decode_slot(sid, out, slot))
+                if evs:
+                    per_sid.append((sid, evs))
             if not per_sid:
                 continue
             self._deliver_tenant(tid, cbs, per_sid)
@@ -1209,47 +1622,107 @@ class TenantPool:
         any (it sees the single-device twin of each program)."""
         from ..core.compile import (CompileSpec, spec_args_abstract,
                                     zeros_array)
+        from ..core.ingest import initial_encoding, layout
         caps = sorted({bucket_capacity(min(int(c), self.batch_max))
                        for c in (caps or (self.batch_max,))})
         base = self._spec_key_base()
         specs = []
+
+        def place(qname, states, emitted, batch=None, buf=None):
+            if self.mesh is None or spec_args_abstract():
+                return states, emitted, batch, buf
+            # warm SHARDED programs: the example args must carry the
+            # runtime placement or the AOT compile lands on a
+            # different (and never-dispatched) single-device program
+            placed = self._sharding.shard_pytree(
+                {"states": {qname: states},
+                 "emitted": {qname: emitted}},
+                self.mesh, self._sharding.POOL_STATE_RULES,
+                axis=self.mesh_axis)
+            states = placed["states"][qname]
+            emitted = placed["emitted"][qname]
+            if batch is not None:
+                batch = self._place_batch(batch)
+            if buf is not None:
+                buf = self._sharding.place_leading(
+                    buf, self.mesh, axis=self.mesh_axis)
+            return states, emitted, batch, buf
+
         with self._lock:
             slots = self.slots
+            ingest = set(self._ingest_streams)
             for cap in caps:
                 for qname in self._order:
-                    def build(qname=qname, cap=cap):
-                        fn = self._vstep_for(qname, cap)
-                        states = _tree_zeros(self._states[qname])
-                        emitted = zeros_array((slots,), jnp.int64)
-                        schema = self.proto.queries[qname].in_schema
-                        N = slots
-                        batch = EventBatch(
-                            ts=zeros_array((N, cap), jnp.int64),
-                            cols=tuple(zeros_array((N, cap), np_dtype(t))
-                                       for t in schema.types),
-                            nulls=tuple(zeros_array((N, cap), jnp.bool_)
-                                        for _ in schema.types),
-                            kind=zeros_array((N, cap), jnp.int32),
-                            valid=zeros_array((N, cap), jnp.bool_))
-                        if self.mesh is not None and \
-                                not spec_args_abstract():
-                            # warm SHARDED programs: the example args
-                            # must carry the runtime placement or the
-                            # AOT compile lands on a different (and
-                            # never-dispatched) single-device program
-                            placed = self._sharding.shard_pytree(
-                                {"states": {qname: states},
-                                 "emitted": {qname: emitted}},
-                                self.mesh,
-                                self._sharding.POOL_STATE_RULES,
-                                axis=self.mesh_axis)
-                            states = placed["states"][qname]
-                            emitted = placed["emitted"][qname]
-                            batch = self._place_batch(batch)
-                        return fn, (states, emitted, batch,
-                                    zeros_array((), jnp.int64))
-                    specs.append(CompileSpec(
-                        f"{base}/{qname}/v{slots}x{cap}", build))
+                    for label, sid in self._q_in[qname]:
+                        lab = "" if label == "*" \
+                            else "/" + label.replace(":", "-")
+                        schema = self.proto.junctions[sid].schema
+
+                        def build(qname=qname, label=label, cap=cap,
+                                  schema=schema):
+                            fn = self._vstep_for(qname, label,
+                                                 ("b", cap))
+                            states = _tree_zeros(self._states[qname])
+                            emitted = zeros_array((slots,), jnp.int64)
+                            N = slots
+                            batch = EventBatch(
+                                ts=zeros_array((N, cap), jnp.int64),
+                                cols=tuple(
+                                    zeros_array((N, cap), np_dtype(t))
+                                    for t in schema.types),
+                                nulls=tuple(
+                                    zeros_array((N, cap), jnp.bool_)
+                                    for _ in schema.types),
+                                kind=zeros_array((N, cap), jnp.int32),
+                                valid=zeros_array((N, cap), jnp.bool_))
+                            states, emitted, batch, _ = place(
+                                qname, states, emitted, batch=batch)
+                            return fn, (states, emitted, batch,
+                                        zeros_array((), jnp.int64))
+                        specs.append(CompileSpec(
+                            f"{base}/{qname}{lab}/v{slots}x{cap}",
+                            build))
+                        if not (self._packed_on and sid in ingest):
+                            continue
+                        # packed flavor: one spec per current sticky
+                        # encoding (the enc tuple is part of the key —
+                        # a widened stream warms its new shape)
+                        enc_obj = self._encoders.get(sid)
+                        enc = enc_obj.encoding if enc_obj is not None \
+                            else initial_encoding(schema)
+
+                        def pbuild(qname=qname, label=label, cap=cap,
+                                   schema=schema, enc=enc):
+                            fn = self._vstep_for(qname, label,
+                                                 ("p", enc, cap))
+                            states = _tree_zeros(self._states[qname])
+                            emitted = zeros_array((slots,), jnp.int64)
+                            _H, _o, total = layout(len(schema.types),
+                                                   enc, cap)
+                            buf = zeros_array((slots, total),
+                                              jnp.uint8)
+                            states, emitted, _, buf = place(
+                                qname, states, emitted, buf=buf)
+                            return fn, (states, emitted, buf)
+                        specs.append(CompileSpec(
+                            f"{base}/{qname}{lab}/v{slots}x{cap}"
+                            f"/pk-{'.'.join(enc)}", pbuild))
+            # pattern absent-deadline timer steps (cap-independent)
+            for qname in self._order:
+                if self._kind[qname] != "pattern" or \
+                        not self.proto.queries[qname].engine.has_absent:
+                    continue
+
+                def tbuild(qname=qname):
+                    fn = self._vstep_for(qname, "timer", ("t",))
+                    states = _tree_zeros(self._states[qname])
+                    emitted = zeros_array((slots,), jnp.int64)
+                    states, emitted, _, _ = place(qname, states,
+                                                  emitted)
+                    return fn, (states, emitted,
+                                zeros_array((), jnp.int64))
+                specs.append(CompileSpec(
+                    f"{base}/{qname}/timer/v{slots}", tbuild))
         return specs
 
     def warmup(self, caps=None, workers: Optional[int] = None) -> dict:
@@ -1358,6 +1831,29 @@ class TenantPool:
                 self._emitted[qn] = self._emitted[qn].at[slot].set(
                     jnp.asarray(snap["emitted"]))
 
+    # -- aggregation query side -------------------------------------------
+
+    def materialize_tenant(self, tenant_id: str, agg_id: str,
+                           duration: str, start: Optional[int] = None,
+                           end: Optional[int] = None):
+        """One tenant's `within/per` view of a pooled incremental
+        aggregation: slice the tenant's slot out of the stacked bucket
+        tables (one device_get of one slot's slice) and materialize it
+        host-side through the aggregation runtime's own projection
+        (core/aggregation.py materialize_from) — bit-identical to a
+        separate runtime fed the same rows."""
+        with self._lock:
+            ar = self._aggs.get(agg_id)
+            if ar is None:
+                raise KeyError(
+                    f"no aggregation '{agg_id}' in pool '{self.name}' "
+                    f"(aggregations: {sorted(self._aggs)})")
+            slot = self._slot(tenant_id)
+            d = ar.duration_key(duration)
+            host = jax.device_get(jax.tree_util.tree_map(
+                lambda x: x[slot], self._states[agg_id][d]))
+        return ar.materialize_from(host, d, start, end)
+
     # -- live slot migration (serving/migrate.py orchestrates; docs/
     # serving.md "Live migration & rebalance") ----------------------------
 
@@ -1459,10 +1955,12 @@ class TenantPool:
             # assert conservation: parked + pending in == pending out
             before = self._pending_rows.get(tid, 0)
             parked = mig["parked_rows"]
-            q = self._pending.setdefault(tid, deque())
-            q.extend(mig["parked"])
+            qs = self._pending.setdefault(tid, self._fresh_queues())
+            for sid, ts, cols, t_arr in mig["parked"]:
+                qs[sid].append((ts, cols, t_arr))
             self._pending_rows[tid] = before + parked
-            actual = sum(len(t) for t, _c, _a in q)
+            actual = sum(len(t) for q in qs.values()
+                         for t, _c, _a in q)
             assert actual == self._pending_rows[tid], (
                 f"migration row conservation broken for '{tid}': "
                 f"{actual} queued != {before} pending + {parked} parked")
@@ -1556,8 +2054,10 @@ class TenantPool:
                     continue
                 if mig["to_device"] != device:
                     self._free.append(mig["to_slot"])
-                q = self._pending.setdefault(tid, deque())
-                q.extend(mig["parked"])
+                qs = self._pending.setdefault(tid,
+                                              self._fresh_queues())
+                for sid, ts, cols, t_arr in mig["parked"]:
+                    qs[sid].append((ts, cols, t_arr))
                 self._pending_rows[tid] = \
                     self._pending_rows.get(tid, 0) + mig["parked_rows"]
                 del self._migrations[tid]
@@ -1694,7 +2194,7 @@ class TenantPool:
                 self._tenants[tid] = slot
                 self._bindings[tid] = dict(entry.get("bindings") or {})
                 self._tenant_qos_raw[tid] = dict(entry.get("qos") or {})
-                self._pending[tid] = deque()
+                self._pending[tid] = self._fresh_queues()
                 self._pending_rows[tid] = 0
                 self._error_counts[tid] = 0
                 if self._qos is not None:
@@ -1858,6 +2358,22 @@ class TenantPool:
                 "rounds": self._rounds, "dispatches": self._dispatches,
                 "grows": self._grows,
                 "state_bytes_per_tenant": self.state_bytes_per_tenant,
+                "state_bytes_by_class":
+                    dict(self.state_bytes_by_class),
+            }
+            ist = self._ingest_stats
+            packed_ingest = {
+                "enabled": self._packed_on,
+                "transfers_per_round":
+                    round(ist["transfers"] / ist["rounds"], 3)
+                    if ist["rounds"] else 0.0,
+                "rows_packed": ist["rows"],
+                "pad_frac":
+                    round(1.0 - ist["rows"] / ist["cells"], 4)
+                    if ist["cells"] else 0.0,
+                "bytes": ist["bytes"],
+                "rounds": ist["rounds"],
+                "streams": len(self._ingest_streams),
             }
             saturation = self._saturation_locked()
             qos_rep = None
@@ -1966,7 +2482,19 @@ class TenantPool:
             # departed tenants must not linger in scrapes
             self.metrics.prune_family(fam, dotted)
         for k, v in pool_stats.items():
-            flat[f"{p}.pool.{k}"] = v
+            if isinstance(v, dict):
+                for kk, vv in v.items():
+                    flat[f"{p}.pool.{k}.{kk}"] = vv
+            else:
+                flat[f"{p}.pool.{k}"] = v
+        # packed pool ingest (docs/performance.md "Packed pool
+        # ingest"): one transfer per ingest stream per round is the
+        # acceptance invariant — transfers_per_round tracks it, and
+        # pad_frac shows how much of each (slots, cap) round was
+        # padding (bench.py tenants arms export the same block)
+        report["packed_ingest"] = packed_ingest
+        for k in ("transfers_per_round", "rows_packed", "pad_frac"):
+            flat[f"{p}.ingest.{k}"] = packed_ingest[k]
         if mesh_info is not None:
             # per-device labeled gauge FAMILIES (`device=` label — the
             # cardinality-safe shape, docs/observability.md): slots

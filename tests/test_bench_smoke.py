@@ -258,6 +258,31 @@ def test_bench_tenants_quick_parses():
     assert reb["migration_pause_ms"] >= 0
     assert reb["rows_moved"] >= 0
     assert reb["lost"] == 0 and reb["duplicates"] == 0
+    # packed pool ingest acceptance (docs/performance.md "Packed pool
+    # ingest"): ONE device transfer per ingest stream per fair round —
+    # the filter template has one ingest stream, so the per-round
+    # transfer count must not exceed 1
+    pk = d["packed_ingest"]
+    assert 0 < pk["transfers_per_round"] <= 1.0 + 1e-9, pk
+    assert pk["rows_packed"] > 0
+    assert 0.0 <= pk["pad_frac"] < 1.0
+    # operator-class arms (docs/serving.md "Poolable operator
+    # classes"): pattern NFA and two-stream equi-join pools measured
+    # pooled-vs-separate with the same one-program-set compile story
+    for arm, n_streams in (("pattern_template", 1),
+                           ("join_template", 2)):
+        e = d[arm]
+        assert e["eps_pooled"] > 0 and e["eps_separate"] > 0, (arm, e)
+        assert e["speedup"] > 0
+        assert e["program_sets"] == 1
+        assert e["compile_ms"] > 0
+        assert e["ingest_streams"] and \
+            len(e["ingest_streams"]) == n_streams
+        epk = e["packed_ingest"]
+        assert 0 < epk["transfers_per_round"] <= n_streams + 1e-9, \
+            (arm, epk)
+        assert epk["rows_packed"] > 0
+        assert 0.0 <= epk["pad_frac"] < 1.0
 
 
 def test_bench_fanout_quick_parses():

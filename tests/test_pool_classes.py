@@ -1,0 +1,518 @@
+"""Pooled operator classes (serving/pool.py): pattern, join and
+incremental-aggregation templates running as vmapped tenant slots —
+bit-equality vs N separate statically-bound runtimes (including the
+disorder sweep), packed single-transfer pool ingest (counting-
+device_put: ONE transfer per ingest stream per round, one SHARDED put
+per round on a mesh), counting-jit zero-recompile churn for every
+class, and per-slot snapshot/restore + live-migration round-trips of
+NFA / join / aggregation slot state.
+"""
+import functools
+
+import numpy as np
+import pytest
+
+import jax
+
+from siddhi_tpu import SiddhiManager, StreamCallback
+from siddhi_tpu.parallel import sharding
+from siddhi_tpu.serving import Template, TenantPool
+
+TS0 = 1_000_000
+
+PATTERN_TPL = """
+define stream S (k int, v int);
+@info(name='p')
+from every e1=S[v > 800] -> e2=S[k == e1.k and v < 100]
+within 10 sec
+select e1.k as k, e1.v as v1, e2.v as v2
+insert into Out;
+"""
+
+JOIN_TPL = """
+define stream L (k int, v int);
+define stream R (k int, w int);
+@info(name='j')
+from L#window.length(16) as a join R#window.length(16) as b
+  on a.k == b.k
+select a.k as k, a.v as v, b.w as w
+insert into Out;
+"""
+
+AGG_TPL = """
+define stream T (sym long, price double, ats long);
+@info(name='q')
+from T select sym, price insert into Out;
+define aggregation Agg
+from T
+select sym, sum(price) as tp, count() as n
+group by sym
+aggregate by ats every seconds, minutes;
+"""
+
+
+def _mk_pool(text, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("max_tenants", 64)
+    kw.setdefault("batch_max", 64)
+    return TenantPool(Template(text), manager=SiddhiManager(), **kw)
+
+
+def _chunks(seed, n=192, chunk=48, lo=0, hi=1000):
+    """Strictly-increasing ts + seeded int32 payload columns."""
+    rng = np.random.default_rng(seed)
+    out = []
+    for c in range(n // chunk):
+        ts = TS0 + (c * chunk + np.arange(chunk, dtype=np.int64)) * 4
+        cols = [rng.integers(lo, hi, chunk).astype(np.int32)
+                for _ in range(2)]
+        out.append((ts, cols))
+    return out
+
+
+def _shuffle_within(ts, cols, rng, skew=48):
+    jitter = rng.integers(0, skew + 1, ts.shape[0])
+    order = np.argsort(ts + jitter, kind="stable")
+    return ts[order], [c[order] for c in cols]
+
+
+def _separate(text, stream_chunks):
+    """One statically-bound runtime fed the same per-stream chunks."""
+    mgr = SiddhiManager()
+    rt = mgr.create_siddhi_app_runtime(
+        Template(text).instantiate_static({}, app_name="sep"))
+    got = []
+    rt.add_callback("Out", StreamCallback(fn=lambda evs: got.extend(
+        (e.timestamp, tuple(e.data)) for e in evs)))
+    rt.start()
+    for per_stream in stream_chunks:
+        for sid, (ts, cols) in per_stream:
+            rt.get_input_handler(sid).send_arrays(ts, cols)
+    rt.shutdown()
+    return got
+
+
+def _pooled(text, tenants, per_tenant_chunks):
+    """The same rows through one pool: send every tenant's chunk, pump
+    once per chunk round (the separate runtimes' batching twin)."""
+    pool = _mk_pool(text)
+    got = {tid: [] for tid in tenants}
+    for tid in tenants:
+        pool.add_tenant(tid, {})
+        pool.add_callback(tid, lambda evs, t=tid: got[t].extend(
+            (e.timestamp, tuple(e.data)) for e in evs))
+    rounds = max(len(c) for c in per_tenant_chunks.values())
+    for i in range(rounds):
+        for tid in tenants:
+            for sid, (ts, cols) in per_tenant_chunks[tid][i]:
+                pool.send(tid, ts, cols, stream=sid)
+        pool.flush()
+    pool.shutdown()
+    return got, pool
+
+
+# ---- bit-equality vs separate runtimes (the disorder sweep) ------------
+
+
+@pytest.mark.parametrize("disorder", [False, True],
+                         ids=["ordered", "disorder"])
+def test_pattern_pool_bit_equal_to_separate_runtimes(disorder):
+    tenants = ["a", "b", "c"]
+    per_tenant = {}
+    for i, tid in enumerate(tenants):
+        rng = np.random.default_rng(100 + i)
+        rounds = []
+        for ts, cols in _chunks(seed=10 + i):
+            if disorder:
+                ts, cols = _shuffle_within(ts, cols, rng)
+            rounds.append([("S", (ts, cols))])
+        per_tenant[tid] = rounds
+    expected = {tid: _separate(PATTERN_TPL, per_tenant[tid])
+                for tid in tenants}
+    assert any(expected.values()), "baselines produced no matches"
+    got, _pool = _pooled(PATTERN_TPL, tenants, per_tenant)
+    for tid in tenants:
+        assert got[tid] == expected[tid], tid
+
+
+@pytest.mark.parametrize("disorder", [False, True],
+                         ids=["ordered", "disorder"])
+def test_join_pool_bit_equal_to_separate_runtimes(disorder):
+    tenants = ["a", "b"]
+    per_tenant = {}
+    for i, tid in enumerate(tenants):
+        rng = np.random.default_rng(200 + i)
+        lchunks = _chunks(seed=20 + i, lo=0, hi=8)
+        rchunks = _chunks(seed=40 + i, lo=0, hi=8)
+        rounds = []
+        for (lts, lcols), (rts, rcols) in zip(lchunks, rchunks):
+            rts = rts + 2   # interleave: distinct cross-stream ts
+            if disorder:
+                lts, lcols = _shuffle_within(lts, lcols, rng)
+                rts, rcols = _shuffle_within(rts, rcols, rng)
+            rounds.append([("L", (lts, lcols)), ("R", (rts, rcols))])
+        per_tenant[tid] = rounds
+    expected = {tid: _separate(JOIN_TPL, per_tenant[tid])
+                for tid in tenants}
+    assert all(expected.values()), "baselines produced no join rows"
+    got, pool = _pooled(JOIN_TPL, tenants, per_tenant)
+    assert sorted(pool.ingest_streams) == ["L", "R"]
+    for tid in tenants:
+        assert got[tid] == expected[tid], tid
+
+
+def _agg_chunks(seed, rounds=3, chunk=32):
+    rng = np.random.default_rng(seed)
+    out = []
+    for c in range(rounds):
+        ts = TS0 + (c * chunk + np.arange(chunk, dtype=np.int64))
+        sym = rng.integers(0, 4, chunk).astype(np.int64)
+        price = rng.uniform(1.0, 9.0, chunk)
+        ats = 1_000 + rng.integers(0, 5, chunk).astype(np.int64) * 1000
+        out.append((ts, [sym, price, ats]))
+    return out
+
+
+def _agg_rows(schema, buf):
+    """Valid bucket rows as a sorted list of value tuples."""
+    valid = np.asarray(buf["valid"])
+    cols = [np.asarray(c) for c in buf["cols"]]
+    rows = []
+    for i in np.nonzero(valid)[0]:
+        rows.append(tuple(round(float(c[i]), 9) for c in cols))
+    return sorted(rows)
+
+
+def test_aggregation_pool_matches_separate_runtime():
+    """materialize_tenant == a separate runtime's materialize over the
+    same rows, per duration, per tenant."""
+    tenants = ["a", "b"]
+    chunks = {tid: _agg_chunks(seed=7 + i)
+              for i, tid in enumerate(tenants)}
+
+    expected = {}
+    for tid in tenants:
+        mgr = SiddhiManager()
+        rt = mgr.create_siddhi_app_runtime(
+            Template(AGG_TPL).instantiate_static({}, app_name="sep"))
+        rt.start()
+        h = rt.get_input_handler("T")
+        for ts, cols in chunks[tid]:
+            h.send_arrays(ts, cols)
+        ar = rt.aggregations["Agg"]
+        expected[tid] = {
+            d: _agg_rows(*ar.materialize(d, None, None))
+            for d in ("seconds", "minutes")}
+        rt.shutdown()
+        assert expected[tid]["seconds"], "baseline built no buckets"
+
+    pool = _mk_pool(AGG_TPL)
+    for tid in tenants:
+        pool.add_tenant(tid, {})
+    for i in range(len(chunks["a"])):
+        for tid in tenants:
+            ts, cols = chunks[tid][i]
+            pool.send(tid, ts, cols)
+        pool.flush()
+    for tid in tenants:
+        for d in ("seconds", "minutes"):
+            schema, buf = pool.materialize_tenant(tid, "Agg", d)
+            assert _agg_rows(schema, buf) == expected[tid][d], \
+                (tid, d)
+    with pytest.raises(KeyError, match="no aggregation"):
+        pool.materialize_tenant("a", "Nope", "seconds")
+
+
+# ---- packed ingest: counting-device_put --------------------------------
+
+
+def _count_puts(monkeypatch):
+    real_put = jax.device_put
+    calls = []
+
+    @functools.wraps(real_put)
+    def counting(x, *a, **kw):
+        calls.append(x)
+        return real_put(x, *a, **kw)
+
+    monkeypatch.setattr(jax, "device_put", counting)
+    return calls
+
+
+def test_packed_ingest_one_transfer_per_stream_per_round(monkeypatch):
+    """The acceptance invariant at N=64 tenants: one fair round ships
+    exactly ONE device_put per ingest stream, no matter how many
+    tenants contributed rows."""
+    pool = _mk_pool(JOIN_TPL, slots=64, max_tenants=64)
+    chunks = _chunks(seed=3, n=48, chunk=48, lo=0, hi=8)
+    for i in range(64):
+        pool.add_tenant(f"t{i}", {})
+    for i in range(64):
+        ts, cols = chunks[0]
+        pool.send(f"t{i}", ts, cols, stream="L")
+        pool.send(f"t{i}", ts + 2, cols, stream="R")
+    calls = _count_puts(monkeypatch)
+    n = pool.pump()
+    assert n == 64 * 2 * 48
+    assert len(calls) == 2, \
+        f"expected one transfer per ingest stream, saw {len(calls)}"
+    assert all(isinstance(c, np.ndarray) and c.dtype == np.uint8
+               and c.shape[0] == 64 for c in calls)
+    stats = pool.statistics()["packed_ingest"]
+    assert stats["enabled"] and stats["transfers_per_round"] == 2.0
+    assert stats["rows_packed"] == 64 * 2 * 48
+
+
+def test_packed_ingest_single_stream_and_fallback(monkeypatch):
+    """Single-stream template: ONE put per round packed; the
+    SIDDHI_TPU_POOL_PACKED=0 kill switch falls back to the stacked
+    EventBatch (one put per pytree leaf, still one logical transfer —
+    and identical outputs)."""
+    chunks = _chunks(seed=5, n=96, chunk=48)
+
+    def run(env):
+        monkeypatch.setenv("SIDDHI_TPU_POOL_PACKED", env)
+        pool = _mk_pool(PATTERN_TPL, slots=8, max_tenants=8)
+        got = []
+        pool.add_tenant("a", {})
+        pool.add_callback("a", lambda evs: got.extend(
+            (e.timestamp, tuple(e.data)) for e in evs))
+        for ts, cols in chunks:
+            pool.send("a", ts, cols)
+            pool.flush()
+        return pool, got
+
+    pool, got_packed = run("1")
+    assert pool._packed_on
+    for ts, cols in chunks:
+        pool.send("a", ts, cols)
+    calls = _count_puts(monkeypatch)
+    pool.pump()
+    assert len(calls) == 1
+
+    pool2, got_batched = run("0")
+    assert not pool2._packed_on
+    assert pool2.statistics()["packed_ingest"]["enabled"] is False
+    assert got_batched == got_packed, \
+        "packed and stacked ingest must be output-identical"
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="mesh pool needs >= 2 devices")
+def test_packed_ingest_mesh_one_sharded_put_per_stream(monkeypatch):
+    pool = TenantPool(Template(JOIN_TPL), manager=SiddhiManager(),
+                      slots=4, max_tenants=4, batch_max=64,
+                      mesh=sharding.build_mesh(2))
+    chunks = _chunks(seed=9, n=48, chunk=48, lo=0, hi=8)
+    for i in range(4):
+        pool.add_tenant(f"t{i}", {})
+    ts, cols = chunks[0]
+    for i in range(4):
+        pool.send(f"t{i}", ts, cols, stream="L")
+        pool.send(f"t{i}", ts + 2, cols, stream="R")
+    calls = _count_puts(monkeypatch)
+    n = pool.pump()
+    assert n == 4 * 2 * 48
+    # one SHARDED put per ingest stream: each carries a NamedSharding
+    assert len(calls) == 2
+    stats = pool.statistics()["packed_ingest"]
+    assert stats["transfers_per_round"] == 2.0
+
+
+# ---- zero-recompile churn for every pooled class -----------------------
+
+
+@pytest.mark.parametrize("tpl,streams", [
+    (PATTERN_TPL, ("S",)),
+    (JOIN_TPL, ("L", "R")),
+    (AGG_TPL, ("T",)),
+], ids=["pattern", "join", "aggregation"])
+def test_class_pools_churn_zero_recompiles(monkeypatch, tpl, streams):
+    real_jit = jax.jit
+    traces = [0]
+
+    def counting_jit(f, *a, **kw):
+        @functools.wraps(f)
+        def wrapped(*args, **kwargs):
+            traces[0] += 1
+            return f(*args, **kwargs)
+        return real_jit(wrapped, *a, **kw)
+
+    monkeypatch.setattr(jax, "jit", counting_jit)
+    pool = _mk_pool(tpl, slots=4, max_tenants=4)
+    if tpl is AGG_TPL:
+        chunk = _agg_chunks(seed=1, rounds=1)[0]
+    else:
+        chunk = _chunks(seed=1, n=48, chunk=48, lo=0, hi=8)[0]
+
+    def traffic(tid):
+        ts, cols = chunk
+        for sid in streams:
+            pool.send(tid, ts, cols, stream=sid)
+        pool.flush()
+
+    pool.add_tenant("a", {})
+    pool.add_tenant("b", {})
+    traffic("a")
+    warm = traces[0]
+    assert warm > 0
+    for i in range(3):
+        pool.remove_tenant("b")
+        pool.add_tenant("b", {})
+        pool.add_tenant(f"c{i}", {})
+        pool.remove_tenant(f"c{i}")
+        traffic("a")
+        traffic("b")
+    assert traces[0] == warm, \
+        f"{pool._kind} pool churn must not retrace"
+
+
+# ---- snapshot/restore + migration round-trips --------------------------
+
+
+def _slot_slice(pool, tid):
+    slot = pool._tenants[tid]
+    return jax.device_get(jax.tree_util.tree_map(
+        lambda x: x[slot], {qn: pool._states[qn]
+                            for qn in pool._order}))
+
+
+def _assert_trees_equal(a, b, msg=""):
+    fa, _ = jax.tree_util.tree_flatten(a)
+    fb, _ = jax.tree_util.tree_flatten(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y),
+                                      err_msg=msg)
+
+
+@pytest.mark.parametrize("tpl,streams", [
+    (PATTERN_TPL, ("S",)),
+    (JOIN_TPL, ("L", "R")),
+    (AGG_TPL, ("T",)),
+], ids=["pattern", "join", "aggregation"])
+def test_slot_snapshot_restore_roundtrip_bit_identical(tpl, streams):
+    """snapshot -> more traffic -> restore returns the slot to the
+    snapshot bit-for-bit, for NFA, join and aggregation slot state;
+    the other tenant's slices never move."""
+    pool = _mk_pool(tpl, slots=4, max_tenants=4)
+    pool.add_tenant("a", {})
+    pool.add_tenant("b", {})
+    if tpl is AGG_TPL:
+        chunks = _agg_chunks(seed=2, rounds=2)
+    else:
+        chunks = _chunks(seed=2, n=96, chunk=48, lo=0, hi=8)
+    for tid in ("a", "b"):
+        ts, cols = chunks[0]
+        for sid in streams:
+            pool.send(tid, ts, cols, stream=sid)
+    pool.flush()
+
+    snap_a = pool.snapshot_tenant("a")
+    before_a = _slot_slice(pool, "a")
+    ts, cols = chunks[1]
+    for sid in streams:
+        pool.send("a", ts, cols, stream=sid)
+    pool.flush()
+    # b's baseline AFTER a's traffic: a ring grow rewrites shared
+    # capacity leaves across every slot (one compiled shape), so the
+    # isolation invariant is that the RESTORE leaves b untouched
+    before_b = _slot_slice(pool, "b")
+    # a advanced, b did not
+    assert not all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree_util.tree_leaves(before_a),
+                        jax.tree_util.tree_leaves(_slot_slice(pool,
+                                                              "a")))), \
+        "traffic must advance the slot state"
+    pool.restore_tenant("a", snap_a)
+    _assert_trees_equal(_slot_slice(pool, "a"), before_a,
+                        "restore must be bit-identical")
+    _assert_trees_equal(_slot_slice(pool, "b"), before_b,
+                        "other tenants must not move")
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="migration needs >= 2 mesh devices")
+@pytest.mark.parametrize("tpl,streams", [
+    (PATTERN_TPL, ("S",)),
+    (JOIN_TPL, ("L", "R")),
+    (AGG_TPL, ("T",)),
+], ids=["pattern", "join", "aggregation"])
+def test_live_migration_preserves_class_state(tpl, streams):
+    pool = TenantPool(Template(tpl), manager=SiddhiManager(),
+                      slots=4, max_tenants=4, batch_max=64,
+                      mesh=sharding.build_mesh(2))
+    pool.add_tenant("a", {})
+    pool.add_tenant("b", {})
+    if tpl is AGG_TPL:
+        chunks = _agg_chunks(seed=3, rounds=2)
+    else:
+        chunks = _chunks(seed=3, n=96, chunk=48, lo=0, hi=8)
+    for tid in ("a", "b"):
+        ts, cols = chunks[0]
+        for sid in streams:
+            pool.send(tid, ts, cols, stream=sid)
+    pool.flush()
+    before = _slot_slice(pool, "a")
+    src = pool._device_of_slot(pool._tenants["a"])
+    rec = pool.migrate_tenant("a", 1 - src, cause="test")
+    assert rec["to"]["device"] == 1 - src
+    _assert_trees_equal(_slot_slice(pool, "a"), before,
+                        "migration must move state bit-identically")
+    # the moved slot keeps serving correctly: more traffic equals the
+    # same traffic on a never-migrated twin
+    ts, cols = chunks[1]
+    for sid in streams:
+        pool.send("a", ts, cols, stream=sid)
+    pool.flush()
+    after_mig = _slot_slice(pool, "a")
+
+    twin = TenantPool(Template(tpl), manager=SiddhiManager(),
+                      slots=4, max_tenants=4, batch_max=64,
+                      mesh=sharding.build_mesh(2))
+    twin.add_tenant("a", {})
+    twin.add_tenant("b", {})
+    for tid in ("a", "b"):
+        ts, cols = chunks[0]
+        for sid in streams:
+            twin.send(tid, ts, cols, stream=sid)
+    twin.flush()
+    ts, cols = chunks[1]
+    for sid in streams:
+        twin.send("a", ts, cols, stream=sid)
+    twin.flush()
+    _assert_trees_equal(after_mig, _slot_slice(twin, "a"),
+                        "post-migration execution must match a "
+                        "never-migrated twin")
+
+
+# ---- admission: per-class state accounting -----------------------------
+
+
+def test_state_quota_429_names_per_class_breakdown():
+    probe = _mk_pool(JOIN_TPL)
+    by_class = probe.state_bytes_by_class
+    assert "join" in by_class and by_class["join"] > 0
+    pool = _mk_pool(
+        JOIN_TPL, state_quota_bytes=probe.state_bytes_per_tenant + 1)
+    pool.add_tenant("a", {})
+    from siddhi_tpu.serving import AdmissionError
+    with pytest.raises(AdmissionError, match="state quota") as ei:
+        pool.add_tenant("b", {})
+    assert "join=" in str(ei.value)
+    sat = ei.value.saturation
+    assert sat["state_bytes_by_class"]["join"] == by_class["join"]
+
+
+def test_state_bytes_by_class_covers_all_classes():
+    for tpl, cls in ((PATTERN_TPL, "pattern"), (JOIN_TPL, "join"),
+                     (AGG_TPL, "aggregation")):
+        pool = _mk_pool(tpl)
+        assert pool.state_bytes_by_class.get(cls, 0) > 0, cls
+        assert sum(pool.state_bytes_by_class.values()) == \
+            pool.state_bytes_per_tenant
+    # statistics surface the breakdown
+    pool = _mk_pool(AGG_TPL)
+    st = pool.statistics()["pool"]
+    assert st["state_bytes_by_class"] == pool.state_bytes_by_class

@@ -205,20 +205,25 @@ def test_pool_window_template():
 
 
 def test_pool_rejects_unpoolable_templates():
-    with pytest.raises(CompileError, match="not poolable"):
-        _mk_pool("""
-            define stream A (x long);
-            define stream B (y long);
-            from A#window.length(2) join B#window.length(2)
-            on A.x == B.y
-            select A.x insert into Out;
-        """)
-    with pytest.raises(CompileError, match="not poolable"):
+    # joins and patterns are poolable now; tables are the honest
+    # remainder, and the rejection names a reason plus the nearest
+    # poolable alternative.
+    with pytest.raises(CompileError, match="not poolable") as ei:
         _mk_pool("""
             define stream A (x long);
             define table T (x long);
             from A select x insert into T;
         """)
+    assert "nearest poolable alternative" in str(ei.value)
+    with pytest.raises(CompileError,
+                       match="reads tables|joins table") as ei:
+        _mk_pool("""
+            define stream A (x long);
+            define table T (y long);
+            from A join T on A.x == T.y
+            select A.x insert into Out;
+        """)
+    assert "nearest poolable alternative" in str(ei.value)
     # a param in a join ON is caught even earlier, by the plan rule
     with pytest.raises(CompileError, match="template-binding"):
         _mk_pool("""
@@ -228,6 +233,26 @@ def test_pool_rejects_unpoolable_templates():
             on A.x == B.y and A.x > ${lo:long}
             select A.x insert into Out;
         """)
+
+
+def test_pool_accepts_join_and_pattern_templates():
+    # the former rejection list shrank: plain stream-stream joins and
+    # patterns compile into pools now.
+    pool = _mk_pool("""
+        define stream A (x long);
+        define stream B (y long);
+        from A#window.length(2) join B#window.length(2)
+        on A.x == B.y
+        select A.x insert into Out;
+    """)
+    assert sorted(pool.ingest_streams) == ["A", "B"]
+    pool2 = _mk_pool("""
+        define stream S (v double, k long);
+        from every e1=S[v > 0.0] -> e2=S[v > e1.v]
+        within 100 sec
+        select e1.v as a, e2.v as b insert into Out;
+    """)
+    assert list(pool2.ingest_streams) == ["S"]
 
 
 def test_pool_binding_validation_routes_through_plan_rule():

@@ -20,6 +20,11 @@ Gate (exit 1):
   configs that report it (the ingest config): the double-buffered
   pipeline silently degrading to serial is a regression throughput
   numbers can hide on small runs;
+- ``packed_ingest.transfers_per_round`` rising more than 0.5 absolute
+  on configs that report it (the tenants config): the pooled ingest
+  acceptance is ONE device transfer per ingest stream per round —
+  extra per-round puts mean the packed path silently fell back to
+  per-tenant transfers, which small-run throughput can also hide;
 - any ``plan.plan_hash`` change, unless ``--allow-plan-change`` — a
   faster number measured against a DIFFERENT plan is not a comparison,
   it is a confound (the plan block exists so BENCH artifacts record
@@ -81,6 +86,14 @@ def _overlap_frac(entry: dict):
     return None
 
 
+def _transfers_per_round(entry: dict):
+    pk = entry.get("packed_ingest")
+    if isinstance(pk, dict):
+        v = pk.get("transfers_per_round")
+        return v if isinstance(v, (int, float)) else None
+    return None
+
+
 def _num(entry: dict, key: str):
     v = entry.get(key)
     return v if isinstance(v, (int, float)) else None
@@ -119,6 +132,14 @@ def diff_configs(a: dict, b: dict, threshold_pct: float,
             # means the double-buffered pipeline stopped overlapping
             if ob < oa - 0.25:
                 row["flags"].append("overlap-drop")
+                regressions.append(name)
+        ta, tb = _transfers_per_round(ea), _transfers_per_round(eb)
+        if ta is not None and tb is not None:
+            row["transfers_a"], row["transfers_b"] = ta, tb
+            # one put per ingest stream per round is the packed-ingest
+            # acceptance; a rise means per-tenant transfers crept back
+            if tb > ta + 0.5:
+                row["flags"].append("packed-ingest-transfers")
                 regressions.append(name)
         ha, hb = _plan_hash(ea), _plan_hash(eb)
         row["plan_a"], row["plan_b"] = ha, hb
